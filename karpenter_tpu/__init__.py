@@ -1,0 +1,30 @@
+"""karpenter-tpu: a TPU-native cluster-capacity framework.
+
+A brand-new implementation of the capabilities of Karpenter core
+(sigs.k8s.io/karpenter, surveyed in SURVEY.md): watching unschedulable pods,
+simulating kube-scheduler constraints, bin-packing pods onto priced instance
+types, launching right-sized nodes, and continuously consolidating the
+cluster under disruption budgets.
+
+The two combinatorial hot paths of the reference — the provisioning
+bin-packer (pkg/controllers/provisioning/scheduling/scheduler.go:195) and the
+consolidation search (pkg/controllers/disruption) — are reformulated here as
+batched pod-group x instance-type feasibility tensors with a greedy/LP-relaxed
+assignment kernel in JAX/XLA, sharded via shard_map over a device mesh, with
+an in-process FFD fallback when no accelerator is available.
+
+Layering (mirrors SURVEY.md §1, re-architected TPU-first):
+
+    api/            L0  data model (NodePool, NodeClaim, Pod, Node, labels)
+    scheduling/     L1  constraint algebra (Requirements, Taints, ports, volumes)
+    cloudprovider/  L2  cloud-provider SPI + fake + kwok catalog
+    state/          L3  in-memory cluster mirror + tensor snapshots
+    ops/            --  tensorization compilers + device kernels
+    models/         --  Solver implementations (FFD host, TPU batched)
+    parallel/       --  mesh / shard_map sharded solve
+    controllers/    L4-L6 provisioning, disruption, lifecycle
+    kube/           --  in-memory apiserver (envtest/kwok analog)
+    operator/       L7  options, runtime wiring
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+from karpenter_tpu.api import labels  # noqa: F401
+from karpenter_tpu.api.objects import (  # noqa: F401
+    Node,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api.nodeclaim import NodeClaim, NodeClaimSpec, NodeClaimStatus  # noqa: F401
+from karpenter_tpu.api.nodepool import (  # noqa: F401
+    Budget,
+    Disruption,
+    NodePool,
+    NodePoolSpec,
+    NodePoolStatus,
+)

@@ -1,0 +1,105 @@
+"""NodeClaim: one requested/owned machine.
+
+Field semantics from the reference's pkg/apis/v1beta1/nodeclaim.go
+(NodeClaimSpec :26, NodeSelectorRequirementWithMinValues :60) and
+nodeclaim_status.go (providerID, capacity/allocatable, conditions
+Launched/Registered/Initialized plus disruption conditions
+Drifted/Empty/Expired set by pkg/controllers/nodeclaim/disruption).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from karpenter_tpu.api.objects import ObjectMeta
+
+# condition types
+COND_LAUNCHED = "Launched"
+COND_REGISTERED = "Registered"
+COND_INITIALIZED = "Initialized"
+COND_DRIFTED = "Drifted"
+COND_EMPTY = "Empty"
+COND_EXPIRED = "Expired"
+COND_CONSISTENT = "ConsistentStateFound"
+COND_TERMINATING = "Terminating"
+
+
+@dataclass
+class Condition:
+    type: str
+    status: str = "True"  # True | False | Unknown
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class NodeClaimSpec:
+    taints: list = field(default_factory=list)  # [Taint]
+    startup_taints: list = field(default_factory=list)
+    requirements: list = field(default_factory=list)  # [NodeSelectorRequirement]
+    resource_requests: dict = field(default_factory=dict)
+    kubelet: dict = field(default_factory=dict)
+    node_class_ref: dict = field(default_factory=dict)
+    terminate_after: float | None = None
+
+
+@dataclass
+class NodeClaimStatus:
+    provider_id: str = ""
+    image_id: str = ""
+    node_name: str = ""
+    capacity: dict = field(default_factory=dict)
+    allocatable: dict = field(default_factory=dict)
+    conditions: list = field(default_factory=list)  # [Condition]
+
+
+@dataclass
+class NodeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeClaimSpec = field(default_factory=NodeClaimSpec)
+    status: NodeClaimStatus = field(default_factory=NodeClaimStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def get_condition(self, cond_type: str) -> Condition | None:
+        for c in self.status.conditions:
+            if c.type == cond_type:
+                return c
+        return None
+
+    def set_condition(self, cond_type: str, status: str = "True", reason: str = "", message: str = "", now: float | None = None):
+        existing = self.get_condition(cond_type)
+        if existing is not None:
+            if existing.status != status:
+                existing.status = status
+                existing.last_transition_time = time.time() if now is None else now
+            existing.reason = reason
+            existing.message = message
+            return existing
+        c = Condition(type=cond_type, status=status, reason=reason, message=message,
+                      last_transition_time=time.time() if now is None else now)
+        self.status.conditions.append(c)
+        return c
+
+    def clear_condition(self, cond_type: str):
+        self.status.conditions = [c for c in self.status.conditions if c.type != cond_type]
+
+    def is_true(self, cond_type: str) -> bool:
+        c = self.get_condition(cond_type)
+        return c is not None and c.status == "True"
+
+    @property
+    def launched(self) -> bool:
+        return self.is_true(COND_LAUNCHED)
+
+    @property
+    def registered(self) -> bool:
+        return self.is_true(COND_REGISTERED)
+
+    @property
+    def initialized(self) -> bool:
+        return self.is_true(COND_INITIALIZED)

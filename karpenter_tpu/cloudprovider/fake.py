@@ -1,0 +1,107 @@
+"""Test-double CloudProvider.
+
+Mirrors pkg/cloudprovider/fake/cloudprovider.go:47-158: records calls,
+supports injectable next-errors and a create budget, and synthesizes
+NodeClaims from the cheapest compatible offering.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodeclaim import NodeClaim
+from karpenter_tpu.api.objects import new_uid
+from karpenter_tpu.cloudprovider.catalog import kwok_catalog
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    instance_type_compatible,
+)
+from karpenter_tpu.scheduling import Requirements, node_selector_requirements
+
+
+class FakeCloudProvider(CloudProvider):
+    def __init__(self, instance_types=None):
+        self.instance_types = instance_types if instance_types is not None else kwok_catalog()
+        self.created: dict = {}  # provider_id -> NodeClaim
+        self.create_calls: list = []
+        self.delete_calls: list = []
+        self.next_create_err: Exception | None = None
+        self.next_delete_err: Exception | None = None
+        self.next_get_err: Exception | None = None
+        self.allowed_create_calls: int | None = None
+        self.drifted: str = ""  # reason returned by is_drifted for all claims
+        self._lock = threading.Lock()
+
+    def name(self) -> str:
+        return "fake"
+
+    def get_instance_types(self, node_pool) -> list:
+        return list(self.instance_types)
+
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        with self._lock:
+            if self.next_create_err is not None:
+                err, self.next_create_err = self.next_create_err, None
+                raise err
+            if self.allowed_create_calls is not None and len(self.create_calls) >= self.allowed_create_calls:
+                raise InsufficientCapacityError("create budget exhausted")
+            self.create_calls.append(node_claim)
+            reqs = node_selector_requirements(node_claim.spec.requirements)
+            choice = self._cheapest(reqs, node_claim.spec.resource_requests)
+            if choice is None:
+                raise InsufficientCapacityError("no compatible instance type")
+            it, offering = choice
+            claim = copy.deepcopy(node_claim)
+            claim.status.provider_id = f"fake://{new_uid('instance')}"
+            claim.status.capacity = dict(it.capacity)
+            claim.status.allocatable = dict(it.allocatable())
+            claim.metadata.labels = {
+                **node_claim.metadata.labels,
+                wk.INSTANCE_TYPE_LABEL: it.name,
+                wk.TOPOLOGY_ZONE_LABEL: offering.zone,
+                wk.CAPACITY_TYPE_LABEL: offering.capacity_type,
+                **{k: v for k, v in reqs.labels().items() if k not in (wk.INSTANCE_TYPE_LABEL,)},
+            }
+            self.created[claim.status.provider_id] = claim
+            return claim
+
+    def _cheapest(self, reqs: Requirements, requests: dict):
+        best = None
+        for it in self.instance_types:
+            if not instance_type_compatible(it, reqs, requests):
+                continue
+            for o in it.offerings.available().compatible(reqs):
+                if best is None or o.price < best[1].price:
+                    best = (it, o)
+        return best
+
+    def delete(self, node_claim: NodeClaim) -> None:
+        with self._lock:
+            if self.next_delete_err is not None:
+                err, self.next_delete_err = self.next_delete_err, None
+                raise err
+            self.delete_calls.append(node_claim)
+            if node_claim.status.provider_id not in self.created:
+                raise NodeClaimNotFoundError(node_claim.status.provider_id)
+            del self.created[node_claim.status.provider_id]
+
+    def get(self, provider_id: str) -> NodeClaim:
+        with self._lock:
+            if self.next_get_err is not None:
+                err, self.next_get_err = self.next_get_err, None
+                raise err
+            claim = self.created.get(provider_id)
+            if claim is None:
+                raise NodeClaimNotFoundError(provider_id)
+            return claim
+
+    def list(self) -> list:
+        with self._lock:
+            return list(self.created.values())
+
+    def is_drifted(self, node_claim) -> str:
+        return self.drifted

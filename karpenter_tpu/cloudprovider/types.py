@@ -1,0 +1,225 @@
+"""Cloud-provider SPI: the only seam to the outside world.
+
+Semantics from the reference's pkg/cloudprovider/types.go: the CloudProvider
+interface :46-69, InstanceType/Offerings catalog model :73-102/:214-297,
+SatisfiesMinValues :165-199, Truncate :203-212, and the typed errors
+:299-387. The catalog model doubles as the source for the device-side
+allocatable/price tensors (ops/tensorize.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.scheduling import Requirement, Requirements, IN
+from karpenter_tpu.utils import resources as resutil
+
+SPOT_REQUIREMENT = Requirements(Requirement(wk.CAPACITY_TYPE_LABEL, IN, [wk.CAPACITY_TYPE_SPOT]))
+ON_DEMAND_REQUIREMENT = Requirements(
+    Requirement(wk.CAPACITY_TYPE_LABEL, IN, [wk.CAPACITY_TYPE_ON_DEMAND])
+)
+
+
+@dataclass
+class Offering:
+    """One (zone, capacity-type) purchase option (types.go:214-225)."""
+
+    requirements: Requirements
+    price: float
+    available: bool = True
+
+    @property
+    def zone(self) -> str:
+        r = self.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL)
+        return next(iter(r.values), "") if not r.complement else ""
+
+    @property
+    def capacity_type(self) -> str:
+        r = self.requirements.get_req(wk.CAPACITY_TYPE_LABEL)
+        return next(iter(r.values), "") if not r.complement else ""
+
+
+class Offerings(list):
+    def available(self) -> "Offerings":
+        return Offerings(o for o in self if o.available)
+
+    def compatible(self, reqs: Requirements) -> "Offerings":
+        return Offerings(
+            o
+            for o in self
+            if reqs.is_compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS)
+        )
+
+    def has_compatible(self, reqs: Requirements) -> bool:
+        return any(
+            reqs.is_compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) for o in self
+        )
+
+    def cheapest(self) -> Offering:
+        return min(self, key=lambda o: o.price)
+
+    def most_expensive(self) -> Offering:
+        return max(self, key=lambda o: o.price)
+
+    def worst_launch_price(self, reqs: Requirements) -> float:
+        """Spot-aware worst-case launch price (types.go:276-297)."""
+        if reqs.get_req(wk.CAPACITY_TYPE_LABEL).has(wk.CAPACITY_TYPE_SPOT):
+            spot = self.compatible(reqs).compatible(SPOT_REQUIREMENT)
+            if spot:
+                return spot.most_expensive().price
+        if reqs.get_req(wk.CAPACITY_TYPE_LABEL).has(wk.CAPACITY_TYPE_ON_DEMAND):
+            od = self.compatible(reqs).compatible(ON_DEMAND_REQUIREMENT)
+            if od:
+                return od.most_expensive().price
+        return math.inf
+
+
+@dataclass
+class InstanceTypeOverhead:
+    kube_reserved: dict = field(default_factory=dict)
+    system_reserved: dict = field(default_factory=dict)
+    eviction_threshold: dict = field(default_factory=dict)
+
+    def total(self) -> dict:
+        return resutil.merge(self.kube_reserved, self.system_reserved, self.eviction_threshold)
+
+
+class InstanceType:
+    """Properties of a potential node (types.go:73-102)."""
+
+    def __init__(
+        self,
+        name: str,
+        requirements: Requirements,
+        offerings: Offerings,
+        capacity: dict,
+        overhead: InstanceTypeOverhead | None = None,
+    ):
+        self.name = name
+        self.requirements = requirements
+        self.offerings = offerings
+        self.capacity = capacity
+        self.overhead = overhead or InstanceTypeOverhead()
+        self._allocatable = None
+
+    def allocatable(self) -> dict:
+        if self._allocatable is None:
+            self._allocatable = resutil.subtract(self.capacity, self.overhead.total())
+        return self._allocatable
+
+    def __repr__(self):
+        return f"InstanceType({self.name})"
+
+
+def _cheapest_available_price(it: InstanceType, reqs: Requirements) -> float:
+    ofs = it.offerings.available().compatible(reqs)
+    return ofs.cheapest().price if ofs else math.inf
+
+
+def order_by_price(its, reqs: Requirements) -> list:
+    """Cheapest available+compatible offering first; name tiebreak
+    (types.go OrderByPrice:104)."""
+    return sorted(its, key=lambda it: (_cheapest_available_price(it, reqs), it.name))
+
+
+def compatible_instance_types(its, reqs: Requirements) -> list:
+    """Instance types with at least one available offering compatible with
+    reqs (types.go Compatible:124)."""
+    return [it for it in its if it.offerings.available().has_compatible(reqs)]
+
+
+def instance_type_compatible(it: InstanceType, reqs: Requirements, requests: dict | None = None) -> bool:
+    """Full per-type check used by the scheduler's filter
+    (scheduling/nodeclaim.go filterInstanceTypesByRequirements:242):
+    requirement overlap (two-way Intersects — custom labels the pod demands
+    but the type doesn't define become node labels, so they don't filter
+    here) ∧ resource fit ∧ an available compatible offering."""
+    if it.requirements.intersects(reqs) is not None:
+        return False
+    if requests is not None and not resutil.fits(requests, it.allocatable()):
+        return False
+    return it.offerings.available().has_compatible(reqs)
+
+
+def filter_instance_types(its, reqs: Requirements, requests: dict | None = None) -> list:
+    return [it for it in its if instance_type_compatible(it, reqs, requests)]
+
+
+def satisfies_min_values(its, reqs: Requirements):
+    """(min needed instance types, error) per types.go:165-199 — walks the
+    (pre-sorted) list accumulating distinct values per minValues key until
+    every floor is met."""
+    if not reqs.has_min_values():
+        return 0, None
+    values_for_key: dict = {}
+    min_keys = [r.key for r in reqs.values() if r.min_values is not None]
+    incompatible = None
+    for i, it in enumerate(its):
+        for key in min_keys:
+            values_for_key.setdefault(key, set()).update(it.requirements.get_req(key).values)
+        incompatible = next(
+            (
+                k
+                for k in min_keys
+                if len(values_for_key.get(k, ())) < (reqs.get_req(k).min_values or 0)
+            ),
+            None,
+        )
+        if incompatible is None:
+            return i + 1, None
+    return len(list(its)), f'minValues requirement is not met for "{incompatible}"'
+
+
+def truncate_instance_types(its, reqs: Requirements, max_items: int):
+    """(truncated list, error) — price-ordered prefix of max_items, rejected
+    if it breaks minValues (types.go Truncate:203)."""
+    truncated = order_by_price(its, reqs)[:max_items]
+    if reqs.has_min_values():
+        _, err = satisfies_min_values(truncated, reqs)
+        if err:
+            return list(its), f"validating minValues, {err}"
+    return truncated, None
+
+
+# ---------------------------------------------------------------------------
+# typed errors (types.go:299-387)
+
+
+class NodeClaimNotFoundError(Exception):
+    pass
+
+
+class InsufficientCapacityError(Exception):
+    pass
+
+
+class NodeClassNotReadyError(Exception):
+    pass
+
+
+class CloudProvider:
+    """The SPI every provider implements (types.go:46-69)."""
+
+    def create(self, node_claim):  # -> NodeClaim (with status filled)
+        raise NotImplementedError
+
+    def delete(self, node_claim) -> None:
+        raise NotImplementedError
+
+    def get(self, provider_id: str):  # -> NodeClaim
+        raise NotImplementedError
+
+    def list(self) -> list:  # -> [NodeClaim]
+        raise NotImplementedError
+
+    def get_instance_types(self, node_pool) -> list:  # -> [InstanceType]
+        raise NotImplementedError
+
+    def is_drifted(self, node_claim) -> str:
+        """Returns a drift reason or '' (types.go IsDrifted)."""
+        return ""
+
+    def name(self) -> str:
+        raise NotImplementedError

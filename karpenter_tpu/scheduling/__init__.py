@@ -1,0 +1,18 @@
+from karpenter_tpu.scheduling.requirements import (  # noqa: F401
+    IN,
+    NOT_IN,
+    EXISTS,
+    DOES_NOT_EXIST,
+    GT,
+    LT,
+    Requirement,
+    Requirements,
+    pod_requirements,
+    strict_pod_requirements,
+    label_requirements,
+    node_selector_requirements,
+    has_preferred_node_affinity,
+)
+from karpenter_tpu.scheduling.taints import Taints, KNOWN_EPHEMERAL_TAINTS  # noqa: F401
+from karpenter_tpu.scheduling.hostports import HostPortUsage  # noqa: F401
+from karpenter_tpu.scheduling.volumes import VolumeUsage  # noqa: F401

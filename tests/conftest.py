@@ -1,0 +1,15 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding
+(parallel/) is exercised without TPU hardware, mirroring how the reference
+tests multi-node without a real cluster (SURVEY.md §4: envtest + kwok).
+Must run before jax initializes any backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,183 @@
+"""Data-model unit tests: quantities, resources, taints, cron budgets,
+instance-type catalog ops (ordering, minValues, truncation)."""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import Budget, NodePool
+from karpenter_tpu.api.objects import Pod, Taint, Toleration
+from karpenter_tpu.cloudprovider.catalog import benchmark_catalog, kwok_catalog, make_instance_type
+from karpenter_tpu.cloudprovider.types import (
+    compatible_instance_types,
+    order_by_price,
+    satisfies_min_values,
+    truncate_instance_types,
+)
+from karpenter_tpu.scheduling import IN, Requirement, Requirements, Taints
+from karpenter_tpu.utils import resources as resutil
+from karpenter_tpu.utils.cron import CronSchedule
+from karpenter_tpu.utils.quantity import parse_quantity
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("100m", 0.1),
+            ("1", 1.0),
+            ("1.5", 1.5),
+            ("1Gi", 2**30),
+            ("512Mi", 512 * 2**20),
+            ("2k", 2000.0),
+            ("1G", 1e9),
+            (4, 4.0),
+        ],
+    )
+    def test_parse(self, s, expected):
+        assert parse_quantity(s) == expected
+
+
+class TestResources:
+    def test_fits(self):
+        assert resutil.fits({"cpu": 1}, {"cpu": 2, "memory": 1})
+        assert not resutil.fits({"cpu": 3}, {"cpu": 2})
+        assert not resutil.fits({"gpu": 1}, {"cpu": 2})  # absent = zero
+
+    def test_merge_subtract(self):
+        assert resutil.merge({"cpu": 1}, {"cpu": 2, "m": 1}) == {"cpu": 3, "m": 1}
+        assert resutil.subtract({"cpu": 3}, {"cpu": 1}) == {"cpu": 2}
+
+    def test_pod_requests_init_containers(self):
+        pod = Pod(
+            containers=[{"requests": {"cpu": 1}}, {"requests": {"cpu": 1}}],
+            init_containers=[{"requests": {"cpu": 3}}],
+        )
+        req = pod.effective_requests()
+        assert req["cpu"] == 3  # max(init) > sum(containers)
+        assert req["pods"] == 1
+
+
+class TestTaints:
+    def test_tolerates(self):
+        taints = Taints([Taint(key="team", value="a", effect="NoSchedule")])
+        assert taints.tolerates(Pod()) is not None
+        assert taints.tolerates(Pod(tolerations=[Toleration(key="team", value="a")])) is None
+        assert taints.tolerates(Pod(tolerations=[Toleration(operator="Exists")])) is None
+        assert taints.tolerates(Pod(tolerations=[Toleration(key="team", operator="Exists")])) is None
+        assert taints.tolerates(Pod(tolerations=[Toleration(key="team", value="b")])) is not None
+
+    def test_effect_scoping(self):
+        taints = Taints([Taint(key="k", value="v", effect="NoExecute")])
+        assert taints.tolerates(Pod(tolerations=[Toleration(key="k", value="v", effect="NoSchedule")])) is not None
+        assert taints.tolerates(Pod(tolerations=[Toleration(key="k", value="v", effect="NoExecute")])) is None
+
+    def test_merge(self):
+        a = Taints([Taint(key="a", effect="NoSchedule")])
+        merged = a.merge([Taint(key="a", value="x", effect="NoSchedule"), Taint(key="b", effect="NoExecute")])
+        assert len(merged) == 2  # (a, NoSchedule) kept from self
+
+
+class TestBudgets:
+    def test_always_active_percent(self):
+        b = Budget(nodes="10%")
+        assert b.allowed(100) == 10
+        assert b.allowed(5) == 0
+
+    def test_absolute(self):
+        assert Budget(nodes="3").allowed(100) == 3
+
+    def test_schedule_window(self):
+        # active 09:00-10:00 UTC daily
+        b = Budget(nodes="0", schedule="0 9 * * *", duration=3600)
+        nine_thirty = 9.5 * 3600  # 1970-01-01T09:30Z
+        eleven = 11 * 3600
+        assert b.is_active(nine_thirty)
+        assert not b.is_active(eleven)
+        # outside the window the budget imposes no cap
+        assert b.allowed(50, eleven) == 50
+        assert b.allowed(50, nine_thirty) == 0
+
+    def test_nodepool_allowed_disruptions(self):
+        np = NodePool()
+        np.spec.disruption.budgets = [
+            Budget(nodes="20%"),
+            Budget(nodes="5", reasons=["Drifted"]),
+        ]
+        assert np.allowed_disruptions("Underutilized", 100) == 20
+        assert np.allowed_disruptions("Drifted", 100) == 5
+
+
+class TestCron:
+    def test_prev_next(self):
+        s = CronSchedule("0 9 * * *")
+        t = 9.5 * 3600
+        assert s.prev(t) == 9 * 3600
+        assert s.next(t) == 24 * 3600 + 9 * 3600
+
+    def test_step(self):
+        s = CronSchedule("*/15 * * * *")
+        assert s.prev(16 * 60) == 15 * 60
+
+
+class TestCatalog:
+    def test_kwok_catalog_size(self):
+        cat = kwok_catalog()
+        assert len(cat) == 4 * 8 * 2  # families x cpus x archs
+
+    def test_allocatable_below_capacity(self):
+        it = kwok_catalog()[0]
+        assert it.allocatable()["cpu"] < it.capacity["cpu"]
+
+    def test_order_by_price(self):
+        cat = benchmark_catalog(50)
+        ordered = order_by_price(cat, Requirements())
+        prices = [it.offerings.available().cheapest().price for it in ordered]
+        assert prices == sorted(prices)
+
+    def test_compatible_filters_zone(self):
+        cat = [
+            make_instance_type("a", 2, 4, zones=("zone-1",)),
+            make_instance_type("b", 2, 4, zones=("zone-2",)),
+        ]
+        reqs = Requirements(Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, ["zone-2"]))
+        assert [it.name for it in compatible_instance_types(cat, reqs)] == ["b"]
+
+    def test_min_values(self):
+        fams = ["c", "c", "m", "s"]
+        cat = [
+            make_instance_type(f"it-{i}", 2, 4, family=fams[i], price_override=1.0 + i)
+            for i in range(4)
+        ]
+        from karpenter_tpu.cloudprovider.catalog import INSTANCE_FAMILY_LABEL
+
+        reqs = Requirements(
+            Requirement(INSTANCE_FAMILY_LABEL, IN, ["c", "m", "s"], min_values=3)
+        )
+        n, err = satisfies_min_values(cat, reqs)
+        assert err is None and n == 4  # needs all four to see 3 families
+
+        n, err = satisfies_min_values(cat[:2], reqs)
+        assert err is not None
+
+    def test_truncate_respects_min_values(self):
+        from karpenter_tpu.cloudprovider.catalog import INSTANCE_FAMILY_LABEL
+
+        fams = ["c", "c", "m", "s"]
+        cat = [
+            make_instance_type(f"it-{i}", 2, 4, family=fams[i], price_override=1.0 + i)
+            for i in range(4)
+        ]
+        reqs = Requirements(
+            Requirement(INSTANCE_FAMILY_LABEL, IN, ["c", "m", "s"], min_values=3)
+        )
+        _, err = truncate_instance_types(cat, reqs, 2)
+        assert err is not None
+        out, err = truncate_instance_types(cat, reqs, 4)
+        assert err is None and len(out) == 4
+
+    def test_restricted_labels(self):
+        assert wk.is_restricted_node_label("karpenter.sh/custom")
+        assert not wk.is_restricted_node_label(wk.TOPOLOGY_ZONE_LABEL)
+        assert not wk.is_restricted_node_label("example.com/team")
+        assert wk.is_restricted_node_label(wk.HOSTNAME_LABEL)
+        assert not wk.is_restricted_node_label("node-restriction.kubernetes.io/x")

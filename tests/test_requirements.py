@@ -1,0 +1,210 @@
+"""Constraint-algebra unit tests.
+
+Scenario coverage modeled on the reference's pkg/scheduling/requirement_test.go
+and requirements_test.go (operator matrix for intersection/compatibility,
+complement handling, Gt/Lt bounds, minValues propagation).
+"""
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PreferredSchedulingTerm,
+)
+from karpenter_tpu.scheduling import (
+    DOES_NOT_EXIST,
+    EXISTS,
+    GT,
+    IN,
+    LT,
+    NOT_IN,
+    Requirement,
+    Requirements,
+    pod_requirements,
+    strict_pod_requirements,
+)
+
+
+def R(key, op, *values, min_values=None):
+    return Requirement(key, op, values, min_values=min_values)
+
+
+class TestRequirement:
+    def test_operators(self):
+        assert R("k", IN, "a", "b").operator == IN
+        assert R("k", NOT_IN, "a").operator == NOT_IN
+        assert R("k", EXISTS).operator == EXISTS
+        assert R("k", DOES_NOT_EXIST).operator == DOES_NOT_EXIST
+        assert R("k", GT, "5").operator == EXISTS  # bounds report as Exists
+        assert R("k", IN).operator == DOES_NOT_EXIST  # empty In collapses
+
+    def test_has(self):
+        assert R("k", IN, "a", "b").has("a")
+        assert not R("k", IN, "a").has("c")
+        assert R("k", NOT_IN, "a").has("c")
+        assert not R("k", NOT_IN, "a").has("a")
+        assert R("k", EXISTS).has("anything")
+        assert not R("k", DOES_NOT_EXIST).has("anything")
+        assert R("k", GT, "5").has("6")
+        assert not R("k", GT, "5").has("5")
+        assert R("k", LT, "5").has("4")
+        assert not R("k", LT, "5").has("5")
+        assert not R("k", GT, "5").has("not-a-number")
+
+    def test_intersection_in_in(self):
+        r = R("k", IN, "a", "b").intersection(R("k", IN, "b", "c"))
+        assert r.values == {"b"} and not r.complement
+
+    def test_intersection_in_notin(self):
+        r = R("k", IN, "a", "b").intersection(R("k", NOT_IN, "a"))
+        assert r.values == {"b"} and not r.complement
+
+    def test_intersection_notin_notin(self):
+        r = R("k", NOT_IN, "a").intersection(R("k", NOT_IN, "b"))
+        assert r.complement and r.values == {"a", "b"}
+
+    def test_intersection_exists(self):
+        r = R("k", EXISTS).intersection(R("k", IN, "a"))
+        assert not r.complement and r.values == {"a"}
+
+    def test_intersection_doesnotexist(self):
+        r = R("k", IN, "a").intersection(R("k", DOES_NOT_EXIST))
+        assert len(r) == 0
+
+    def test_intersection_bounds(self):
+        r = R("k", GT, "1").intersection(R("k", LT, "5"))
+        assert r.complement and r.greater_than == 1 and r.less_than == 5
+        assert r.has("3") and not r.has("1") and not r.has("5")
+
+    def test_intersection_bounds_collapse(self):
+        # Gt 5 ∩ Lt 5 → empty (DoesNotExist)
+        r = R("k", GT, "5").intersection(R("k", LT, "5"))
+        assert len(r) == 0
+
+    def test_intersection_bounds_filter_concrete(self):
+        r = R("k", IN, "1", "3", "9").intersection(R("k", GT, "2"))
+        assert r.values == {"3", "9"} and not r.complement
+        # bounds dropped for concrete sets
+        assert r.greater_than is None
+
+    def test_min_values_propagates(self):
+        r = R("k", IN, "a", "b", min_values=2).intersection(R("k", IN, "a", "b", "c"))
+        assert r.min_values == 2
+
+    def test_len(self):
+        assert len(R("k", IN, "a", "b")) == 2
+        assert len(R("k", DOES_NOT_EXIST)) == 0
+        assert len(R("k", EXISTS)) > 10**9
+
+    def test_normalized_label(self):
+        assert R("beta.kubernetes.io/arch", IN, "amd64").key == wk.ARCH_LABEL
+
+
+class TestRequirements:
+    def test_add_intersects_same_key(self):
+        reqs = Requirements(R("k", IN, "a", "b"))
+        reqs.add(R("k", IN, "b", "c"))
+        assert reqs.get_req("k").values == {"b"}
+
+    def test_get_undefined_is_exists(self):
+        assert Requirements().get_req("zzz").operator == EXISTS
+
+    def test_intersects_overlap(self):
+        a = Requirements(R("k", IN, "a", "b"))
+        b = Requirements(R("k", IN, "b"))
+        assert a.intersects(b) is None
+
+    def test_intersects_disjoint(self):
+        a = Requirements(R("k", IN, "a"))
+        b = Requirements(R("k", IN, "b"))
+        assert a.intersects(b) is not None
+
+    def test_intersects_both_notin_empty_ok(self):
+        a = Requirements(R("k", DOES_NOT_EXIST))
+        b = Requirements(R("k", NOT_IN, "a"))
+        # empty intersection tolerated because both sides are NotIn/DoesNotExist
+        assert a.intersects(b) is None
+
+    def test_compatible_undefined_custom_label_denied(self):
+        node = Requirements(R(wk.ARCH_LABEL, IN, "amd64"))
+        pod = Requirements(R("custom-label", IN, "x"))
+        assert node.compatible(pod) is not None
+
+    def test_compatible_undefined_wellknown_allowed(self):
+        node = Requirements()
+        pod = Requirements(R(wk.TOPOLOGY_ZONE_LABEL, IN, "zone-1"))
+        assert node.compatible(pod, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+
+    def test_compatible_undefined_notin_allowed(self):
+        node = Requirements()
+        pod = Requirements(R("custom-label", NOT_IN, "x"))
+        assert node.compatible(pod) is None
+
+    def test_compatible_value_mismatch(self):
+        node = Requirements(R(wk.ARCH_LABEL, IN, "amd64"))
+        pod = Requirements(R(wk.ARCH_LABEL, IN, "arm64"))
+        assert node.compatible(pod, allow_undefined=wk.WELL_KNOWN_LABELS) is not None
+
+    def test_labels(self):
+        reqs = Requirements(R("a", IN, "v"), R(wk.HOSTNAME_LABEL, IN, "h"))
+        labels = reqs.labels()
+        assert labels["a"] == "v"
+        assert wk.HOSTNAME_LABEL not in labels  # restricted
+
+    def test_has_min_values(self):
+        assert not Requirements(R("k", IN, "a")).has_min_values()
+        assert Requirements(R("k", IN, "a", min_values=1)).has_min_values()
+
+
+class TestPodRequirements:
+    def _pod(self):
+        return Pod(
+            node_selector={"disk": "ssd"},
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    required=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, IN, ["zone-1", "zone-2"])
+                            ]
+                        ),
+                        NodeSelectorTerm(  # alternative OR term: ignored (first term wins)
+                            match_expressions=[
+                                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, IN, ["zone-3"])
+                            ]
+                        ),
+                    ],
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                match_expressions=[NodeSelectorRequirement("light", IN, ["1"])]
+                            ),
+                        ),
+                        PreferredSchedulingTerm(
+                            weight=10,
+                            preference=NodeSelectorTerm(
+                                match_expressions=[NodeSelectorRequirement("heavy", IN, ["1"])]
+                            ),
+                        ),
+                    ],
+                )
+            ),
+        )
+
+    def test_node_selector_and_first_required_term(self):
+        reqs = pod_requirements(self._pod())
+        assert reqs.get_req("disk").values == {"ssd"}
+        assert reqs.get_req(wk.TOPOLOGY_ZONE_LABEL).values == {"zone-1", "zone-2"}
+
+    def test_heaviest_preference_included(self):
+        reqs = pod_requirements(self._pod())
+        assert "heavy" in reqs and "light" not in reqs
+
+    def test_strict_excludes_preferences(self):
+        reqs = strict_pod_requirements(self._pod())
+        assert "heavy" not in reqs and "light" not in reqs
+        assert "disk" in reqs
