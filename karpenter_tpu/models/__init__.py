@@ -1,0 +1,4 @@
+from karpenter_tpu.models.inflight import ClaimTemplate, InFlightNodeClaim  # noqa: F401
+from karpenter_tpu.models.queue import SchedulingQueue  # noqa: F401
+from karpenter_tpu.models.scheduler import Scheduler, SchedulerResults  # noqa: F401
+from karpenter_tpu.models.solver import HostSolver, Solver, TPUSolver, make_solver  # noqa: F401

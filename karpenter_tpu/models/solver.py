@@ -1,0 +1,310 @@
+"""Solver: the pluggable device/host boundary.
+
+The reference has a single in-process Go loop; our build exposes a `Solver`
+seam (the analog of the metrics-decorator precedent around CloudProvider,
+SURVEY.md §2.3): `TPUSolver` compiles the snapshot to tensors and runs the
+batched feasibility+pack kernels on the accelerator, then decodes bins back
+into in-flight NodeClaims and validates them host-side; anything the device
+path can't express (pod affinity, topology waves before M2, validation
+failures, leftovers) flows through `HostSolver` — the faithful FFD loop —
+seeded with the device-produced claims. Shapes are bucketed so XLA compiles
+once per bucket.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from karpenter_tpu.models.inflight import InFlightNodeClaim
+from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
+from karpenter_tpu.ops import tensorize
+from karpenter_tpu.ops.tensorize import device_eligible
+
+
+class Solver:
+    def solve(self, pods, templates, instance_types, **kw) -> SchedulerResults:
+        raise NotImplementedError
+
+
+class HostSolver(Solver):
+    """The reference algorithm (FFD loop) on the host. Fallback + oracle."""
+
+    def solve(
+        self,
+        pods,
+        templates,
+        instance_types,
+        topology=None,
+        existing_nodes=(),
+        daemon_overhead=None,
+        limits=None,
+        initial_claims=(),
+    ) -> SchedulerResults:
+        sched = Scheduler(
+            templates,
+            instance_types,
+            topology=topology,
+            existing_nodes=existing_nodes,
+            daemon_overhead=daemon_overhead,
+            remaining_resources=limits,
+        )
+        sched.new_claims = list(initial_claims)
+        return sched.solve(pods)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    return max(lo, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+class TPUSolver(Solver):
+    def __init__(self):
+        self._compiled = {}
+        self.host = HostSolver()
+        self.last_device_stats: dict = {}
+
+    def _kernel(self, key):
+        if key not in self._compiled:
+            import jax
+
+            from karpenter_tpu.ops import kernels
+
+            max_bins = key[-1]
+
+            def run(args):
+                F, price, tmpl_full = kernels.feasibility(
+                    args["g_mask"],
+                    args["g_has"],
+                    args["g_demand"],
+                    args["t_mask"],
+                    args["t_has"],
+                    args["t_alloc"],
+                    args["g_zone_allowed"],
+                    args["g_ct_allowed"],
+                    args["off_zone"],
+                    args["off_ct"],
+                    args["off_avail"],
+                    args["off_price"],
+                    args["g_tmpl_ok"],
+                    args["m_mask"],
+                    args["m_has"],
+                )
+                out = kernels.pack(
+                    args["g_demand"],
+                    args["g_count"],
+                    args["g_mask"],
+                    args["g_has"],
+                    F,
+                    tmpl_full,
+                    args["t_alloc"],
+                    args["t_cap"],
+                    args["t_tmpl"],
+                    args["m_mask"],
+                    args["m_has"],
+                    args["m_overhead"],
+                    args["m_limits"],
+                    max_bins=max_bins,
+                )
+                out["F"] = F
+                return out
+
+            self._compiled[key] = jax.jit(run)
+        return self._compiled[key]
+
+    def solve(
+        self,
+        pods,
+        templates,
+        instance_types,
+        topology=None,
+        existing_nodes=(),
+        daemon_overhead=None,
+        limits=None,
+        max_bins: int | None = None,
+    ) -> SchedulerResults:
+        # Existing-node scheduling and topology join the device path in
+        # M4/M2; until then those snapshots route through the host loop.
+        has_topology = topology is not None and not isinstance(topology, NullTopology)
+        if existing_nodes or has_topology or not templates:
+            return self.host.solve(
+                pods,
+                templates,
+                instance_types,
+                topology=topology,
+                existing_nodes=existing_nodes,
+                daemon_overhead=daemon_overhead,
+                limits=limits,
+            )
+
+        # weight order decides which template a new bin opens from
+        # (scheduler.go:267 tries templates in weight order)
+        templates = sorted(templates, key=lambda t: (-t.weight, t.nodepool_name))
+
+        eligible = [p for p in pods if device_eligible(p)]
+        rest = [p for p in pods if not device_eligible(p)]
+        if not eligible:
+            return self.host.solve(
+                pods,
+                templates,
+                instance_types,
+                daemon_overhead=daemon_overhead,
+                limits=limits,
+            )
+
+        snap = tensorize(
+            eligible, templates, instance_types, daemon_overhead=daemon_overhead, limits=limits
+        )
+        claims, retry = self._run_and_decode(snap, max_bins)
+        self.last_device_stats = dict(
+            groups=snap.G,
+            types=snap.T,
+            device_pods=len(eligible) - len(retry),
+            retry_pods=len(retry),
+            host_pods=len(rest),
+        )
+        # debit nodepool limits for the device-built claims so the host pass
+        # can't double-spend them (scheduler.go:292 subtractMax)
+        if limits:
+            from karpenter_tpu.models.scheduler import subtract_max
+
+            limits = {k: dict(v) for k, v in limits.items()}
+            for claim in claims:
+                pool = claim.template.nodepool_name
+                if pool in limits:
+                    limits[pool] = subtract_max(limits[pool], claim.instance_types)
+        # leftovers + ineligible pods run through the host loop seeded with
+        # the device-built claims (they can still land on those bins)
+        if rest or retry:
+            return self.host.solve(
+                rest + retry,
+                templates,
+                instance_types,
+                daemon_overhead=daemon_overhead,
+                limits=limits,
+                initial_claims=claims,
+            )
+        for claim in claims:
+            claim.finalize()
+        return SchedulerResults(new_claims=claims, existing_nodes=[], pod_errors={})
+
+    def _run_and_decode(self, snap, max_bins):
+        G, T = snap.G, snap.T
+        K, W = snap.g_mask.shape[1], snap.W
+        R = len(snap.resources)
+        M = len(snap.templates)
+        total_pods = int(snap.g_count.sum())
+        B = max_bins or min(max(total_pods, 1), 4096)
+        Gp, Tp, Bp = _bucket(G), _bucket(T), _bucket(B)
+
+        def pad(a, shape):
+            out = np.zeros(shape, dtype=a.dtype)
+            out[tuple(slice(0, s) for s in a.shape)] = a
+            return out
+
+        args = dict(
+            g_mask=pad(snap.g_mask, (Gp, K, W)),
+            g_has=pad(snap.g_has, (Gp, K)),
+            g_demand=pad(snap.g_demand, (Gp, R)),
+            g_count=pad(snap.g_count, (Gp,)),
+            g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
+            g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
+            g_tmpl_ok=pad(snap.g_tmpl_ok, (Gp, M)),
+            t_mask=pad(snap.t_mask, (Tp, K, W)),
+            t_has=pad(snap.t_has, (Tp, K)),
+            t_alloc=pad(snap.t_alloc, (Tp, R)),
+            t_cap=pad(snap.t_cap, (Tp, R)),
+            t_tmpl=pad(snap.t_tmpl, (Tp,)),
+            off_zone=np.full((Tp, snap.off_zone.shape[1]), -1, dtype=np.int32),
+            off_ct=np.full((Tp, snap.off_ct.shape[1]), -1, dtype=np.int32),
+            off_avail=pad(snap.off_avail, (Tp, snap.off_avail.shape[1])),
+            off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
+            m_mask=snap.m_mask,
+            m_has=snap.m_has,
+            m_overhead=snap.m_overhead,
+            m_limits=snap.m_limits,
+        )
+        args["off_zone"][:T] = snap.off_zone
+        args["off_ct"][:T] = snap.off_ct
+        # padded types must be infeasible: zero alloc fails fits (pods>=1)
+
+        key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], Bp)
+        out = self._kernel(key)(args)
+        assign = np.asarray(out["assign"])[:G, :Bp]
+        used = np.asarray(out["used"])
+        types = np.asarray(out["types"])[:, :T]
+        tmpl = np.asarray(out["tmpl"])
+
+        return self._decode(snap, assign, used, types, tmpl)
+
+    def _decode(self, snap, assign, used, types, tmpl):
+        """Bins → InFlightNodeClaims, with host-side validation of each
+        claim's joint instance-type set (the kernel approximates joint
+        offering feasibility by intersecting per-group feasibility)."""
+        from karpenter_tpu.cloudprovider.types import filter_instance_types, satisfies_min_values
+
+        cursors = [0] * snap.G
+        claims = []
+        retry = []
+        topology = NullTopology()
+        for b in range(assign.shape[1]):
+            if not used[b] or assign[:, b].sum() == 0:
+                continue
+            m = int(tmpl[b])
+            template = snap.templates[m]
+            bin_pods = []
+            bin_reqs = template.requirements.copy()
+            for g in range(snap.G):
+                c = int(assign[g, b])
+                if c == 0:
+                    continue
+                bin_pods.extend(snap.groups[g][cursors[g] : cursors[g] + c])
+                cursors[g] += c
+                bin_reqs.add(*snap.group_reqs[g].values())
+            its = [snap.type_refs[t][1] for t in range(snap.T) if types[b, t] and snap.type_refs[t][0] == m]
+            claim = InFlightNodeClaim(
+                template,
+                topology,
+                dict(zip(snap.resources, snap.m_overhead[m].tolist())),
+                its,
+            )
+            claim.pods = bin_pods
+            claim.requests = {
+                r: float(v)
+                for r, v in zip(
+                    snap.resources,
+                    snap.m_overhead[m]
+                    + sum(
+                        snap.g_demand[g] * assign[g, b] for g in range(snap.G)
+                    ),
+                )
+                if v > 0
+            }
+            claim.requirements.add(*bin_reqs.values())
+            # host-side joint validation
+            remaining = filter_instance_types(claim.instance_types, claim.requirements, claim.requests)
+            if remaining and claim.requirements.has_min_values():
+                _, err = satisfies_min_values(remaining, claim.requirements)
+                if err:
+                    remaining = []
+            if not remaining:
+                retry.extend(bin_pods)
+                continue
+            claim.instance_types = remaining
+            claims.append(claim)
+        # pods the kernel couldn't place (unsched counts are implied by the
+        # unconsumed remainder of each group)
+        for g in range(snap.G):
+            retry.extend(snap.groups[g][cursors[g] :])
+        return claims, retry
+
+
+def make_solver(prefer_device: bool = True) -> Solver:
+    if not prefer_device:
+        return HostSolver()
+    try:
+        import jax  # noqa: F401
+
+        return TPUSolver()
+    except Exception:  # pragma: no cover - jax is baked into this image
+        return HostSolver()

@@ -1,0 +1,1 @@
+from karpenter_tpu.ops.tensorize import DeviceSnapshot, tensorize  # noqa: F401

@@ -1,0 +1,279 @@
+"""Device kernels: batched feasibility + grouped bin-packing.
+
+TPU-native reformulation of the reference's two hot loops (SURVEY.md §2.5):
+
+- `feasibility`: the per-pod, per-instance-type constraint checks of
+  scheduler.go's inner loop (requirement intersection nodeclaim.go:242,
+  resource fit, offering availability) become one batched tensor expression
+  over [G groups x T types] with requirements as packed uint32 bitmasks.
+
+- `pack`: the FFD loop (scheduler.go:195-296) becomes a lax.scan over pod
+  GROUPS. The reference tries open claims emptiest-first and a claim keeps
+  every instance type still feasible for its accumulated pods (capacity =
+  max over remaining types). We replicate that with a level-fill: a binary
+  search finds the pod-count water level L such that filling every
+  compatible bin up to L absorbs the group, which is exactly where the
+  reference's ascending-pod-count ordering converges, without the per-pod
+  serialization.
+
+All shapes are static (pad groups with count 0, types with alloc 0); the
+solver buckets shapes and caches compiled executables.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-6
+_LEVEL_SEARCH_ITERS = 24  # supports levels up to ~16M pods per bin
+
+
+def feasibility(
+    g_mask,  # [G,K,W] u32
+    g_has,  # [G,K] bool
+    g_demand,  # [G,R] f32
+    t_mask,  # [T,K,W] u32
+    t_has,  # [T,K] bool
+    t_alloc,  # [T,R] f32
+    g_zone_allowed,  # [G,Vz] bool
+    g_ct_allowed,  # [G,Vc] bool
+    off_zone,  # [T,O] i32
+    off_ct,  # [T,O] i32
+    off_avail,  # [T,O] bool
+    off_price,  # [T,O] f32
+    g_tmpl_ok,  # [G,M] bool (taints + custom-label definedness)
+    m_mask,  # [M,K,W] u32
+    m_has,  # [M,K] bool
+):
+    """Returns (F [G,T] bool, price [G,T] f32, tmpl_full [G,M] bool)."""
+    G, K, W = g_mask.shape
+    T = t_mask.shape[0]
+
+    # requirement overlap, key by key (K is small; the python loop unrolls
+    # into fused vector ops — no [G,T,K,W] intermediate is materialized)
+    compat = jnp.ones((G, T), dtype=bool)
+    for k in range(K):
+        ov = jnp.zeros((G, T), dtype=bool)
+        for w in range(W):
+            ov = ov | ((g_mask[:, None, k, w] & t_mask[None, :, k, w]) != 0)
+        both = g_has[:, None, k] & t_has[None, :, k]
+        compat = compat & (~both | ov)
+
+    # resource fit: every demanded resource within allocatable
+    fits = jnp.all(g_demand[:, None, :] <= t_alloc[None, :, :] + _EPS, axis=-1)
+
+    # offerings: available ∧ zone allowed ∧ capacity-type allowed
+    zo = jnp.where(
+        off_zone[None, :, :] >= 0, g_zone_allowed[:, jnp.maximum(off_zone, 0)], True
+    )  # [G,T,O]
+    co = jnp.where(off_ct[None, :, :] >= 0, g_ct_allowed[:, jnp.maximum(off_ct, 0)], True)
+    off_ok = off_avail[None, :, :] & zo & co  # [G,T,O]
+    has_off = jnp.any(off_ok, axis=-1)
+    price = jnp.min(jnp.where(off_ok, off_price[None, :, :], jnp.inf), axis=-1)
+
+    F = compat & fits & has_off
+
+    # template-level requirement overlap for new-bin placement
+    M = m_mask.shape[0]
+    tm_ov = jnp.ones((G, M), dtype=bool)
+    for k in range(K):
+        ov = jnp.zeros((G, M), dtype=bool)
+        for w in range(W):
+            ov = ov | ((g_mask[:, None, k, w] & m_mask[None, :, k, w]) != 0)
+        both = g_has[:, None, k] & m_has[None, :, k]
+        tm_ov = tm_ov & (~both | ov)
+    tmpl_full = g_tmpl_ok & tm_ov
+
+    return F, price, tmpl_full
+
+
+def _combine_masks(a_mask, a_has, b_mask, b_has):
+    """Requirement-set union with per-key intersection of allowed values.
+    a:[...,K,W]/[...,K]; b broadcastable to a."""
+    both = a_has & b_has
+    out_mask = jnp.where(
+        both[..., None], a_mask & b_mask, jnp.where(b_has[..., None], b_mask, a_mask)
+    )
+    return out_mask, a_has | b_has
+
+
+def _level_fill(q, npods, n):
+    """Distribute n pods across bins filling emptiest-first up to per-bin
+    caps q — the batched equivalent of the reference's ascending-pod-count
+    claim ordering (scheduler.go:258). Returns per-bin take."""
+    total_cap = jnp.sum(q)
+    n_eff = jnp.minimum(n, total_cap)
+
+    def fill(level):
+        return jnp.sum(jnp.minimum(q, jnp.maximum(level - npods, 0)))
+
+    lo = jnp.int32(0)
+    hi = jnp.int32(1) << _LEVEL_SEARCH_ITERS
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        enough = fill(mid) >= n_eff
+        return jnp.where(enough, lo, mid), jnp.where(enough, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(0, _LEVEL_SEARCH_ITERS, body, (lo, hi))
+    level = hi
+    take = jnp.minimum(q, jnp.maximum(level - npods, 0))
+    # overshoot: bins whose take reaches the final level can each give back 1
+    excess = jnp.sum(take) - n_eff
+    cand = (take > 0) & (npods + take == level)
+    give_back = cand & (jnp.cumsum(cand.astype(jnp.int32)) <= excess)
+    return take - give_back.astype(jnp.int32)
+
+
+def pack(
+    # per-group (scan xs), already in FFD order
+    g_demand,  # [G,R]
+    g_count,  # [G]
+    g_mask,  # [G,K,W]
+    g_has,  # [G,K]
+    F,  # [G,T] feasibility
+    tmpl_full,  # [G,M]
+    # static catalog
+    t_alloc,  # [T,R]
+    t_cap,  # [T,R]
+    t_tmpl,  # [T]
+    m_mask,  # [M,K,W]
+    m_has,  # [M,K]
+    m_overhead,  # [M,R]
+    m_limits,  # [M,R]
+    *,
+    max_bins: int,
+):
+    """Grouped greedy pack. Returns dict with:
+    assign [G,B] i32, used [B] bool, npods [B] i32, types [B,T] bool,
+    tmpl [B] i32. Pods a group couldn't place are implied by
+    count - sum(assign[g]) and re-routed by the decoder.
+    """
+    G, R = g_demand.shape
+    T = t_alloc.shape[0]
+    M = m_overhead.shape[0]
+    B = max_bins
+    t_is_m = t_tmpl[:, None] == jnp.arange(M)[None, :]  # [T,M]
+
+    state = dict(
+        used=jnp.zeros(B, dtype=bool),
+        npods=jnp.zeros(B, dtype=jnp.int32),
+        load=jnp.zeros((B, R), dtype=jnp.float32),
+        types=jnp.zeros((B, T), dtype=bool),
+        bmask=jnp.zeros((B,) + g_mask.shape[1:], dtype=jnp.uint32),
+        bhas=jnp.zeros((B,) + g_has.shape[1:], dtype=bool),
+        btmpl=jnp.zeros(B, dtype=jnp.int32),
+        rem=m_limits.astype(jnp.float32),
+    )
+
+    def step(state, xs):
+        d, n, gm, gh, Fg, tfull = xs
+        has_pods = n > 0
+
+        # ---- existing bins: compatibility ----
+        both = state["bhas"] & gh[None, :]
+        ov = jnp.any((state["bmask"] & gm[None, :, :]) != 0, axis=-1)
+        compat_b = jnp.all(~both | ov, axis=-1)
+        compat_b = compat_b & state["used"] & jnp.take(tfull, state["btmpl"])
+
+        # ---- per-bin capacity for this group (max over remaining types) ----
+        avail = t_alloc[None, :, :] - state["load"][:, None, :]  # [B,T,R]
+        ratio = jnp.where(d[None, None, :] > 0, avail / jnp.maximum(d[None, None, :], _EPS), jnp.inf)
+        cap_bt = jnp.floor(jnp.min(ratio, axis=-1) + _EPS).astype(jnp.int32)  # [B,T]
+        cap_bt = jnp.where(state["types"] & Fg[None, :], jnp.maximum(cap_bt, 0), 0)
+        q = jnp.max(cap_bt, axis=-1)  # [B]
+        q = jnp.where(compat_b, q, 0)
+
+        take = _level_fill(q, state["npods"], n)
+        take = jnp.where(has_pods, take, 0)
+        assigned = jnp.sum(take)
+        spill = n - assigned
+
+        # ---- new bins from the best template ----
+        fresh_avail = t_alloc - m_overhead[t_tmpl]  # [T,R]
+        fr = jnp.where(d[None, :] > 0, fresh_avail / jnp.maximum(d[None, :], _EPS), jnp.inf)
+        fresh_cap = jnp.floor(jnp.min(fr, axis=-1) + _EPS).astype(jnp.int32)  # [T]
+        limit_ok = jnp.all(t_cap <= state["rem"][t_tmpl] + _EPS, axis=-1)  # [T]
+        new_ok = Fg & limit_ok & jnp.take(tfull, t_tmpl) & (fresh_cap > 0)  # [T]
+        per_node_m = jnp.max(
+            jnp.where(new_ok[:, None] & t_is_m, fresh_cap[:, None], 0), axis=0
+        )  # [M]
+        feasible_m = per_node_m > 0
+        # templates are pre-sorted by weight: first feasible wins
+        m_star = jnp.argmax(feasible_m)
+        any_m = jnp.any(feasible_m)
+        per_node = jnp.maximum(jnp.take(per_node_m, m_star), 1)
+
+        # worst-case capacity of a new bin (for limit accounting, below)
+        worst = jnp.max(
+            jnp.where((new_ok & (t_tmpl == m_star))[:, None], t_cap, 0.0), axis=0
+        )  # [R]
+        # cap bin openings by the nodepool's remaining limits so one group
+        # cannot breach them mid-step (host parity: scheduler.go:271-292
+        # re-filters after every claim)
+        limit_ratio = jnp.where(worst > 0, state["rem"][m_star] / worst, jnp.inf)
+        max_new_by_limit = jnp.clip(
+            jnp.floor(jnp.min(limit_ratio) + _EPS), 0, 2**30
+        ).astype(jnp.int32)
+
+        want_new = jnp.where(any_m & (spill > 0), (spill + per_node - 1) // per_node, 0)
+        want_new = jnp.minimum(want_new, max_new_by_limit)
+        free = ~state["used"]
+        rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+        sel = free & (rank < want_new)
+        pods_new = jnp.clip(spill - rank * per_node, 0, per_node) * sel.astype(jnp.int32)
+
+        # ---- commit: existing bins ----
+        upd = take > 0
+        npods2 = state["npods"] + take
+        load2 = state["load"] + take[:, None].astype(jnp.float32) * d[None, :]
+        fits_new = jnp.all(load2[:, None, :] <= t_alloc[None, :, :] + _EPS, axis=-1)  # [B,T]
+        types2 = jnp.where(upd[:, None], state["types"] & Fg[None, :] & fits_new, state["types"])
+        cm, ch = _combine_masks(state["bmask"], state["bhas"], gm[None, :, :], gh[None, :])
+        bmask2 = jnp.where(upd[:, None, None], cm, state["bmask"])
+        bhas2 = jnp.where(upd[:, None], ch, state["bhas"])
+
+        # ---- commit: new bins ----
+        new_load = m_overhead[m_star][None, :] + pods_new[:, None].astype(jnp.float32) * d[None, :]
+        new_types = (
+            (t_tmpl[None, :] == m_star)
+            & new_ok[None, :]
+            & jnp.all(new_load[:, None, :] <= t_alloc[None, :, :] + _EPS, axis=-1)
+        )
+        # new bin requirements = template ∧ group (claim starts from template)
+        nm, nh = _combine_masks(m_mask[m_star], m_has[m_star], gm, gh)
+        used3 = state["used"] | sel
+        npods3 = jnp.where(sel, pods_new, npods2)
+        load3 = jnp.where(sel[:, None], new_load, load2)
+        types3 = jnp.where(sel[:, None], new_types, types2)
+        bmask3 = jnp.where(sel[:, None, None], nm[None, :, :], bmask2)
+        bhas3 = jnp.where(sel[:, None], nh[None, :], bhas2)
+        btmpl3 = jnp.where(sel, m_star, state["btmpl"])
+
+        # ---- nodepool limits: subtract worst-case capacity per new bin ----
+        n_opened = jnp.sum(sel.astype(jnp.float32))
+        rem3 = state["rem"].at[m_star].add(-worst * n_opened)
+
+        new_state = dict(
+            used=used3,
+            npods=npods3,
+            load=load3,
+            types=types3,
+            bmask=bmask3,
+            bhas=bhas3,
+            btmpl=btmpl3,
+            rem=rem3,
+        )
+        return new_state, take + pods_new
+
+    xs = (g_demand, g_count, g_mask, g_has, F, tmpl_full)
+    state, assign = jax.lax.scan(step, state, xs)
+    return dict(
+        assign=assign,  # [G,B] (scan stacks per-step [B] outputs)
+        used=state["used"],
+        npods=state["npods"],
+        types=state["types"],
+        tmpl=state["btmpl"],
+    )

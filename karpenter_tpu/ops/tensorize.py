@@ -1,0 +1,344 @@
+"""Snapshot compiler: pods + catalog → dense device tensors.
+
+This is the TPU-native reformulation of the reference's constraint checking
+(SURVEY.md §2.2): label requirements become bitmasks over interned per-key
+value vocabularies, resource fits become dense demand/allocatable matrices,
+and taint/offering checks fold into per-group/per-type boolean tensors. The
+pack kernel (ops/kernels.py) then consumes this snapshot.
+
+Design notes:
+- Pods are deduplicated into GROUPS by scheduling signature. Real bursts are
+  dominated by a few deployment templates, so G << P; the kernel scans groups
+  (not pods), which is what makes 50k pods tractable in one device call.
+- Complement requirements (NotIn/Exists/Gt/Lt) are materialized against the
+  closed type-side vocabulary, which is sound because overlap is only ever
+  evaluated against type/template values, all of which are interned.
+- The one-way Compatible rule (custom labels undefined on the claim are
+  denied — requirements.go:174) is per (group, template) and becomes the
+  g_tmpl_ok tensor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.scheduling import (
+    NOT_IN,
+    DOES_NOT_EXIST,
+    Requirements,
+    Taints,
+    pod_requirements,
+)
+from karpenter_tpu.utils import resources as resutil
+
+WORD = 32
+
+
+def _bits_for(n_values: int) -> int:
+    return max(1, (n_values + WORD - 1) // WORD)
+
+
+@dataclass
+class DeviceSnapshot:
+    # vocabularies
+    keys: list  # requirement keys (K)
+    key_index: dict
+    vocab: dict  # key -> {value: bit index}
+    resources: list  # resource names (R)
+    W: int
+
+    # groups (G)
+    groups: list  # list[list[Pod]] in FFD order
+    group_reqs: list  # list[Requirements]
+    g_demand: np.ndarray  # [G,R] f32
+    g_count: np.ndarray  # [G] i32
+    g_mask: np.ndarray  # [G,K,W] u32
+    g_has: np.ndarray  # [G,K] bool
+    g_tmpl_ok: np.ndarray  # [G,M] bool
+
+    # flattened (template, type) axis (T)
+    type_refs: list  # [(template_idx, InstanceType)]
+    t_mask: np.ndarray  # [T,K,W] u32
+    t_has: np.ndarray  # [T,K] bool
+    t_alloc: np.ndarray  # [T,R] f32
+    t_cap: np.ndarray  # [T,R] f32
+    t_tmpl: np.ndarray  # [T] i32
+
+    # offerings (O per type)
+    off_zone: np.ndarray  # [T,O] i32 (bit index into zone vocab; -1 = none)
+    off_ct: np.ndarray  # [T,O] i32
+    off_avail: np.ndarray  # [T,O] bool
+    off_price: np.ndarray  # [T,O] f32
+    g_zone_allowed: np.ndarray  # [G,Vz] bool
+    g_ct_allowed: np.ndarray  # [G,Vc] bool
+
+    # templates (M)
+    templates: list
+    m_mask: np.ndarray  # [M,K,W] u32
+    m_has: np.ndarray  # [M,K] bool
+    m_overhead: np.ndarray  # [M,R] f32
+    m_limits: np.ndarray  # [M,R] f32 (inf where unconstrained)
+
+    ineligible_pods: list = field(default_factory=list)
+
+    @property
+    def G(self):
+        return len(self.groups)
+
+    @property
+    def T(self):
+        return len(self.type_refs)
+
+
+def pod_signature(pod) -> tuple:
+    """Scheduling-equivalence key for pod deduplication."""
+    reqs = pod_requirements(pod)
+    req_sig = tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in reqs.values()
+        )
+    )
+    res = pod.effective_requests()
+    res_sig = tuple(sorted((k, round(v, 9)) for k, v in res.items()))
+    tol_sig = tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations))
+    return (req_sig, res_sig, tol_sig)
+
+
+def device_eligible(pod) -> bool:
+    """Pods the M1 device path handles; the rest go to the host solver.
+    (M2 extends this to topology constraints.)"""
+    if pod.affinity and (pod.affinity.pod_affinity or pod.affinity.pod_anti_affinity):
+        return False
+    if pod.affinity and pod.affinity.node_affinity:
+        na = pod.affinity.node_affinity
+        if na.preferred or len(na.required) > 1:
+            return False  # relaxation ladder is host-side
+    if pod.topology_spread_constraints:
+        return False
+    if getattr(pod, "host_ports", None) or getattr(pod, "volumes", None):
+        return False
+    if any(c.get("ports") for c in pod.containers or []):
+        return False
+    return True
+
+
+def _materialize_mask(req, vocab_k: dict, W: int) -> np.ndarray:
+    mask = np.zeros(W, dtype=np.uint32)
+    for value, bit in vocab_k.items():
+        if req.has(value):
+            mask[bit // WORD] |= np.uint32(1 << (bit % WORD))
+    return mask
+
+
+def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, limits=None):
+    """Compile a scheduling snapshot to tensors.
+
+    pods: eligible pods (caller pre-filters with device_eligible)
+    templates: [ClaimTemplate] in weight order
+    instance_types_by_pool: nodepool name -> [InstanceType]
+    daemon_overhead: nodepool name -> ResourceList
+    limits: nodepool name -> ResourceList (remaining resources; absent = inf)
+    """
+    daemon_overhead = daemon_overhead or {}
+    limits = limits or {}
+
+    # ---- group pods by signature, FFD order ----
+    by_sig: dict = {}
+    for pod in pods:
+        by_sig.setdefault(pod_signature(pod), []).append(pod)
+    groups = sorted(
+        by_sig.values(),
+        key=lambda g: (
+            -g[0].effective_requests().get(resutil.CPU, 0.0),
+            -g[0].effective_requests().get(resutil.MEMORY, 0.0),
+        ),
+    )
+    group_reqs = [pod_requirements(g[0]) for g in groups]
+    group_demand = [g[0].effective_requests() for g in groups]
+
+    # ---- resource dimension union ----
+    res_names = {resutil.CPU, resutil.MEMORY, resutil.PODS}
+    for d in group_demand:
+        res_names.update(d.keys())
+    resources = sorted(res_names)
+    r_index = {r: i for i, r in enumerate(resources)}
+
+    # ---- key/value vocabularies ----
+    # collect from type requirements, template requirements, group concrete values
+    def iter_reqs():
+        for m, tpl in enumerate(templates):
+            for r in tpl.requirements.values():
+                yield r
+            for it in instance_types_by_pool.get(tpl.nodepool_name, []):
+                for r in it.requirements.values():
+                    yield r
+                for o in it.offerings:
+                    for r in o.requirements.values():
+                        yield r
+        for reqs in group_reqs:
+            for r in reqs.values():
+                yield r
+
+    vocab: dict = {}
+    for r in iter_reqs():
+        if r.key == wk.HOSTNAME_LABEL:
+            continue
+        vocab.setdefault(r.key, {})
+        if not r.complement:
+            for v in r.values:
+                vocab[r.key].setdefault(v, len(vocab[r.key]))
+        else:
+            # NotIn values matter only if present elsewhere; Gt/Lt handled via has()
+            for v in r.values:
+                vocab[r.key].setdefault(v, len(vocab[r.key]))
+    keys = sorted(vocab.keys())
+    key_index = {k: i for i, k in enumerate(keys)}
+    K = len(keys)
+    W = _bits_for(max((len(v) for v in vocab.values()), default=1))
+
+    M = len(templates)
+    G = len(groups)
+
+    def build_mask_set(reqs: Requirements):
+        mask = np.zeros((K, W), dtype=np.uint32)
+        has = np.zeros(K, dtype=bool)
+        for r in reqs.values():
+            if r.key == wk.HOSTNAME_LABEL or r.key not in key_index:
+                continue
+            k = key_index[r.key]
+            has[k] = True
+            mask[k] = _materialize_mask(r, vocab[r.key], W)
+        return mask, has
+
+    # ---- templates ----
+    m_mask = np.zeros((M, K, W), dtype=np.uint32)
+    m_has = np.zeros((M, K), dtype=bool)
+    m_overhead = np.zeros((M, len(resources)), dtype=np.float32)
+    m_limits = np.full((M, len(resources)), np.inf, dtype=np.float32)
+    for m, tpl in enumerate(templates):
+        m_mask[m], m_has[m] = build_mask_set(tpl.requirements)
+        for r, v in daemon_overhead.get(tpl.nodepool_name, {}).items():
+            if r in r_index:
+                m_overhead[m, r_index[r]] = v
+        for r, v in limits.get(tpl.nodepool_name, {}).items():
+            if r in r_index:
+                m_limits[m, r_index[r]] = v
+
+    # ---- flattened (template, type) axis; pre-filter type vs template ----
+    type_refs = []
+    for m, tpl in enumerate(templates):
+        for it in instance_types_by_pool.get(tpl.nodepool_name, []):
+            if it.requirements.intersects(tpl.requirements) is not None:
+                continue
+            if not it.offerings.available().has_compatible(tpl.requirements):
+                continue
+            type_refs.append((m, it))
+    T = len(type_refs)
+    O = max((len(it.offerings) for _, it in type_refs), default=1)
+
+    t_mask = np.zeros((T, K, W), dtype=np.uint32)
+    t_has = np.zeros((T, K), dtype=bool)
+    t_alloc = np.zeros((T, len(resources)), dtype=np.float32)
+    t_cap = np.zeros((T, len(resources)), dtype=np.float32)
+    t_tmpl = np.zeros(T, dtype=np.int32)
+    off_zone = np.full((T, O), -1, dtype=np.int32)
+    off_ct = np.full((T, O), -1, dtype=np.int32)
+    off_avail = np.zeros((T, O), dtype=bool)
+    off_price = np.full((T, O), np.inf, dtype=np.float32)
+
+    zone_vocab = vocab.get(wk.TOPOLOGY_ZONE_LABEL, {})
+    ct_vocab = vocab.get(wk.CAPACITY_TYPE_LABEL, {})
+
+    for t, (m, it) in enumerate(type_refs):
+        t_tmpl[t] = m
+        t_mask[t], t_has[t] = build_mask_set(it.requirements)
+        alloc = it.allocatable()
+        for r, v in alloc.items():
+            if r in r_index:
+                t_alloc[t, r_index[r]] = max(v, 0.0)
+        for r, v in it.capacity.items():
+            if r in r_index:
+                t_cap[t, r_index[r]] = v
+        for o, off in enumerate(it.offerings):
+            z = off.zone
+            c = off.capacity_type
+            off_zone[t, o] = zone_vocab.get(z, -1)
+            off_ct[t, o] = ct_vocab.get(c, -1)
+            off_avail[t, o] = off.available
+            off_price[t, o] = off.price
+
+    # ---- groups ----
+    R = len(resources)
+    g_demand = np.zeros((G, R), dtype=np.float32)
+    g_count = np.zeros(G, dtype=np.int32)
+    g_mask = np.zeros((G, K, W), dtype=np.uint32)
+    g_has = np.zeros((G, K), dtype=bool)
+    g_tmpl_ok = np.zeros((G, M), dtype=bool)
+    g_zone_allowed = np.ones((G, max(len(zone_vocab), 1)), dtype=bool)
+    g_ct_allowed = np.ones((G, max(len(ct_vocab), 1)), dtype=bool)
+
+    for g, (pods_g, reqs) in enumerate(zip(groups, group_reqs)):
+        for r, v in group_demand[g].items():
+            g_demand[g, r_index[r]] = v
+        g_count[g] = len(pods_g)
+        g_mask[g], g_has[g] = build_mask_set(reqs)
+        pod0 = pods_g[0]
+        for m, tpl in enumerate(templates):
+            ok = Taints(tpl.taints).tolerates(pod0) is None
+            if ok:
+                # one-way Compatible: custom labels undefined on the template
+                # are denied unless NotIn/DoesNotExist (requirements.go:174)
+                for r in reqs.values():
+                    if r.key in wk.WELL_KNOWN_LABELS or r.key == wk.HOSTNAME_LABEL:
+                        continue
+                    if r.key in tpl.requirements:
+                        continue
+                    if r.operator in (NOT_IN, DOES_NOT_EXIST):
+                        continue
+                    ok = False
+                    break
+            g_tmpl_ok[g, m] = ok
+        if wk.TOPOLOGY_ZONE_LABEL in reqs:
+            zr = reqs.get_req(wk.TOPOLOGY_ZONE_LABEL)
+            for v, bit in zone_vocab.items():
+                g_zone_allowed[g, bit] = zr.has(v)
+        if wk.CAPACITY_TYPE_LABEL in reqs:
+            cr = reqs.get_req(wk.CAPACITY_TYPE_LABEL)
+            for v, bit in ct_vocab.items():
+                g_ct_allowed[g, bit] = cr.has(v)
+
+    return DeviceSnapshot(
+        keys=keys,
+        key_index=key_index,
+        vocab=vocab,
+        resources=resources,
+        W=W,
+        groups=groups,
+        group_reqs=group_reqs,
+        g_demand=g_demand,
+        g_count=g_count,
+        g_mask=g_mask,
+        g_has=g_has,
+        g_tmpl_ok=g_tmpl_ok,
+        type_refs=type_refs,
+        t_mask=t_mask,
+        t_has=t_has,
+        t_alloc=t_alloc,
+        t_cap=t_cap,
+        t_tmpl=t_tmpl,
+        off_zone=off_zone,
+        off_ct=off_ct,
+        off_avail=off_avail,
+        off_price=off_price,
+        g_zone_allowed=g_zone_allowed,
+        g_ct_allowed=g_ct_allowed,
+        templates=list(templates),
+        m_mask=m_mask,
+        m_has=m_has,
+        m_overhead=m_overhead,
+        m_limits=m_limits,
+    )

@@ -135,6 +135,14 @@ class Requirement:
     def values_list(self) -> list:
         return sorted(self.values)
 
+    def to_node_selector_requirement(self) -> NodeSelectorRequirement:
+        """Emit the API form (requirement.go NodeSelectorRequirement:90)."""
+        if self.greater_than is not None:
+            return NodeSelectorRequirement(self.key, GT, [str(self.greater_than)], self.min_values)
+        if self.less_than is not None:
+            return NodeSelectorRequirement(self.key, LT, [str(self.less_than)], self.min_values)
+        return NodeSelectorRequirement(self.key, self.operator, sorted(self.values), self.min_values)
+
     def __repr__(self) -> str:
         op = self.operator
         s = f"{self.key} {op}"

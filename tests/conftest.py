@@ -13,3 +13,19 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# This image's sitecustomize imports jax and registers a PJRT plugin for the
+# tunneled TPU in every interpreter, so jax's config has already latched
+# JAX_PLATFORMS=axon by the time conftest runs — and initializing that
+# backend claims the (single) chip and blocks when it is contended. Tests
+# must never touch it: force the live config to cpu and deregister the
+# device-plugin factories before any backend initialization happens.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    for _plat in ("axon", "tpu"):
+        getattr(xla_bridge, "_backend_factories", {}).pop(_plat, None)
+except Exception:
+    pass

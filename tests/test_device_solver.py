@@ -1,0 +1,173 @@
+"""Device (TPU kernel) solver tests: correctness and node-count parity
+against the host FFD oracle, mirroring the reference's benchmark parity
+gates (scheduling_benchmark_test.go node-count reporting)."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.catalog import benchmark_catalog, make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver, TPUSolver
+from karpenter_tpu.scheduling import IN
+
+GIB = 2**30
+
+
+def nodepool(name="default", weight=0, taints=(), requirements=()):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    np_.spec.weight = weight
+    np_.spec.template.taints = list(taints)
+    np_.spec.template.requirements = list(requirements)
+    return np_
+
+
+def pod(name, cpu=1.0, mem_gib=1.0, **kw):
+    return Pod(metadata=ObjectMeta(name=name), requests={"cpu": cpu, "memory": mem_gib * GIB}, **kw)
+
+
+def run_both(pods, pools, catalog):
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    host = HostSolver().solve([p.clone() for p in pods], templates, its)
+    templates2 = [ClaimTemplate(p) for p in pools]
+    dev = TPUSolver().solve([p.clone() for p in pods], templates2, its)
+    return host, dev
+
+
+@pytest.fixture
+def catalog():
+    return [
+        make_instance_type("small", 2, 8),
+        make_instance_type("medium", 8, 32),
+        make_instance_type("large", 32, 128),
+    ]
+
+
+class TestDeviceBasics:
+    def test_single_pod(self, catalog):
+        _, dev = run_both([pod("p1")], [nodepool()], catalog)
+        assert dev.all_pods_scheduled() and dev.node_count() == 1
+
+    def test_homogeneous_pack_parity(self, catalog):
+        pods = [pod(f"p{i}", cpu=0.5, mem_gib=0.5) for i in range(100)]
+        host, dev = run_both(pods, [nodepool()], catalog)
+        assert dev.all_pods_scheduled()
+        assert dev.scheduled_pod_count() == 100
+        assert dev.node_count() == host.node_count()
+
+    def test_selector_groups(self, catalog):
+        pool = nodepool(requirements=[NodeSelectorRequirement("team", IN, ["a", "b"])])
+        pods = [pod(f"a{i}", cpu=0.5, node_selector={"team": "a"}) for i in range(5)]
+        pods += [pod(f"b{i}", cpu=0.5, node_selector={"team": "b"}) for i in range(5)]
+        host, dev = run_both(pods, [pool], catalog)
+        assert dev.all_pods_scheduled()
+        assert dev.node_count() == host.node_count() == 2
+
+    def test_zone_constraint(self, catalog):
+        p = pod("p1")
+        p.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, IN, ["zone-2"])
+                        ]
+                    )
+                ]
+            )
+        )
+        _, dev = run_both([p], [nodepool()], catalog)
+        assert dev.all_pods_scheduled()
+        claim = dev.new_claims[0]
+        assert claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL).values == {"zone-2"}
+
+    def test_taint_gating(self, catalog):
+        pool = nodepool(taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")])
+        tolerating = pod("tol", tolerations=[Toleration(key="dedicated", value="infra")])
+        plain = pod("plain")
+        _, dev = run_both([tolerating, plain], [pool], catalog)
+        assert "default/plain" in dev.pod_errors
+        assert dev.scheduled_pod_count() == 1
+
+    def test_unschedulable_reported(self, catalog):
+        _, dev = run_both([pod("huge", cpu=1000)], [nodepool()], catalog)
+        assert not dev.all_pods_scheduled()
+
+    def test_template_weight_order(self, catalog):
+        low, high = nodepool("low", weight=1), nodepool("high", weight=10)
+        _, dev = run_both([pod("p1")], [low, high], catalog)
+        assert dev.new_claims[0].template.nodepool_name == "high"
+
+    def test_ineligible_pods_fall_back_to_host(self, catalog):
+        # preferred node affinity → host path; device claims still reused
+        p = pod("pref")
+        p.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=[],
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[NodeSelectorRequirement(wk.ARCH_LABEL, IN, ["sparc"])]
+                    ),
+                    NodeSelectorTerm(
+                        match_expressions=[NodeSelectorRequirement(wk.ARCH_LABEL, IN, ["amd64"])]
+                    ),
+                ],
+            )
+        )
+        plain = [pod(f"p{i}", cpu=0.2) for i in range(4)]
+        _, dev = run_both(plain + [p], [nodepool()], catalog)
+        assert dev.all_pods_scheduled()
+
+
+class TestDeviceParity:
+    @pytest.mark.parametrize("n_pods,seed", [(200, 0), (500, 1)])
+    def test_random_mix_parity(self, n_pods, seed):
+        rng = random.Random(seed)
+        catalog = benchmark_catalog(60)
+        pods = []
+        for i in range(n_pods):
+            kind = rng.random()
+            kw = {}
+            if kind < 0.3:
+                kw["node_selector"] = {wk.ARCH_LABEL: rng.choice(["amd64", "arm64"])}
+            elif kind < 0.4:
+                kw["node_selector"] = {wk.CAPACITY_TYPE_LABEL: "spot"}
+            pods.append(
+                pod(
+                    f"p{i}",
+                    cpu=rng.choice([0.1, 0.25, 0.5, 1, 2, 4]),
+                    mem_gib=rng.choice([0.25, 0.5, 1, 2, 8]),
+                    **kw,
+                )
+            )
+        host, dev = run_both(pods, [nodepool()], catalog)
+        assert dev.all_pods_scheduled() == host.all_pods_scheduled()
+        assert dev.scheduled_pod_count() == host.scheduled_pod_count()
+        # parity gate: within 2% node count (BASELINE.md target)
+        assert dev.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
+
+    def test_multi_pool_parity(self):
+        catalog = benchmark_catalog(40)
+        pools = [
+            nodepool("spot-pool", weight=10, requirements=[
+                NodeSelectorRequirement(wk.CAPACITY_TYPE_LABEL, IN, ["spot"])
+            ]),
+            nodepool("od-pool", weight=1),
+        ]
+        pods = [pod(f"p{i}", cpu=0.5, mem_gib=1) for i in range(50)]
+        host, dev = run_both(pods, pools, catalog)
+        assert dev.all_pods_scheduled()
+        assert dev.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
+        assert all(c.template.nodepool_name == "spot-pool" for c in dev.new_claims)

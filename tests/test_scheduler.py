@@ -1,0 +1,219 @@
+"""Host FFD scheduler behavior tests.
+
+Scenario coverage modeled on the reference's provisioning suite
+(pkg/controllers/provisioning/suite_test.go) and instance-selection specs
+(scheduling/instance_selection_test.go): packing, selector/taint gating,
+template weighting, limits, relaxation.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver
+from karpenter_tpu.scheduling import IN
+
+GIB = 2**30
+
+
+def nodepool(name="default", weight=0, taints=(), requirements=(), limits=None):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    np_.spec.weight = weight
+    np_.spec.template.taints = list(taints)
+    np_.spec.template.requirements = list(requirements)
+    if limits:
+        np_.spec.limits = limits
+    return np_
+
+
+def pod(name, cpu=1.0, mem_gib=1.0, **kw):
+    p = Pod(metadata=ObjectMeta(name=name), requests={"cpu": cpu, "memory": mem_gib * GIB}, **kw)
+    return p
+
+
+@pytest.fixture
+def catalog():
+    return [
+        make_instance_type("small", 2, 8),
+        make_instance_type("medium", 8, 32),
+        make_instance_type("large", 32, 128),
+    ]
+
+
+def solve(pods, pools, catalog, **kw):
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    return HostSolver().solve(pods, templates, its, **kw)
+
+
+class TestPacking:
+    def test_single_pod_single_node(self, catalog):
+        res = solve([pod("p1")], [nodepool()], catalog)
+        assert res.node_count() == 1 and res.all_pods_scheduled()
+
+    def test_pods_pack_onto_one_node(self, catalog):
+        pods = [pod(f"p{i}", cpu=0.5, mem_gib=0.5) for i in range(10)]
+        res = solve(pods, [nodepool()], catalog)
+        # 10x(0.5cpu,0.5Gi) fits a single large (32cpu) node
+        assert res.node_count() == 1
+        assert len(res.new_claims[0].pods) == 10
+
+    def test_overflow_opens_second_node(self, catalog):
+        # each pod cpu=20 → only "large" fits, one pod per node
+        pods = [pod(f"p{i}", cpu=20, mem_gib=1) for i in range(3)]
+        res = solve(pods, [nodepool()], catalog)
+        assert res.node_count() == 3
+
+    def test_claim_keeps_all_feasible_types(self, catalog):
+        res = solve([pod("p1", cpu=0.1, mem_gib=0.1)], [nodepool()], catalog)
+        assert len(res.new_claims[0].instance_types) == 3
+        res = solve([pod("p2", cpu=16, mem_gib=1)], [nodepool()], catalog)
+        assert [it.name for it in res.new_claims[0].instance_types] == ["large"]
+
+    def test_unschedulable_pod_reports_error(self, catalog):
+        res = solve([pod("p1", cpu=1000)], [nodepool()], catalog)
+        assert res.node_count() == 0
+        assert "default/p1" in res.pod_errors
+
+    def test_ffd_order_big_pods_first(self, catalog):
+        # 1 big + many small: big pod determines the node type; smalls fill in
+        pods = [pod("big", cpu=20, mem_gib=4)] + [pod(f"s{i}", cpu=1, mem_gib=1) for i in range(10)]
+        res = solve(pods, [nodepool()], catalog)
+        assert res.node_count() == 1
+
+
+class TestConstraints:
+    def test_node_selector_filters_types(self, catalog):
+        catalog2 = [
+            make_instance_type("amd", 8, 32, arch="amd64"),
+            make_instance_type("arm", 8, 32, arch="arm64"),
+        ]
+        p = pod("p1", node_selector={wk.ARCH_LABEL: "arm64"})
+        res = solve([p], [nodepool()], catalog2)
+        assert [it.name for it in res.new_claims[0].instance_types] == ["arm"]
+
+    def test_custom_label_undefined_on_pool_rejected(self, catalog):
+        p = pod("p1", node_selector={"team": "a"})
+        res = solve([p], [nodepool()], catalog)
+        assert not res.all_pods_scheduled()
+
+    def test_custom_label_defined_on_pool_ok(self, catalog):
+        p = pod("p1", node_selector={"team": "a"})
+        pool = nodepool(requirements=[NodeSelectorRequirement("team", IN, ["a", "b"])])
+        res = solve([p], [pool], catalog)
+        assert res.all_pods_scheduled()
+        assert res.new_claims[0].requirements.get_req("team").values == {"a"}
+
+    def test_conflicting_selectors_dont_share_node(self, catalog):
+        pool = nodepool(requirements=[NodeSelectorRequirement("team", IN, ["a", "b"])])
+        p1 = pod("p1", cpu=0.1, node_selector={"team": "a"})
+        p2 = pod("p2", cpu=0.1, node_selector={"team": "b"})
+        res = solve([p1, p2], [pool], catalog)
+        assert res.node_count() == 2
+
+    def test_zone_affinity_restricts_offerings(self, catalog):
+        p = pod("p1")
+        p.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, IN, ["zone-2"])
+                        ]
+                    )
+                ]
+            )
+        )
+        res = solve([p], [nodepool()], catalog)
+        assert res.all_pods_scheduled()
+        claim = res.new_claims[0]
+        assert claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL).values == {"zone-2"}
+
+    def test_taints_require_toleration(self, catalog):
+        pool = nodepool(taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")])
+        res = solve([pod("p1")], [pool], catalog)
+        assert not res.all_pods_scheduled()
+        p2 = pod("p2", tolerations=[Toleration(key="dedicated", value="infra")])
+        res = solve([p2], [pool], catalog)
+        assert res.all_pods_scheduled()
+
+
+class TestTemplates:
+    def test_weight_order(self, catalog):
+        low = nodepool("low", weight=1)
+        high = nodepool("high", weight=10)
+        res = solve([pod("p1")], [low, high], catalog)
+        assert res.new_claims[0].template.nodepool_name == "high"
+
+    def test_fallback_to_second_template(self, catalog):
+        high = nodepool(
+            "high",
+            weight=10,
+            taints=[Taint(key="gpu", value="true", effect="NoSchedule")],
+        )
+        low = nodepool("low", weight=1)
+        res = solve([pod("p1")], [high, low], catalog)
+        assert res.new_claims[0].template.nodepool_name == "low"
+
+    def test_limits_cap_nodes(self, catalog):
+        pool = nodepool(limits={"cpu": 40.0})
+        pods = [pod(f"p{i}", cpu=20, mem_gib=1) for i in range(4)]
+        # each pod needs its own "large" (32 cpu capacity) node; cpu limit 40
+        # allows only one node (worst-case capacity accounting)
+        res = solve(pods, [pool], catalog, limits={pool.name: dict(pool.spec.limits)})
+        assert res.node_count() == 1
+        assert len(res.pod_errors) == 3
+
+
+class TestRelaxation:
+    def test_preferred_affinity_dropped_when_unsatisfiable(self, catalog):
+        p = pod("p1")
+        p.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement("nonexistent", IN, ["x"])
+                            ]
+                        ),
+                    )
+                ]
+            )
+        )
+        res = solve([p], [nodepool()], catalog)
+        assert res.all_pods_scheduled()
+
+    def test_required_or_terms_tried_in_sequence(self, catalog):
+        p = pod("p1")
+        p.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(wk.ARCH_LABEL, IN, ["sparc"])
+                        ]
+                    ),
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(wk.ARCH_LABEL, IN, ["amd64"])
+                        ]
+                    ),
+                ]
+            )
+        )
+        res = solve([p], [nodepool()], catalog)
+        assert res.all_pods_scheduled()
