@@ -1,0 +1,96 @@
+"""Headline benchmark: solve a 50k-pod burst against a 500-type catalog.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference's enforced floor is 100 pods/sec for the Go FFD loop
+(scheduling_benchmark_test.go:55); `vs_baseline` reports our throughput as a
+multiple of that floor. The BASELINE.md target is <200 ms wall clock for the
+full solve (snapshot compile + device kernel + decode) on one TPU chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def build_workload(n_pods=50_000, n_types=500):
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.nodepool import NodePool
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+    from karpenter_tpu.models.inflight import ClaimTemplate
+
+    GIB = 2**30
+    catalog = benchmark_catalog(n_types)
+    pools = [NodePool(metadata=ObjectMeta(name="general"))]
+    spot = NodePool(metadata=ObjectMeta(name="spot"))
+    spot.spec.weight = 10
+    pools.append(spot)
+
+    # burst dominated by ~24 deployment shapes (the realistic regime the
+    # grouped kernel exploits), mixing selectors like the reference's
+    # benchmark pod mix (scheduling_benchmark_test.go:234-248)
+    shapes = []
+    sizes = [(0.1, 0.25), (0.25, 0.5), (0.5, 1.0), (1.0, 2.0), (2.0, 8.0), (4.0, 16.0)]
+    selectors = [
+        {},
+        {wk.ARCH_LABEL: "amd64"},
+        {wk.ARCH_LABEL: "arm64"},
+        {wk.CAPACITY_TYPE_LABEL: "spot"},
+    ]
+    for cpu, mem in sizes:
+        for sel in selectors:
+            shapes.append(({"cpu": cpu, "memory": mem * GIB}, sel))
+
+    pods = []
+    for i in range(n_pods):
+        req, sel = shapes[i % len(shapes)]
+        pods.append(
+            Pod(metadata=ObjectMeta(name=f"p{i}"), requests=req, node_selector=dict(sel))
+        )
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    return pods, templates, its
+
+
+def main():
+    n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    n_types = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+
+    from karpenter_tpu.models import TPUSolver
+
+    pods, templates, its = build_workload(n_pods, n_types)
+    solver = TPUSolver()
+
+    # warmup: compile the shape bucket
+    solver.solve(pods, templates, its)
+
+    t0 = time.perf_counter()
+    res = solver.solve(pods, templates, its)
+    elapsed = time.perf_counter() - t0
+
+    assert res.scheduled_pod_count() + len(res.pod_errors) == n_pods
+    pods_per_sec = n_pods / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"solve_wall_clock_{n_pods}pods_x_{n_types}types",
+                "value": round(elapsed * 1000, 2),
+                "unit": "ms",
+                # reference floor: 100 pods/sec (scheduling_benchmark_test.go:55)
+                "vs_baseline": round(pods_per_sec / 100.0, 1),
+                "detail": {
+                    "pods_per_sec": round(pods_per_sec),
+                    "nodes": res.node_count(),
+                    "scheduled": res.scheduled_pod_count(),
+                    "device_stats": solver.last_device_stats,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

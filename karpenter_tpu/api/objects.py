@@ -11,6 +11,7 @@ pkg/controllers/provisioning/scheduling/topologygroup.go).
 
 from __future__ import annotations
 
+import copy
 import itertools
 from dataclasses import dataclass, field, replace
 
@@ -218,6 +219,8 @@ class Pod:
         return None
 
     def clone(self) -> "Pod":
+        # affinity/spread/tolerations must be independent: the relaxation
+        # ladder (models/preferences.py) mutates them in place
         return replace(
             self,
             metadata=replace(
@@ -225,6 +228,9 @@ class Pod:
                 labels=dict(self.metadata.labels),
                 annotations=dict(self.metadata.annotations),
             ),
+            affinity=copy.deepcopy(self.affinity),
+            tolerations=list(self.tolerations),
+            topology_spread_constraints=copy.deepcopy(self.topology_spread_constraints),
         )
 
 

@@ -59,12 +59,17 @@ class FakeCloudProvider(CloudProvider):
             claim.status.provider_id = f"fake://{new_uid('instance')}"
             claim.status.capacity = dict(it.capacity)
             claim.status.allocatable = dict(it.allocatable())
-            claim.metadata.labels = {
-                **node_claim.metadata.labels,
+            # offering-derived labels are authoritative: they reflect where
+            # the instance actually launched, so they spread last
+            offering_labels = {
                 wk.INSTANCE_TYPE_LABEL: it.name,
                 wk.TOPOLOGY_ZONE_LABEL: offering.zone,
                 wk.CAPACITY_TYPE_LABEL: offering.capacity_type,
-                **{k: v for k, v in reqs.labels().items() if k not in (wk.INSTANCE_TYPE_LABEL,)},
+            }
+            claim.metadata.labels = {
+                **node_claim.metadata.labels,
+                **{k: v for k, v in reqs.labels().items() if k not in offering_labels},
+                **offering_labels,
             }
             self.created[claim.status.provider_id] = claim
             return claim

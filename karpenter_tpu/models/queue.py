@@ -8,6 +8,8 @@ relaxation resets staleness tracking.
 
 from __future__ import annotations
 
+from collections import deque
+
 from karpenter_tpu.utils import resources as resutil
 
 
@@ -18,7 +20,7 @@ def _sort_key(pod):
 
 class SchedulingQueue:
     def __init__(self, pods):
-        self.pods = sorted(pods, key=_sort_key)
+        self.pods = deque(sorted(pods, key=_sort_key))
         self._last_len: dict = {}
 
     def pop(self):
@@ -28,7 +30,7 @@ class SchedulingQueue:
         # cycled through the whole queue without progress → stop
         if self._last_len.get(p.uid) == len(self.pods):
             return None
-        self.pods.pop(0)
+        self.pods.popleft()
         return p
 
     def push(self, pod, relaxed: bool):

@@ -21,6 +21,7 @@ from karpenter_tpu.models.inflight import InFlightNodeClaim
 from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
 from karpenter_tpu.ops import tensorize
 from karpenter_tpu.ops.tensorize import device_eligible
+from karpenter_tpu.utils import resources as resutil
 
 
 class Solver:
@@ -254,6 +255,13 @@ class TPUSolver(Solver):
             template = snap.templates[m]
             bin_pods = []
             bin_reqs = template.requirements.copy()
+            # requests accumulate in float64 from the source demand dicts —
+            # the f32 kernel tensors are too coarse at memory-byte scale
+            requests = {
+                r: float(v)
+                for r, v in zip(snap.resources, snap.m_overhead[m].tolist())
+                if v > 0
+            }
             for g in range(snap.G):
                 c = int(assign[g, b])
                 if c == 0:
@@ -261,6 +269,9 @@ class TPUSolver(Solver):
                 bin_pods.extend(snap.groups[g][cursors[g] : cursors[g] + c])
                 cursors[g] += c
                 bin_reqs.add(*snap.group_reqs[g].values())
+                requests = resutil.merge(
+                    requests, {r: v * c for r, v in snap.group_demand[g].items()}
+                )
             its = [snap.type_refs[t][1] for t in range(snap.T) if types[b, t] and snap.type_refs[t][0] == m]
             claim = InFlightNodeClaim(
                 template,
@@ -269,17 +280,7 @@ class TPUSolver(Solver):
                 its,
             )
             claim.pods = bin_pods
-            claim.requests = {
-                r: float(v)
-                for r, v in zip(
-                    snap.resources,
-                    snap.m_overhead[m]
-                    + sum(
-                        snap.g_demand[g] * assign[g, b] for g in range(snap.G)
-                    ),
-                )
-                if v > 0
-            }
+            claim.requests = requests
             claim.requirements.add(*bin_reqs.values())
             # host-side joint validation
             remaining = filter_instance_types(claim.instance_types, claim.requirements, claim.requests)

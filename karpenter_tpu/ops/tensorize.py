@@ -53,6 +53,7 @@ class DeviceSnapshot:
     # groups (G)
     groups: list  # list[list[Pod]] in FFD order
     group_reqs: list  # list[Requirements]
+    group_demand: list  # list[ResourceList] per-pod demand in float64
     g_demand: np.ndarray  # [G,R] f32
     g_count: np.ndarray  # [G] i32
     g_mask: np.ndarray  # [G,K,W] u32
@@ -188,13 +189,11 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
         if r.key == wk.HOSTNAME_LABEL:
             continue
         vocab.setdefault(r.key, {})
-        if not r.complement:
-            for v in r.values:
-                vocab[r.key].setdefault(v, len(vocab[r.key]))
-        else:
-            # NotIn values matter only if present elsewhere; Gt/Lt handled via has()
-            for v in r.values:
-                vocab[r.key].setdefault(v, len(vocab[r.key]))
+        # concrete and complement (NotIn) values both intern — a NotIn value
+        # only matters when it also appears on the type side, and Gt/Lt are
+        # resolved through req.has() at mask materialization
+        for v in r.values:
+            vocab[r.key].setdefault(v, len(vocab[r.key]))
     keys = sorted(vocab.keys())
     key_index = {k: i for i, k in enumerate(keys)}
     K = len(keys)
@@ -319,6 +318,7 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
         W=W,
         groups=groups,
         group_reqs=group_reqs,
+        group_demand=group_demand,
         g_demand=g_demand,
         g_count=g_count,
         g_mask=g_mask,

@@ -1,0 +1,1 @@
+from karpenter_tpu.parallel.mesh import make_mesh, sharded_solve  # noqa: F401

@@ -1,0 +1,96 @@
+"""Device-mesh sharding for the solve kernels.
+
+The reference scales by bounding problem size per solve (SURVEY.md §5
+long-context note); the TPU build scales by sharding the feasibility tensor
+over a mesh instead: pod-groups ride the `data` axis and instance types the
+`model` axis, XLA inserting the all-gathers needed before the (small,
+sequential) pack scan. On real hardware those collectives ride ICI; the
+same program dry-runs on a virtual CPU mesh (tests/conftest.py,
+__graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from karpenter_tpu.ops import kernels
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    # squarest 2D factorization: data-parallel groups x model-parallel types
+    d = int(math.sqrt(n))
+    while n % d:
+        d -= 1
+    shape = (n // d, d)
+    return Mesh(mesh_utils.create_device_mesh(shape, devs[:n]), (DATA_AXIS, MODEL_AXIS))
+
+
+def _pad_to(a: np.ndarray, axis: int, mult: int) -> np.ndarray:
+    size = a.shape[axis]
+    target = ((size + mult - 1) // mult) * mult
+    if target == size:
+        return a
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, target - size)
+    return np.pad(a, pad)
+
+
+def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
+    """Full solve step (feasibility + pack) with the feasibility inputs
+    sharded over the mesh. Returns the same outputs as the unsharded path.
+
+    Sharding layout: group-axis tensors are split over `data`, type-axis
+    tensors over `model`; the pack scan consumes the all-gathered F (XLA
+    inserts the collectives) and runs replicated — it is O(G*B*T) and tiny
+    next to feasibility at scale.
+    """
+    n_data, n_model = mesh.devices.shape
+
+    def shard(a, spec):
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    # pad shardable axes to multiples of their mesh axis
+    args = dict(args)
+    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok"):
+        args[name] = _pad_to(np.asarray(args[name]), 0, n_data)
+    for name in ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail", "off_price"):
+        args[name] = _pad_to(np.asarray(args[name]), 0, n_model)
+
+    placed = dict(args)
+    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok"):
+        placed[name] = shard(args[name], P(DATA_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
+    for name in ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail", "off_price"):
+        placed[name] = shard(args[name], P(MODEL_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
+    for name in ("m_mask", "m_has", "m_overhead", "m_limits"):
+        placed[name] = shard(args[name], P())
+
+    @jax.jit
+    def run(a):
+        F, price, tmpl_full = kernels.feasibility(
+            a["g_mask"], a["g_has"], a["g_demand"],
+            a["t_mask"], a["t_has"], a["t_alloc"],
+            a["g_zone_allowed"], a["g_ct_allowed"],
+            a["off_zone"], a["off_ct"], a["off_avail"], a["off_price"],
+            a["g_tmpl_ok"], a["m_mask"], a["m_has"],
+        )
+        out = kernels.pack(
+            a["g_demand"], a["g_count"], a["g_mask"], a["g_has"], F, tmpl_full,
+            a["t_alloc"], a["t_cap"], a["t_tmpl"], a["m_mask"], a["m_has"],
+            a["m_overhead"], a["m_limits"], max_bins=max_bins,
+        )
+        out["F"] = F
+        out["price"] = price
+        return out
+
+    with mesh:
+        return run(placed)

@@ -11,7 +11,8 @@ import functools
 import time
 
 
-def _parse_field(spec: str, lo: int, hi: int) -> frozenset:
+def _parse_field(spec: str, lo: int, hi: int, wrap: int | None = None) -> frozenset:
+    """wrap: a value that aliases lo (Vixie cron allows dow 7 == Sunday)."""
     out = set()
     for part in spec.split(","):
         step = 1
@@ -26,8 +27,15 @@ def _parse_field(spec: str, lo: int, hi: int) -> frozenset:
         else:
             v = int(part)
             rng = range(v, v + 1)
-        out.update(x for x in rng if (x - rng.start) % step == 0)
-    return frozenset(x for x in out if lo <= x <= hi)
+        for x in rng:
+            if (x - rng.start) % step:
+                continue
+            if x == wrap:
+                x = lo
+            if not lo <= x <= hi:
+                raise ValueError(f"cron field value {x} out of range [{lo},{hi}] in {spec!r}")
+            out.add(x)
+    return frozenset(out)
 
 
 class CronSchedule:
@@ -50,7 +58,7 @@ class CronSchedule:
         self.hours = _parse_field(fields[1], 0, 23)
         self.dom = _parse_field(fields[2], 1, 31)
         self.months = _parse_field(fields[3], 1, 12)
-        self.dow = _parse_field(fields[4], 0, 6)  # 0 = Sunday
+        self.dow = _parse_field(fields[4], 0, 6, wrap=7)  # 0 (or 7) = Sunday
         self.dom_wild = fields[2] == "*"
         self.dow_wild = fields[4] == "*"
 

@@ -61,10 +61,13 @@ def fits(candidate: ResourceList, total: ResourceList) -> bool:
     """True iff every requested resource in candidate is available in total.
 
     A resource absent from total counts as zero capacity (so any positive
-    request for it fails), matching resources.go:221.
+    request for it fails), matching resources.go:221. The tolerance is
+    relative: byte-scale resources (memory) pass through float32 device
+    tensors, whose ulp at 128Gi dwarfs any absolute epsilon.
     """
     for k, v in (candidate or {}).items():
-        if v > total.get(k, 0.0) + _EPS:
+        cap = total.get(k, 0.0)
+        if v > cap + _EPS + 1e-6 * abs(cap):
             return False
     return True
 
