@@ -43,13 +43,18 @@ class Budget:
         """True when the budget window is open (Budget.IsActive nodepool.go:318)."""
         if self.schedule is None and self.duration is None:
             return True
+        try:
+            sched = parse_schedule(self.schedule or "* * * * *")
+        except ValueError:
+            # invalid schedules are rejected at admission by the validation
+            # controller; at runtime an unparseable budget is inert
+            return False
         if self.duration is None:
             # schedule without duration: the window never closes, so the
             # budget is simply always active (CEL validation in the
             # reference requires the pair to be set together)
             return True
         now = time.time() if now is None else now
-        sched = parse_schedule(self.schedule or "* * * * *")
         # Active iff a firing occurred within the last `duration`; bounding
         # the lookback keeps sparse schedules (@yearly) off the hot path.
         lookback = int(self.duration // 60) + 2

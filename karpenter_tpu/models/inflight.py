@@ -74,6 +74,7 @@ class InFlightNodeClaim:
         # finalize (scheduler.go FinalizeScheduling)
         self.hostname = f"hostname-{next(_hostname_counter)}"
         self.requirements.add(Requirement(wk.HOSTNAME_LABEL, IN, [self.hostname]))
+        topology.register(wk.HOSTNAME_LABEL, self.hostname)  # nodeclaim.go:49
         self.taints = Taints(template.taints)
         self.host_ports = HostPortUsage()
 

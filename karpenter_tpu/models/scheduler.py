@@ -31,6 +31,9 @@ class NullTopology:
     def update(self, pod):
         return None
 
+    def register(self, topology_key, domain):
+        pass
+
 
 class SchedulerResults:
     """Solve output (scheduler.go Results:96)."""
@@ -102,6 +105,9 @@ class Scheduler:
         self.new_claims: list = []
 
     def solve(self, pods) -> SchedulerResults:
+        # relaxation mutates pod specs in place; work on clones so a caller
+        # can re-solve the same input and get the same answer
+        pods = [p.clone() for p in pods]
         errors: dict = {}
         pod_by_uid = {}
         q = SchedulingQueue(pods)

@@ -67,50 +67,16 @@ class TPUSolver(Solver):
 
     def _kernel(self, key):
         if key not in self._compiled:
+            import functools
+
             import jax
 
             from karpenter_tpu.ops import kernels
 
             max_bins = key[-1]
-
-            def run(args):
-                F, price, tmpl_full = kernels.feasibility(
-                    args["g_mask"],
-                    args["g_has"],
-                    args["g_demand"],
-                    args["t_mask"],
-                    args["t_has"],
-                    args["t_alloc"],
-                    args["g_zone_allowed"],
-                    args["g_ct_allowed"],
-                    args["off_zone"],
-                    args["off_ct"],
-                    args["off_avail"],
-                    args["off_price"],
-                    args["g_tmpl_ok"],
-                    args["m_mask"],
-                    args["m_has"],
-                )
-                out = kernels.pack(
-                    args["g_demand"],
-                    args["g_count"],
-                    args["g_mask"],
-                    args["g_has"],
-                    F,
-                    tmpl_full,
-                    args["t_alloc"],
-                    args["t_cap"],
-                    args["t_tmpl"],
-                    args["m_mask"],
-                    args["m_has"],
-                    args["m_overhead"],
-                    args["m_limits"],
-                    max_bins=max_bins,
-                )
-                out["F"] = F
-                return out
-
-            self._compiled[key] = jax.jit(run)
+            self._compiled[key] = jax.jit(
+                functools.partial(kernels.solve_step, max_bins=max_bins)
+            )
         return self._compiled[key]
 
     def solve(

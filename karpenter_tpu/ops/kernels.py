@@ -277,3 +277,24 @@ def pack(
         types=state["types"],
         tmpl=state["btmpl"],
     )
+
+
+def solve_step(args: dict, max_bins: int) -> dict:
+    """The full single-call solve: feasibility + pack over one snapshot's
+    arg dict (the canonical invocation shared by the solver, the sharded
+    path, and the graft entry)."""
+    F, price, tmpl_full = feasibility(
+        args["g_mask"], args["g_has"], args["g_demand"],
+        args["t_mask"], args["t_has"], args["t_alloc"],
+        args["g_zone_allowed"], args["g_ct_allowed"],
+        args["off_zone"], args["off_ct"], args["off_avail"], args["off_price"],
+        args["g_tmpl_ok"], args["m_mask"], args["m_has"],
+    )
+    out = pack(
+        args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,
+        args["t_alloc"], args["t_cap"], args["t_tmpl"], args["m_mask"], args["m_has"],
+        args["m_overhead"], args["m_limits"], max_bins=max_bins,
+    )
+    out["F"] = F
+    out["price"] = price
+    return out

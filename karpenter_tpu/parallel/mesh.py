@@ -11,6 +11,7 @@ __graft_entry__.dryrun_multichip).
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -22,6 +23,13 @@ from karpenter_tpu.ops import kernels
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_solve_step(max_bins: int):
+    """One jitted executable per max_bins; jax.jit's own cache handles the
+    per-shape/per-sharding specializations under it."""
+    return jax.jit(functools.partial(kernels.solve_step, max_bins=max_bins))
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -74,23 +82,5 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
     for name in ("m_mask", "m_has", "m_overhead", "m_limits"):
         placed[name] = shard(args[name], P())
 
-    @jax.jit
-    def run(a):
-        F, price, tmpl_full = kernels.feasibility(
-            a["g_mask"], a["g_has"], a["g_demand"],
-            a["t_mask"], a["t_has"], a["t_alloc"],
-            a["g_zone_allowed"], a["g_ct_allowed"],
-            a["off_zone"], a["off_ct"], a["off_avail"], a["off_price"],
-            a["g_tmpl_ok"], a["m_mask"], a["m_has"],
-        )
-        out = kernels.pack(
-            a["g_demand"], a["g_count"], a["g_mask"], a["g_has"], F, tmpl_full,
-            a["t_alloc"], a["t_cap"], a["t_tmpl"], a["m_mask"], a["m_has"],
-            a["m_overhead"], a["m_limits"], max_bins=max_bins,
-        )
-        out["F"] = F
-        out["price"] = price
-        return out
-
     with mesh:
-        return run(placed)
+        return _jitted_solve_step(max_bins)(placed)

@@ -1,0 +1,313 @@
+"""Topology constraint tests.
+
+Scenario coverage modeled on the reference's topology suite
+(pkg/controllers/provisioning/scheduling/topology_test.go, 79 specs) and the
+`ExpectSkew` helper semantics (pkg/test/expectations/expectations.go:596).
+"""
+
+import collections
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    PodAffinity,
+    PodAffinityTerm,
+    Pod,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver
+from karpenter_tpu.models.topology import Topology
+
+GIB = 2**30
+ZONES = ("zone-1", "zone-2", "zone-3")
+
+
+def nodepool(name="default"):
+    return NodePool(metadata=ObjectMeta(name=name))
+
+
+def catalog():
+    return [
+        make_instance_type("small", 4, 16, zones=ZONES),
+        make_instance_type("large", 32, 128, zones=ZONES),
+    ]
+
+
+def make_pods(n, labels, cpu=1.0, **kw):
+    return [
+        Pod(
+            metadata=ObjectMeta(name=f"p{i}", labels=dict(labels)),
+            requests={"cpu": cpu, "memory": 1 * GIB},
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+def zone_spread(max_skew=1, labels=None, **kw):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=wk.TOPOLOGY_ZONE_LABEL,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+        **kw,
+    )
+
+
+def hostname_spread(max_skew=1, labels=None):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=wk.HOSTNAME_LABEL,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+    )
+
+
+def solve(pods, domains=None):
+    pool = nodepool()
+    templates = [ClaimTemplate(pool)]
+    its = {pool.name: catalog()}
+    topo = Topology(
+        domains=domains or {wk.TOPOLOGY_ZONE_LABEL: set(ZONES)}, pods=pods
+    )
+    return HostSolver().solve(pods, templates, its, topology=topo)
+
+
+def zone_skew(res):
+    """Domain → pod count over new claims (ExpectSkew analog)."""
+    counts = collections.Counter()
+    for claim in res.new_claims:
+        zone_req = claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL)
+        assert len(zone_req.values) == 1, "claim not pinned to one zone"
+        counts[next(iter(zone_req.values))] += len(claim.pods)
+    return counts
+
+
+class TestZonalSpread:
+    def test_even_spread(self):
+        pods = make_pods(9, {"app": "web"}, topology_spread_constraints=[zone_spread()])
+        res = solve(pods)
+        assert res.all_pods_scheduled()
+        assert sorted(zone_skew(res).values()) == [3, 3, 3]
+
+    def test_skew_within_max(self):
+        pods = make_pods(7, {"app": "web"}, topology_spread_constraints=[zone_spread()])
+        res = solve(pods)
+        counts = zone_skew(res)
+        assert res.all_pods_scheduled()
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_max_skew_2(self):
+        pods = make_pods(6, {"app": "web"}, topology_spread_constraints=[zone_spread(max_skew=2)])
+        res = solve(pods)
+        counts = zone_skew(res)
+        assert max(counts.values()) - min(counts.values()) <= 2
+
+    def test_spread_ignores_non_matching_pods(self):
+        spread = zone_spread()
+        matching = make_pods(3, {"app": "web"}, topology_spread_constraints=[spread])
+        others = make_pods(5, {"app": "db"})
+        res = solve(matching + others)
+        assert res.all_pods_scheduled()
+
+    def test_unsatisfiable_do_not_schedule(self):
+        # only one zone known → spread satisfiable trivially; with zero
+        # domains the constraint cannot be satisfied
+        pods = make_pods(2, {"app": "web"}, topology_spread_constraints=[zone_spread()])
+        res = solve(pods, domains={wk.TOPOLOGY_ZONE_LABEL: set()})
+        assert not res.all_pods_scheduled()
+
+    def test_schedule_anyway_relaxed(self):
+        tsc = zone_spread()
+        tsc.when_unsatisfiable = "ScheduleAnyway"
+        pods = make_pods(2, {"app": "web"}, topology_spread_constraints=[tsc])
+        res = solve(pods, domains={wk.TOPOLOGY_ZONE_LABEL: set()})
+        assert res.all_pods_scheduled()  # constraint dropped by relaxation
+
+    def test_min_domains(self):
+        pods = make_pods(
+            2,
+            {"app": "web"},
+            topology_spread_constraints=[zone_spread(min_domains=3)],
+        )
+        res = solve(pods, domains={wk.TOPOLOGY_ZONE_LABEL: {"zone-1", "zone-2"}})
+        # fewer domains than minDomains → global min treated as 0, pods can
+        # still land but only within maxSkew of 0 → at most 1 per domain
+        counts = zone_skew(res)
+        assert all(v <= 1 for v in counts.values())
+
+
+class TestHostnameSpread:
+    def test_one_pod_per_node(self):
+        pods = make_pods(4, {"app": "web"}, topology_spread_constraints=[hostname_spread()])
+        res = solve(pods)
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 4
+        assert all(len(c.pods) == 1 for c in res.new_claims)
+
+
+class TestAntiAffinity:
+    def _anti(self, labels=None, key=wk.TOPOLOGY_ZONE_LABEL):
+        return Affinity(
+            pod_anti_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=key,
+                        label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+                    )
+                ]
+            )
+        )
+
+    def test_self_anti_affinity_zone_schroedinger(self):
+        # An unpinned pod with zone anti-affinity blocks EVERY zone it could
+        # be in (reference: "should not violate pod anti-affinity on zone
+        # (Schrödinger)" topology_test.go:1914) — so only the first pod of
+        # the group schedules.
+        pods = make_pods(5, {"app": "web"}, affinity=self._anti())
+        res = solve(pods)
+        assert res.scheduled_pod_count() == 1
+        assert len(res.pod_errors) == 4
+
+    def test_self_anti_affinity_zone_pinned_fills_domains(self):
+        # zone-pinned anti-affinity pods land one per zone
+        # (topology_test.go:1734 "should not violate pod anti-affinity on zone")
+        pods = []
+        for i, zone in enumerate(ZONES):
+            p = make_pods(1, {"app": "web"}, affinity=self._anti())[0]
+            p.metadata.name = f"pinned-{i}"
+            p.node_selector = {wk.TOPOLOGY_ZONE_LABEL: zone}
+            pods.append(p)
+        extra = make_pods(1, {"app": "other"})[0]
+        extra.metadata.labels = {"app": "web"}
+        extra.metadata.name = "unpinned"
+        res = solve(pods + [extra])
+        # three pinned pods schedule; the unpinned selected pod cannot (all
+        # zones hold an anti-affinity pod)
+        assert res.scheduled_pod_count() == 3
+        assert "default/unpinned" in res.pod_errors
+        assert sorted(zone_skew(res).values()) == [1, 1, 1]
+
+    def test_self_anti_affinity_hostname_unbounded(self):
+        pods = make_pods(5, {"app": "web"}, affinity=self._anti(key=wk.HOSTNAME_LABEL))
+        res = solve(pods)
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 5
+
+    def test_inverse_anti_affinity_unpinned_blocks_all(self):
+        # an UNPINNED pod declaring anti-affinity to app=web could land in
+        # any zone, so web pods are blocked everywhere (reference
+        # topology_test.go:1878 "inverse": selected pods can't schedule)
+        anti_pod = make_pods(1, {"app": "guard"}, affinity=self._anti({"app": "web"}))[0]
+        web_pods = make_pods(3, {"app": "web"})
+        res = solve([anti_pod] + web_pods)
+        assert res.scheduled_pod_count() == 1
+        assert len(res.pod_errors) == 3
+
+    def test_inverse_anti_affinity_pinned(self):
+        # pod A declares anti-affinity to app=web and is pinned to zone-1;
+        # web pods must avoid zone-1 but schedule elsewhere
+        anti_pod = make_pods(1, {"app": "guard"}, affinity=self._anti({"app": "web"}))[0]
+        anti_pod.node_selector = {wk.TOPOLOGY_ZONE_LABEL: "zone-1"}
+        web_pods = make_pods(3, {"app": "web"})
+        res = solve([anti_pod] + web_pods)
+        assert res.all_pods_scheduled()
+        guard_zone = None
+        web_zones = set()
+        for claim in res.new_claims:
+            zone = next(iter(claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL).values))
+            for p in claim.pods:
+                if p.metadata.labels.get("app") == "guard":
+                    guard_zone = zone
+                else:
+                    web_zones.add(zone)
+        assert guard_zone is not None and guard_zone not in web_zones
+
+
+class TestPodAffinity:
+    def test_self_affinity_single_zone(self):
+        aff = Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ]
+            )
+        )
+        pods = make_pods(6, {"app": "web"}, affinity=aff)
+        res = solve(pods)
+        assert res.all_pods_scheduled()
+        counts = zone_skew(res)
+        assert len(counts) == 1  # everyone in one zone
+
+    def test_affinity_follows_target_hostname_same_node(self):
+        # in-batch affinity works on hostname because every claim pins a
+        # single hostname (reference "should respect pod affinity (hostname)"
+        # topology_test.go:1404)
+        target = make_pods(1, {"app": "db"})[0]
+        aff = Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.HOSTNAME_LABEL,
+                        label_selector=LabelSelector(match_labels={"app": "db"}),
+                    )
+                ]
+            )
+        )
+        followers = make_pods(2, {"app": "web"}, affinity=aff)
+        res = solve([target] + followers)
+        assert res.all_pods_scheduled()
+        homes = [c for c in res.new_claims if c.pods]
+        assert len(homes) == 1  # all three share one node
+
+    def test_affinity_follows_target(self):
+        target = make_pods(1, {"app": "db"})[0]
+        # the target must be zone-pinned for in-batch zone affinity: an
+        # unpinned claim never commits a single zone domain
+        target.node_selector = {wk.TOPOLOGY_ZONE_LABEL: "zone-2"}
+        aff = Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                        label_selector=LabelSelector(match_labels={"app": "db"}),
+                    )
+                ]
+            )
+        )
+        followers = make_pods(3, {"app": "web"}, affinity=aff)
+        res = solve([target] + followers)
+        assert res.all_pods_scheduled()
+        zones = zone_skew(res)
+        assert len(zones) == 1  # followers joined the db pod's zone
+
+
+class TestCombined:
+    def test_spread_with_anti_affinity_mix(self):
+        spread_pods = make_pods(6, {"app": "web"}, topology_spread_constraints=[zone_spread()])
+        anti = Affinity(
+            pod_anti_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.HOSTNAME_LABEL,
+                        label_selector=LabelSelector(match_labels={"app": "solo"}),
+                    )
+                ]
+            )
+        )
+        solo_pods = make_pods(2, {"app": "solo"}, affinity=anti)
+        res = solve(spread_pods + solo_pods)
+        assert res.all_pods_scheduled()
+        counts = zone_skew(res)
+        # spread pods still balanced
+        web_total = 6
+        assert sum(counts.values()) == web_total + 2
