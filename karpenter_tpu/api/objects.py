@@ -256,6 +256,12 @@ class Node:
 
 
 @dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    template: "Pod" = None  # pod template stamped per node
+
+
+@dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: LabelSelector = field(default_factory=LabelSelector)

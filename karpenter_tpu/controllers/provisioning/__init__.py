@@ -1,0 +1,2 @@
+from karpenter_tpu.controllers.provisioning.batcher import Batcher  # noqa: F401
+from karpenter_tpu.controllers.provisioning.provisioner import Provisioner  # noqa: F401

@@ -1,0 +1,242 @@
+"""Provisioner: the L4 singleton controller.
+
+Mirror of the reference's pkg/controllers/provisioning/provisioner.go:
+trigger on unschedulable pods (controller.go:52-66), debounce via the
+batcher, snapshot cluster state, build the scheduler inputs (NewScheduler
+:219-314 — ready nodepools by weight, per-pool instance types, the topology
+domain universe :264-296, daemonset overhead), Solve, truncate instance
+types (:363), then create NodeClaims and nominate the pods (:149-160).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.controllers.provisioning.batcher import Batcher
+from karpenter_tpu.models import ClaimTemplate
+from karpenter_tpu.models.solver import make_solver
+from karpenter_tpu.models.topology import Topology
+from karpenter_tpu.scheduling import Taints, pod_requirements
+from karpenter_tpu.utils import pod as pod_util
+from karpenter_tpu.utils import resources as resutil
+
+
+class StoreClusterView:
+    """Adapter giving the topology engine visibility into bound pods
+    (replaced by state.Cluster once the state plane lands)."""
+
+    def __init__(self, store):
+        self.store = store
+        self._node_labels = None
+
+    def _labels_for(self, node_name):
+        if self._node_labels is None:
+            self._node_labels = {n.name: n.labels for n in self.store.list("nodes")}
+        return self._node_labels.get(node_name, {})
+
+    def pods_matching(self, namespaces, selector):
+        for pod in self.store.list("pods"):
+            if pod.namespace not in namespaces:
+                continue
+            if selector is not None and not selector.matches(pod.metadata.labels):
+                continue
+            yield pod, self._labels_for(pod.node_name)
+
+    def pods_with_anti_affinity(self):
+        for pod in self.store.list("pods"):
+            if not pod.node_name:
+                continue
+            if (
+                pod.affinity
+                and pod.affinity.pod_anti_affinity
+                and pod.affinity.pod_anti_affinity.required
+            ):
+                yield pod, self._labels_for(pod.node_name)
+
+    def namespaces_matching(self, selector):
+        return [
+            ns.metadata.name
+            for ns in self.store.list("namespaces")
+            if selector.matches(ns.metadata.labels)
+        ]
+
+
+def nodepool_ready(np) -> bool:
+    conds = getattr(np.status, "conditions", None) or []
+    for c in conds:
+        ctype = c.type if hasattr(c, "type") else c.get("type")
+        status = c.status if hasattr(c, "status") else c.get("status")
+        if ctype == "Ready":
+            return status == "True"
+    return True
+
+
+class Provisioner:
+    def __init__(self, store, cloud, solver=None, clock=None, batcher=None, recorder=None, cluster=None):
+        from karpenter_tpu.utils.clock import Clock
+
+        self.store = store
+        self.cloud = cloud
+        self.clock = clock or Clock()
+        self.solver = solver or make_solver()
+        # production default: the reference's 1s idle / 10s max debounce
+        # window (options.go:96-97); test environments inject a 0/0 batcher
+        self.batcher = batcher or Batcher(self.clock)
+        self.recorder = recorder
+        self.cluster = cluster  # state plane (M4); optional
+
+    # -- triggering (provisioning/controller.go:52-107) ------------------
+    def on_event(self, event):
+        if event.kind == "pods":
+            pod = event.obj
+            if event.type != "Deleted" and pod_util.is_provisionable(pod):
+                self.batcher.trigger()
+        elif event.kind == "nodes" and event.type == "Modified":
+            if event.obj.metadata.deletion_timestamp is not None:
+                self.batcher.trigger()
+
+    def trigger(self):
+        self.batcher.trigger()
+
+    @property
+    def pending_trigger(self) -> bool:
+        return self.batcher.triggered and self.batcher.ready()
+
+    # -- the solve round (provisioner.go Schedule:316) -------------------
+    def reconcile(self) -> bool:
+        if not self.batcher.triggered:
+            return False
+        if not self.batcher.ready():
+            return False
+        self.batcher.reset()
+        if self.cluster is not None and not self.cluster.synced():
+            self.batcher.trigger()  # retry next round
+            return False
+        pods = self.pending_pods()
+        if not pods:
+            return False
+        results = self.schedule(pods)
+        return self.create_node_claims(results)
+
+    def pending_pods(self) -> list:
+        """Provisionable pods, excluding ones nominated onto capacity that
+        is still materializing (the reference's cluster-state nomination
+        serves this role, state/cluster.go Nominate)."""
+        out = []
+        for p in self.store.list("pods"):
+            if not pod_util.is_provisionable(p):
+                continue
+            if p.nominated_node_name:
+                nominated_alive = self.store.try_get(
+                    "nodes", p.nominated_node_name
+                ) is not None or any(
+                    nc.name == p.nominated_node_name
+                    for nc in self.store.list("nodeclaims")
+                )
+                if nominated_alive:
+                    continue  # capacity is materializing; the binder lands it
+                p.nominated_node_name = ""  # stale nomination: re-provision
+            out.append(p)
+        return out
+
+    def schedule(self, pods):
+        nodepools = [np for np in self.store.list("nodepools") if nodepool_ready(np)]
+        templates, its_by_pool, overhead, limits = [], {}, {}, {}
+        domains: dict = {}
+        for np in nodepools:
+            its = self.cloud.get_instance_types(np)
+            if not its:
+                continue
+            template = ClaimTemplate(np)
+            templates.append(template)
+            its_by_pool[np.name] = its
+            self._collect_domains(domains, template, its)
+            overhead[np.name] = self._daemon_overhead(template)
+            if np.spec.limits:
+                in_use = self._nodepool_usage(np)
+                limits[np.name] = {
+                    r: v - in_use.get(r, 0.0)
+                    for r, v in resutil.parse_resources(np.spec.limits).items()
+                }
+
+        existing_nodes = self._existing_nodes(templates)
+        topology = Topology(
+            cluster=StoreClusterView(self.store), domains=domains, pods=pods
+        )
+        results = self.solver.solve(
+            pods,
+            templates,
+            its_by_pool,
+            topology=topology,
+            existing_nodes=existing_nodes,
+            daemon_overhead=overhead,
+            limits=limits or None,
+        )
+        results.truncate_instance_types()
+        return results
+
+    def _collect_domains(self, domains, template, instance_types):
+        """Topology domain universe: values from instance-type requirements
+        compatible with the nodepool (provisioner.go:264-296)."""
+        np_reqs = template.requirements
+        for key, req in np_reqs.items():
+            if not req.complement:
+                domains.setdefault(key, set()).update(req.values)
+        for it in instance_types:
+            if it.requirements.intersects(np_reqs) is not None:
+                continue
+            for key, req in it.requirements.items():
+                if req.complement:
+                    continue
+                allowed = np_reqs.get_req(key)
+                vals = {v for v in req.values if allowed.has(v)}
+                if vals:
+                    domains.setdefault(key, set()).update(vals)
+
+    def _daemon_overhead(self, template) -> dict:
+        """Sum of daemonset pod requests that would land on this pool's
+        nodes (scheduler.go:335 getDaemonOverhead)."""
+        total: dict = {}
+        for ds in self.store.list("daemonsets"):
+            p = ds.template
+            if p is None:
+                continue
+            if Taints(template.taints).tolerates(p) is not None:
+                continue
+            if template.requirements.compatible(
+                pod_requirements(p), allow_undefined=wk.WELL_KNOWN_LABELS
+            ):
+                continue
+            total = resutil.merge(total, p.effective_requests())
+        return total
+
+    def _nodepool_usage(self, np) -> dict:
+        if np.status.resources:
+            return dict(np.status.resources)
+        total: dict = {}
+        for node in self.store.list("nodes"):
+            if node.labels.get(wk.NODEPOOL_LABEL) == np.name:
+                total = resutil.merge(total, node.capacity)
+        return total
+
+    def _existing_nodes(self, templates):
+        """In-flight capacity (M4 wires the state plane's StateNodes)."""
+        if self.cluster is None:
+            return []
+        return self.cluster.scheduling_nodes(templates)
+
+    # -- claim creation (provisioner.go CreateNodeClaims:149) ------------
+    def create_node_claims(self, results) -> bool:
+        created = False
+        for claim in results.new_claims:
+            nc = claim.to_node_claim()
+            self.store.create("nodeclaims", nc)
+            created = True
+            for p in claim.pods:
+                p.nominated_node_name = nc.name
+                self.store.update("pods", p)
+        for pod_key, err in results.pod_errors.items():
+            if self.recorder is not None:
+                self.recorder.publish(
+                    "FailedScheduling", f"pod {pod_key} incompatible: {err}"
+                )
+        return created

@@ -1,0 +1,2 @@
+from karpenter_tpu.kube.store import KubeStore, Event, ConflictError, NotFoundError, TooManyRequests  # noqa: F401
+from karpenter_tpu.kube.binder import Binder  # noqa: F401

@@ -1,0 +1,92 @@
+"""Minimal kube-scheduler stand-in for the hermetic cluster.
+
+The reference relies on the real kube-scheduler to bind pods onto nodes it
+launches (SURVEY.md §3.2 final step); its tests bind manually
+(expectations.go ExpectProvisioned:276). Our in-memory cluster needs an
+actual binder so the end-to-end loop closes: pending pods land on ready,
+compatible nodes — preferring the node they were nominated onto — and pods
+the binder cannot place get the Unschedulable condition the provisioner
+watches for.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.scheduling import Taints, label_requirements, pod_requirements
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.utils import pod as pod_util
+from karpenter_tpu.utils import resources as resutil
+
+
+class Binder:
+    def __init__(self, store):
+        self.store = store
+
+    def _fits(self, pod, node, available: dict) -> bool:
+        if not node.ready or node.unschedulable or node.metadata.deletion_timestamp:
+            return False
+        if Taints(t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")).tolerates(pod):
+            return False
+        node_reqs = label_requirements(node.labels)
+        if node_reqs.compatible(pod_requirements(pod), allow_undefined=wk.WELL_KNOWN_LABELS):
+            return False
+        return resutil.fits(pod.effective_requests(), available[node.name])
+
+    def bind_pending(self) -> int:
+        """One binding pass; returns the number of pods progressed."""
+        progressed = 0
+        nodes = {n.name: n for n in self.store.list("nodes")}
+        # availability computed once per pass, decremented as pods bind
+        used: dict = {name: {} for name in nodes}
+        for p in self.store.list("pods"):
+            if p.node_name in used and p.metadata.deletion_timestamp is None:
+                used[p.node_name] = resutil.merge(used[p.node_name], p.effective_requests())
+        available = {
+            name: resutil.subtract(nodes[name].allocatable, used[name]) for name in nodes
+        }
+
+        pending = [
+            p
+            for p in self.store.list("pods")
+            if not p.node_name and p.metadata.deletion_timestamp is None
+        ]
+        # nominated pods get first crack at their reserved capacity
+        pending.sort(key=lambda p: not p.nominated_node_name)
+        for pod in pending:
+            candidates = []
+            if pod.nominated_node_name and pod.nominated_node_name in nodes:
+                candidates.append(nodes[pod.nominated_node_name])
+            candidates.extend(n for n in nodes.values() if n.name != pod.nominated_node_name)
+            placed = False
+            for node in candidates:
+                if self._fits(pod, node, available):
+                    self.store.bind(pod, node.name)
+                    available[node.name] = resutil.subtract(
+                        available[node.name], pod.effective_requests()
+                    )
+                    progressed += 1
+                    placed = True
+                    break
+            if placed:
+                continue
+            target = nodes.get(pod.nominated_node_name)
+            if (
+                target is not None
+                and target.ready
+                and target.metadata.deletion_timestamp is None
+                and not any(t.key == wk.UNREGISTERED_TAINT_KEY for t in target.taints)
+            ):
+                # nominated node is settled but can no longer take the pod
+                # (capacity stolen) — drop the dead nomination so the
+                # provisioner re-solves
+                pod.nominated_node_name = ""
+                self.store.update("pods", pod)
+                progressed += 1
+            elif not pod_util.failed_to_schedule(pod):
+                # mark Unschedulable like the real scheduler would — this is
+                # the condition the provisioner watches for
+                pod.conditions.append(
+                    {"type": "PodScheduled", "status": "False", "reason": "Unschedulable"}
+                )
+                self.store.update("pods", pod)
+                progressed += 1
+        return progressed

@@ -1,0 +1,211 @@
+"""In-memory apiserver: the envtest/kwok analog.
+
+The reference's entire backend is client-go ↔ kube-apiserver (SURVEY.md §5
+"distributed communication backend"): watch streams, finalizer-gated
+deletion, the Eviction subresource, and leases. This store provides those
+semantics in-process so the full controller ring runs hermetically — the
+same role envtest (pkg/test/environment.go) plays for the reference's tier-1
+suites and kwok for its e2e tier.
+
+Semantics implemented:
+- resourceVersion bump per mutation (no optimistic-concurrency: callers
+  alias the stored instances, so controllers coordinate through the
+  synchronous reconcile loop rather than conflict retries)
+- deletion with finalizers: delete stamps deletion_timestamp; the object
+  disappears only when its finalizer list empties
+- watch events queued per mutation, drained by the controller manager
+- pod Eviction subresource honoring PDB disruptionsAllowed (429 analog)
+- pod binding (pod.node_name immutable once set)
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from karpenter_tpu.api.objects import ObjectMeta, PodDisruptionBudget
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class ConflictError(Exception):
+    pass
+
+
+class TooManyRequests(Exception):
+    """Eviction blocked by a PodDisruptionBudget (HTTP 429 analog)."""
+
+
+@dataclass
+class Event:
+    kind: str
+    type: str  # Added | Modified | Deleted
+    obj: object = None
+
+
+# kinds are plural lowercase, mirroring rest paths
+KINDS = (
+    "pods",
+    "nodes",
+    "nodepools",
+    "nodeclaims",
+    "daemonsets",
+    "deployments",
+    "pdbs",
+    "pvcs",
+    "storageclasses",
+    "namespaces",
+    "leases",
+    "events",
+)
+
+_NAMESPACED = {"pods", "daemonsets", "deployments", "pdbs", "pvcs", "leases", "events"}
+
+
+def _key(kind: str, obj) -> str:
+    meta = obj.metadata
+    return f"{meta.namespace}/{meta.name}" if kind in _NAMESPACED else meta.name
+
+
+class KubeStore:
+    def __init__(self, clock=None):
+        from karpenter_tpu.utils.clock import Clock
+
+        self.clock = clock or Clock()
+        self._objects: dict = {k: {} for k in KINDS}
+        self._rv = 0
+        self._events: list = []
+        self._lock = threading.RLock()
+
+    # -- core CRUD -------------------------------------------------------
+    def create(self, kind: str, obj):
+        with self._lock:
+            key = _key(kind, obj)
+            if key in self._objects[kind]:
+                raise ConflictError(f"{kind}/{key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self.clock.now()
+            self._objects[kind][key] = obj
+            self._events.append(Event(kind, "Added", obj))
+            return obj
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        with self._lock:
+            key = f"{namespace}/{name}" if kind in _NAMESPACED else name
+            obj = self._objects[kind].get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind}/{key}")
+            return obj
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def update(self, kind: str, obj):
+        with self._lock:
+            key = _key(kind, obj)
+            if key not in self._objects[kind]:
+                raise NotFoundError(f"{kind}/{key}")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[kind][key] = obj
+            self._events.append(Event(kind, "Modified", obj))
+            # finalizer-gated deletion completes on any update that empties
+            # the finalizer list after deletion was requested
+            self._maybe_finalize(kind, key, obj)
+            return obj
+
+    def delete(self, kind: str, obj_or_name, namespace: str = "default"):
+        with self._lock:
+            if isinstance(obj_or_name, str):
+                obj = self.get(kind, obj_or_name, namespace)
+            else:
+                obj = obj_or_name
+            key = _key(kind, obj)
+            if key not in self._objects[kind]:
+                raise NotFoundError(f"{kind}/{key}")
+            if obj.metadata.deletion_timestamp is None:
+                obj.metadata.deletion_timestamp = self.clock.now()
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                self._events.append(Event(kind, "Modified", obj))
+            self._maybe_finalize(kind, key, obj)
+
+    def _maybe_finalize(self, kind: str, key: str, obj):
+        if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+            del self._objects[kind][key]
+            self._events.append(Event(kind, "Deleted", obj))
+
+    def list(self, kind: str, namespace: str | None = None, predicate=None) -> list:
+        with self._lock:
+            out = list(self._objects[kind].values())
+        if namespace is not None:
+            out = [o for o in out if o.metadata.namespace == namespace]
+        if predicate is not None:
+            out = [o for o in out if predicate(o)]
+        return out
+
+    # -- watch -----------------------------------------------------------
+    def drain_events(self) -> list:
+        with self._lock:
+            events, self._events = self._events, []
+            return events
+
+    # -- pod subresources ------------------------------------------------
+    def bind(self, pod, node_name: str):
+        with self._lock:
+            if pod.node_name and pod.node_name != node_name:
+                raise ConflictError(f"pod {pod.key()} already bound to {pod.node_name}")
+            pod.node_name = node_name
+            pod.phase = "Running"
+            self.update("pods", pod)
+
+    def evict(self, pod):
+        """Eviction subresource: PDB-gated delete (the reference's terminator
+        drives this API, terminator/eviction.go:129-193)."""
+        with self._lock:
+            for pdb in self.list("pdbs", namespace=pod.namespace):
+                if pdb.selector is not None and pdb.selector.matches(pod.metadata.labels):
+                    if self._disruptions_allowed(pdb) <= 0:
+                        raise TooManyRequests(
+                            f"eviction of {pod.key()} blocked by pdb {pdb.metadata.name}"
+                        )
+            self.delete("pods", pod)
+
+    def _disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
+        pods = [
+            p
+            for p in self.list("pods", namespace=pdb.metadata.namespace)
+            if pdb.selector.matches(p.metadata.labels) and p.metadata.deletion_timestamp is None
+        ]
+        healthy = sum(1 for p in pods if p.phase == "Running")
+        if pdb.min_available is not None:
+            min_avail = _resolve_count(pdb.min_available, len(pods))
+            return max(healthy - min_avail, 0)
+        if pdb.max_unavailable is not None:
+            max_unavail = _resolve_count(pdb.max_unavailable, len(pods))
+            unhealthy = len(pods) - healthy
+            return max(max_unavail - unhealthy, 0)
+        return 1 << 30
+
+    # -- convenience for the volume layer --------------------------------
+    def get_pvc(self, namespace: str, name: str):
+        return self.try_get("pvcs", name, namespace)
+
+    def get_storage_class(self, name: str):
+        return self.try_get("storageclasses", name) if name else None
+
+
+def _resolve_count(value, total: int) -> int:
+    s = str(value)
+    if s.endswith("%"):
+        import math
+
+        return int(math.ceil(total * float(s[:-1]) / 100.0))
+    return int(s)

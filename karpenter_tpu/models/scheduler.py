@@ -106,7 +106,9 @@ class Scheduler:
 
     def solve(self, pods) -> SchedulerResults:
         # relaxation mutates pod specs in place; work on clones so a caller
-        # can re-solve the same input and get the same answer
+        # can re-solve the same input and get the same answer, but hand the
+        # caller's own objects back in the results
+        originals = {p.uid: p for p in pods}
         pods = [p.clone() for p in pods]
         errors: dict = {}
         pod_by_uid = {}
@@ -127,6 +129,10 @@ class Scheduler:
                 self.topology.update(pod)
         for claim in self.new_claims:
             claim.finalize()
+            claim.pods = [originals.get(p.uid, p) for p in claim.pods]
+        for node in self.existing_nodes:
+            if hasattr(node, "pods"):
+                node.pods = [originals.get(p.uid, p) for p in node.pods]
         pod_errors = {
             uid: err for uid, err in errors.items() if err is not None
         }

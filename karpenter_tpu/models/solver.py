@@ -90,9 +90,9 @@ class TPUSolver(Solver):
         limits=None,
         max_bins: int | None = None,
     ) -> SchedulerResults:
-        # Existing-node scheduling and topology join the device path in
-        # M4/M2; until then those snapshots route through the host loop.
-        has_topology = topology is not None and not isinstance(topology, NullTopology)
+        # Existing-node scheduling and topology-group waves join the device
+        # path incrementally; those snapshots route through the host loop.
+        has_topology = bool(getattr(topology, "has_groups", topology is not None and not isinstance(topology, NullTopology)))
         if existing_nodes or has_topology or not templates:
             return self.host.solve(
                 pods,

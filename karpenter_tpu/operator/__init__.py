@@ -1,0 +1,1 @@
+from karpenter_tpu.operator.environment import Environment  # noqa: F401

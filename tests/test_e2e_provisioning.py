@@ -1,0 +1,155 @@
+"""End-to-end provisioning slice: pending pods → batcher → solver →
+NodeClaims → kwok nodes → pods bound.
+
+This is the M3 milestone of SURVEY.md §7: the full loop the reference
+exercises through envtest + the fake/kwok providers
+(provisioning/suite_test.go), driven hermetically.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodeclaim import COND_INITIALIZED, COND_LAUNCHED, COND_REGISTERED
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    DaemonSet,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.operator import Environment
+
+GIB = 2**30
+
+
+def nodepool(name="default", **kw):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    for k, v in kw.items():
+        setattr(np_.spec.template, k, v)
+    return np_
+
+
+def pod(name, cpu=1.0, mem_gib=1.0, **kw):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+        requests={"cpu": cpu, "memory": mem_gib * GIB},
+        **kw,
+    )
+
+
+@pytest.fixture
+def env():
+    return Environment(
+        instance_types=[
+            make_instance_type("small", 2, 8),
+            make_instance_type("medium", 8, 32),
+            make_instance_type("large", 32, 128),
+        ]
+    )
+
+
+class TestEndToEnd:
+    def test_single_pod_provisions_and_binds(self, env):
+        env.create("nodepools", nodepool())
+        (p,) = env.provision(pod("p1"))
+        assert p.node_name, "pod not bound"
+        nodes = env.store.list("nodes")
+        assert len(nodes) == 1
+        claims = env.store.list("nodeclaims")
+        assert len(claims) == 1
+        claim = claims[0]
+        assert claim.is_true(COND_LAUNCHED)
+        assert claim.is_true(COND_REGISTERED)
+        assert claim.is_true(COND_INITIALIZED)
+        node = nodes[0]
+        assert node.labels[wk.NODEPOOL_LABEL] == "default"
+        assert wk.INSTANCE_TYPE_LABEL in node.labels
+        assert not any(t.key == wk.UNREGISTERED_TAINT_KEY for t in node.taints)
+
+    def test_no_nodepool_no_nodes(self, env):
+        (p,) = env.provision(pod("p1"))
+        assert not p.node_name
+        assert env.store.list("nodes") == []
+
+    def test_batch_packs_pods(self, env):
+        env.create("nodepools", nodepool())
+        pods = env.provision(*[pod(f"p{i}", cpu=0.5, mem_gib=0.5) for i in range(20)])
+        assert all(p.node_name for p in pods)
+        # 20 x 0.5cpu fits one large node
+        assert len(env.store.list("nodes")) == 1
+
+    def test_new_pods_after_quiesce_trigger_again(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        assert len(env.store.list("nodes")) == 1
+        env.provision(pod("p2", cpu=30))  # needs a new large node
+        assert len(env.store.list("nodes")) == 2
+
+    def test_daemonset_overhead_reserved(self, env):
+        env.create("nodepools", nodepool())
+        ds_pod = Pod(metadata=ObjectMeta(name="ds-template"), requests={"cpu": 1.5, "memory": 1 * GIB})
+        env.create("daemonsets", DaemonSet(metadata=ObjectMeta(name="logging"), template=ds_pod))
+        (p,) = env.provision(pod("p1", cpu=1.0))
+        assert p.node_name
+        node = env.store.list("nodes")[0]
+        # 1.0 pod + 1.5 daemonset won't fit the small (2cpu) type
+        assert node.labels[wk.INSTANCE_TYPE_LABEL] != "small"
+
+    def test_taints_and_tolerations(self, env):
+        env.create(
+            "nodepools",
+            nodepool(name="tainted", taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")]),
+        )
+        plain, tolerant = pod("plain"), pod(
+            "tolerant", tolerations=[Toleration(key="dedicated", value="infra")]
+        )
+        env.provision(plain, tolerant)
+        assert tolerant.node_name and not plain.node_name
+
+    def test_zonal_spread_e2e(self, env):
+        env.create("nodepools", nodepool())
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.TOPOLOGY_ZONE_LABEL,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+        pods = env.provision(
+            *[
+                pod(f"p{i}", cpu=3.0, labels={"app": "web"}, topology_spread_constraints=[tsc])
+                for i in range(6)
+            ]
+        )
+        assert all(p.node_name for p in pods)
+        zones = {}
+        for p in pods:
+            node = env.store.get("nodes", p.node_name)
+            zones[node.labels[wk.TOPOLOGY_ZONE_LABEL]] = zones.get(node.labels[wk.TOPOLOGY_ZONE_LABEL], 0) + 1
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_limits_block_runaway(self, env):
+        np_ = nodepool()
+        np_.spec.limits = {"cpu": 34.0}
+        env.create("nodepools", np_)
+        pods = env.provision(*[pod(f"p{i}", cpu=20) for i in range(4)])
+        bound = [p for p in pods if p.node_name]
+        assert len(bound) == 1
+        assert len(env.store.list("nodes")) == 1
+
+    def test_insufficient_capacity_terminal(self, env):
+        env.create("nodepools", nodepool())
+        (p,) = env.provision(pod("huge", cpu=1000))
+        assert not p.node_name
+        assert env.store.list("nodeclaims") == []
+        assert env.store.list("nodes") == []
+
+    def test_nominated_node_is_used(self, env):
+        env.create("nodepools", nodepool())
+        (p,) = env.provision(pod("p1"))
+        claim = env.store.list("nodeclaims")[0]
+        assert p.nominated_node_name == claim.name
+        assert p.node_name == claim.name
