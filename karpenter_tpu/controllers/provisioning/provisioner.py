@@ -15,7 +15,7 @@ from karpenter_tpu.controllers.provisioning.batcher import Batcher
 from karpenter_tpu.models import ClaimTemplate
 from karpenter_tpu.models.solver import make_solver
 from karpenter_tpu.models.topology import Topology
-from karpenter_tpu.scheduling import Taints, pod_requirements
+from karpenter_tpu.scheduling import daemon_schedulable
 from karpenter_tpu.utils import pod as pod_util
 from karpenter_tpu.utils import resources as resutil
 
@@ -111,10 +111,9 @@ class Provisioner:
         if self.cluster is not None and not self.cluster.synced():
             self.batcher.trigger()  # retry next round
             return False
-        pods = self.pending_pods()
-        if not pods:
+        results = self.schedule()
+        if results is None:
             return False
-        results = self.schedule(pods)
         return self.create_node_claims(results)
 
     def pending_pods(self) -> list:
@@ -138,7 +137,17 @@ class Provisioner:
             out.append(p)
         return out
 
-    def schedule(self, pods):
+    def schedule(self, pods=None):
+        # nodes are snapshotted BEFORE pods are listed: a pod that binds in
+        # between appears both as pending and in its node's usage, which
+        # over-provisions (safe); the reverse order would under-provision
+        # (provisioner.go:318-329)
+        state_nodes = self.cluster.nodes() if self.cluster is not None else []
+        if pods is None:
+            pods = self.pending_pods()
+            pods.extend(self.deleting_node_pods(state_nodes, pods))
+            if not pods:
+                return None
         nodepools = [np for np in self.store.list("nodepools") if nodepool_ready(np)]
         templates, its_by_pool, overhead, limits = [], {}, {}, {}
         domains: dict = {}
@@ -158,10 +167,10 @@ class Provisioner:
                     for r, v in resutil.parse_resources(np.spec.limits).items()
                 }
 
-        existing_nodes = self._existing_nodes(templates)
         topology = Topology(
             cluster=StoreClusterView(self.store), domains=domains, pods=pods
         )
+        existing_nodes = self._existing_nodes(state_nodes, topology)
         results = self.solver.solve(
             pods,
             templates,
@@ -200,10 +209,8 @@ class Provisioner:
             p = ds.template
             if p is None:
                 continue
-            if Taints(template.taints).tolerates(p) is not None:
-                continue
-            if template.requirements.compatible(
-                pod_requirements(p), allow_undefined=wk.WELL_KNOWN_LABELS
+            if not daemon_schedulable(
+                p, template.taints, template.requirements, allow_undefined=wk.WELL_KNOWN_LABELS
             ):
                 continue
             total = resutil.merge(total, p.effective_requests())
@@ -218,11 +225,41 @@ class Provisioner:
                 total = resutil.merge(total, node.capacity)
         return total
 
-    def _existing_nodes(self, templates):
-        """In-flight capacity (M4 wires the state plane's StateNodes)."""
-        if self.cluster is None:
-            return []
-        return self.cluster.scheduling_nodes(templates)
+    def deleting_node_pods(self, state_nodes, already: list) -> list:
+        """Reschedulable pods bound to nodes being drained or marked for
+        deletion: capacity must be pre-provisioned for them
+        (provisioner.go:340 GetPodsFromNodes)."""
+        seen = {p.uid for p in already}
+        out = []
+        for sn in state_nodes:
+            if not (sn.deleting() or sn.marked_for_deletion):
+                continue
+            for p in sn.reschedulable_pods():
+                if p.uid not in seen:
+                    out.append(p)
+        return out
+
+    def _existing_nodes(self, state_nodes, topology):
+        """Existing/in-flight capacity as scheduling targets, each carrying
+        the daemonset requests that will land on it (scheduler.go
+        NewScheduler's per-node daemon filtering)."""
+        from karpenter_tpu.models.existing import ExistingNode
+
+        from karpenter_tpu.scheduling import label_requirements
+
+        daemons = [ds.template for ds in self.store.list("daemonsets") if ds.template is not None]
+        out = []
+        for sn in state_nodes:
+            if sn.marked_for_deletion or sn.deleting():
+                continue
+            taints = sn.taints()
+            node_reqs = label_requirements(sn.labels()) if daemons else None
+            daemon_resources: dict = {}
+            for p in daemons:
+                if daemon_schedulable(p, taints, node_reqs):
+                    daemon_resources = resutil.merge(daemon_resources, p.effective_requests())
+            out.append(ExistingNode(sn, topology, daemon_resources, kube=self.store))
+        return out
 
     # -- claim creation (provisioner.go CreateNodeClaims:149) ------------
     def create_node_claims(self, results) -> bool:
@@ -232,8 +269,21 @@ class Provisioner:
             self.store.create("nodeclaims", nc)
             created = True
             for p in claim.pods:
+                if p.node_name:
+                    continue  # drain pre-provisioning: pod is still bound
                 p.nominated_node_name = nc.name
                 self.store.update("pods", p)
+        # pods placed on existing capacity are nominated so the next solve
+        # round doesn't re-provision for them (Results.Record, scheduler.go:96)
+        for node in results.existing_nodes:
+            pods = getattr(node, "scheduled_pods", None) or []
+            for p in pods:
+                if p.node_name:
+                    continue  # drain pre-provisioning: pod is still bound
+                p.nominated_node_name = node.name
+                self.store.update("pods", p)
+            if pods and self.cluster is not None:
+                self.cluster.nominate(node.name)
         for pod_key, err in results.pod_errors.items():
             if self.recorder is not None:
                 self.recorder.publish(

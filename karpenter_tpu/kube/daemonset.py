@@ -1,0 +1,53 @@
+"""DaemonSet controller stand-in for the hermetic cluster.
+
+The reference's envtest has no controller-manager, so daemon pods never
+materialize there either — but our end-to-end loop models node capacity
+consumption, and daemonset overhead is only real if daemon pods actually
+occupy nodes. This stamps one pod per (daemonset, eligible node), bound
+directly, the way the real daemonset controller + default scheduler would.
+Eligibility mirrors the provisioner's overhead filter: tolerates the node's
+taints and the node's labels satisfy the template's requirements.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.scheduling import daemon_schedulable, label_requirements
+
+
+class DaemonSetController:
+    def __init__(self, store):
+        self.store = store
+
+    def on_event(self, event):
+        pass
+
+    def poll(self) -> bool:
+        progressed = False
+        nodes = [
+            n
+            for n in self.store.list("nodes")
+            if n.ready and n.metadata.deletion_timestamp is None
+        ]
+        for ds in self.store.list("daemonsets"):
+            if ds.template is None:
+                continue
+            for node in nodes:
+                name = f"{ds.metadata.name}-{node.name}"
+                if self.store.try_get("pods", name, ds.metadata.namespace) is not None:
+                    continue
+                tmpl = ds.template
+                if not daemon_schedulable(tmpl, node.taints, label_requirements(node.labels)):
+                    continue
+                p = tmpl.clone()
+                p.metadata.name = name
+                p.metadata.namespace = ds.metadata.namespace
+                from karpenter_tpu.api.objects import new_uid
+
+                p.metadata.uid = new_uid("dspod")
+                p.metadata.owner_references = [
+                    {"kind": "DaemonSet", "name": ds.metadata.name, "controller": True}
+                ]
+                self.store.create("pods", p)
+                self.store.bind(p, node.name)
+                progressed = True
+        return progressed

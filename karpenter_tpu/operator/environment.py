@@ -14,6 +14,7 @@ from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
 from karpenter_tpu.controllers.nodeclaim.lifecycle import NodeClaimLifecycleController
 from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
 from karpenter_tpu.kube import Binder, KubeStore
+from karpenter_tpu.state import Cluster
 from karpenter_tpu.utils.clock import FakeClock
 
 
@@ -25,13 +26,22 @@ class Environment:
         self.store = KubeStore(self.clock)
         self.cloud = cloud or KwokCloudProvider(self.store, instance_types)
         self.binder = Binder(self.store)
+        self.cluster = Cluster(self.store, clock=self.clock)
         # sync mode collapses the batch window so tests drive deterministically
         batcher = Batcher(self.clock, idle_duration=0.0, max_duration=0.0) if sync else None
         self.provisioner = Provisioner(
-            self.store, self.cloud, solver=solver, clock=self.clock, batcher=batcher
+            self.store,
+            self.cloud,
+            solver=solver,
+            clock=self.clock,
+            batcher=batcher,
+            cluster=self.cluster,
         )
+        from karpenter_tpu.kube.daemonset import DaemonSetController
+
         self.controllers = [
             NodeClaimLifecycleController(self.store, self.cloud, clock=self.clock),
+            DaemonSetController(self.store),
         ]
 
     def run_until_idle(self, max_rounds: int = 100) -> int:
@@ -40,6 +50,9 @@ class Environment:
         for rounds in range(1, max_rounds + 1):
             progressed = False
             for event in self.store.drain_events():
+                # informer layer first: state must mirror the event before
+                # any controller acts on it (state/informer/*)
+                self.cluster.on_event(event)
                 self.provisioner.on_event(event)
                 for c in self.controllers:
                     c.on_event(event)

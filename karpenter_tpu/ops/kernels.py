@@ -283,6 +283,9 @@ def solve_step(args: dict, max_bins: int) -> dict:
     """The full single-call solve: feasibility + pack over one snapshot's
     arg dict (the canonical invocation shared by the solver, the sharded
     path, and the graft entry)."""
+    # device arrays throughout: the scan body indexes these with traced
+    # values, which numpy inputs cannot satisfy when called outside jit
+    args = {k: jnp.asarray(v) for k, v in args.items()}
     F, price, tmpl_full = feasibility(
         args["g_mask"], args["g_has"], args["g_demand"],
         args["t_mask"], args["t_has"], args["t_alloc"],

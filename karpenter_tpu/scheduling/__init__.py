@@ -16,3 +16,19 @@ from karpenter_tpu.scheduling.requirements import (  # noqa: F401
 from karpenter_tpu.scheduling.taints import Taints, KNOWN_EPHEMERAL_TAINTS  # noqa: F401
 from karpenter_tpu.scheduling.hostports import HostPortUsage  # noqa: F401
 from karpenter_tpu.scheduling.volumes import VolumeUsage  # noqa: F401
+
+
+def daemon_schedulable(template_pod, taints, requirements, allow_undefined=None) -> bool:
+    """Would this daemonset pod template land on a node with the given
+    taints and requirements? The single predicate behind daemon-overhead
+    reservation (scheduler.go getDaemonOverhead) and the hermetic daemonset
+    controller — they must agree or simulated reservations diverge from
+    stamped pods."""
+    if Taints(taints).tolerates(template_pod) is not None:
+        return False
+    return (
+        requirements.compatible(
+            pod_requirements(template_pod), allow_undefined=allow_undefined
+        )
+        is None
+    )

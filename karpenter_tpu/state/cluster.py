@@ -1,0 +1,247 @@
+"""Cluster: the in-memory mirror of apiserver state.
+
+Behavioral mirror of the reference's pkg/controllers/state/cluster.go:47-84:
+nodes and nodeclaims merged by providerID into StateNodes, pod→node
+bindings, an anti-affinity pod index, nominations, MarkedForDeletion, and a
+consolidation-state timestamp (`mark_unconsolidated`/`consolidation_state`,
+cluster.go:310-337). `synced()` is the superset gate (cluster.go:85-127):
+every apiserver NodeClaim/Node must be represented in memory before the
+provisioner or the disruption controller may solve.
+
+Events flow in through `on_event` (the informer layer,
+state/informer/{pod,node,nodeclaim}.go collapsed into one method — our
+hermetic runtime has a single watch stream).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.state.statenode import StateNode
+from karpenter_tpu.utils import pod as pod_util
+
+_anon_counter = itertools.count(1)
+
+
+class Cluster:
+    def __init__(self, store, clock=None):
+        from karpenter_tpu.utils.clock import Clock
+
+        self.store = store
+        self.clock = clock or Clock()
+        self._nodes: dict = {}  # provider_id -> StateNode
+        self._node_name_to_pid: dict = {}  # node name -> provider_id
+        self._claim_name_to_pid: dict = {}  # claim name -> provider_id
+        self._bindings: dict = {}  # pod key -> node name
+        self._antiaffinity_pods: dict = {}  # pod key -> Pod (bound, w/ required anti-affinity)
+        self._consolidated_at: float = 0.0
+
+    # -- informer entry point -------------------------------------------
+    def on_event(self, event):
+        kind, typ, obj = event.kind, event.type, event.obj
+        if kind == "nodes":
+            if typ == "Deleted":
+                self.delete_node(obj)
+            else:
+                self.update_node(obj)
+        elif kind == "nodeclaims":
+            if typ == "Deleted":
+                self.delete_node_claim(obj)
+            else:
+                self.update_node_claim(obj)
+        elif kind == "pods":
+            if typ == "Deleted":
+                self.delete_pod(obj)
+            else:
+                self.update_pod(obj)
+        elif kind == "nodepools":
+            # any nodepool change can change the consolidation answer
+            self.mark_unconsolidated()
+
+    # -- node / claim tracking (cluster.go UpdateNode/UpdateNodeClaim) ---
+    def _state_for(self, provider_id: str) -> StateNode:
+        if not provider_id:
+            provider_id = f"anon-{next(_anon_counter)}"
+        sn = self._nodes.get(provider_id)
+        if sn is None:
+            sn = StateNode(provider_id)
+            self._nodes[provider_id] = sn
+        return sn
+
+    def update_node(self, node):
+        pid = node.provider_id or node.name
+        old_pid = self._node_name_to_pid.get(node.name)
+        if old_pid is not None and old_pid != pid:
+            old = self._nodes.get(old_pid)
+            if old is not None:
+                old.node = None
+                self._gc(old_pid)
+        sn = self._state_for(pid)
+        sn.node = node
+        self._node_name_to_pid[node.name] = pid
+        self.mark_unconsolidated()
+        return sn
+
+    def delete_node(self, node):
+        pid = self._node_name_to_pid.pop(node.name, None)
+        if pid is None:
+            return
+        sn = self._nodes.get(pid)
+        if sn is not None:
+            sn.node = None
+            self._gc(pid)
+        self.mark_unconsolidated()
+
+    def update_node_claim(self, claim):
+        pid = claim.status.provider_id or claim.name
+        old_pid = self._claim_name_to_pid.get(claim.name)
+        if old_pid is not None and old_pid != pid:
+            # claim gained its providerID: re-key (cluster.go updates by
+            # provider id once launched)
+            old = self._nodes.pop(old_pid, None)
+            if old is not None:
+                old.provider_id = pid
+                existing = self._nodes.get(pid)
+                if existing is not None:
+                    existing.node_claim = claim
+                    existing.marked_for_deletion |= old.marked_for_deletion
+                else:
+                    self._nodes[pid] = old
+        sn = self._state_for(pid)
+        sn.node_claim = claim
+        self._claim_name_to_pid[claim.name] = pid
+        self.mark_unconsolidated()
+        return sn
+
+    def delete_node_claim(self, claim):
+        pid = self._claim_name_to_pid.pop(claim.name, None)
+        if pid is None:
+            return
+        sn = self._nodes.get(pid)
+        if sn is not None:
+            sn.node_claim = None
+            self._gc(pid)
+        self.mark_unconsolidated()
+
+    def _gc(self, pid: str):
+        sn = self._nodes.get(pid)
+        if sn is not None and sn.node is None and sn.node_claim is None:
+            del self._nodes[pid]
+
+    # -- pod tracking (cluster.go UpdatePod:284) -------------------------
+    def update_pod(self, pod):
+        key = pod.key()
+        if pod_util.is_terminal(pod) or pod.metadata.deletion_timestamp is not None:
+            self.delete_pod(pod)
+            return
+        bound = self._bindings.get(key)
+        if bound is not None and bound != pod.node_name:
+            self._unbind(key, bound)
+            bound = None
+        if pod.node_name and bound is None:
+            self._bindings[key] = pod.node_name
+            sn = self._node_by_name(pod.node_name)
+            if sn is not None:
+                sn.pods[key] = pod
+                sn.host_port_usage.add(pod)
+                sn.volume_usage.add(pod, kube=self.store)
+            if (
+                pod.affinity
+                and pod.affinity.pod_anti_affinity
+                and pod.affinity.pod_anti_affinity.required
+            ):
+                self._antiaffinity_pods[key] = pod
+            self.mark_unconsolidated()
+        elif pod.node_name and bound == pod.node_name:
+            sn = self._node_by_name(pod.node_name)
+            if sn is not None:
+                sn.pods[key] = pod  # refresh the stored object
+
+    def delete_pod(self, pod):
+        key = pod.key()
+        bound = self._bindings.pop(key, None)
+        if bound is not None:
+            self._unbind(key, bound)
+        self._antiaffinity_pods.pop(key, None)
+        self.mark_unconsolidated()
+
+    def _unbind(self, key: str, node_name: str):
+        sn = self._node_by_name(node_name)
+        if sn is not None:
+            sn.pods.pop(key, None)
+            sn.host_port_usage.remove(key)
+            sn.volume_usage.remove(key)
+
+    def _node_by_name(self, name: str):
+        pid = self._node_name_to_pid.get(name)
+        if pid is not None:
+            return self._nodes.get(pid)
+        # a claim whose node hasn't appeared yet may already carry the name
+        for sn in self._nodes.values():
+            if sn.name == name:
+                return sn
+        return None
+
+    # -- views -----------------------------------------------------------
+    def nodes(self) -> list:
+        """Snapshot of all StateNodes (deep-enough copies; the scheduler and
+        the disruption simulation mutate them, cluster.go Nodes())."""
+        return [sn.snapshot() for sn in self._nodes.values()]
+
+    def node_for(self, provider_id: str):
+        return self._nodes.get(provider_id)
+
+    def node_by_name(self, name: str):
+        return self._node_by_name(name)
+
+    def bound_node(self, pod) -> str | None:
+        return self._bindings.get(pod.key())
+
+    def pods_with_anti_affinity(self):
+        for pod in self._antiaffinity_pods.values():
+            node = self._node_by_name(pod.node_name)
+            yield pod, (node.labels() if node is not None else {})
+
+    # -- synced gate (cluster.go Synced:85) ------------------------------
+    def synced(self) -> bool:
+        for claim in self.store.list("nodeclaims"):
+            if not claim.launched:
+                continue  # nothing to mirror yet
+            if claim.name not in self._claim_name_to_pid:
+                return False
+        for node in self.store.list("nodes"):
+            if node.name not in self._node_name_to_pid:
+                return False
+        return True
+
+    # -- nomination (cluster.go NominateNodeForPod) ----------------------
+    def nominate(self, node_name: str):
+        sn = self._node_by_name(node_name)
+        if sn is not None:
+            sn.nominate(self.clock.now())
+
+    # -- deletion marks (cluster.go MarkForDeletion) ---------------------
+    def mark_for_deletion(self, *provider_ids):
+        for pid in provider_ids:
+            sn = self._nodes.get(pid)
+            if sn is not None:
+                sn.marked_for_deletion = True
+        self.mark_unconsolidated()
+
+    def unmark_for_deletion(self, *provider_ids):
+        for pid in provider_ids:
+            sn = self._nodes.get(pid)
+            if sn is not None:
+                sn.marked_for_deletion = False
+        self.mark_unconsolidated()
+
+    # -- consolidation timestamp (cluster.go:310-337) --------------------
+    def mark_unconsolidated(self) -> float:
+        self._consolidated_at = self.clock.now()
+        return self._consolidated_at
+
+    def consolidation_state(self) -> float:
+        """A timestamp fencing consolidation decisions: a command computed
+        against state older than the latest mutation must revalidate."""
+        return self._consolidated_at

@@ -47,8 +47,15 @@ def is_provisionable(pod) -> bool:
 
 def is_reschedulable(pod) -> bool:
     """scheduling.go IsReschedulable:42 — counts toward capacity we must
-    recreate when disrupting its node."""
-    return not is_terminal(pod) and not is_terminating(pod) and not is_owned_by_node(pod)
+    recreate when disrupting its node. Daemonset pods are excluded: the
+    daemonset controller recreates them on the replacement node, and their
+    requests are already reserved as daemon overhead."""
+    return (
+        not is_terminal(pod)
+        and not is_terminating(pod)
+        and not is_owned_by_node(pod)
+        and not is_owned_by_daemonset(pod)
+    )
 
 
 def is_evictable(pod) -> bool:
