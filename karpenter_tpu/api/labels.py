@@ -35,6 +35,7 @@ CAPACITY_TYPE_LABEL = f"{GROUP}/capacity-type"
 DO_NOT_DISRUPT_ANNOTATION = f"{GROUP}/do-not-disrupt"
 NODEPOOL_HASH_ANNOTATION = f"{GROUP}/nodepool-hash"
 NODEPOOL_HASH_VERSION_ANNOTATION = f"{GROUP}/nodepool-hash-version"
+NODEPOOL_HASH_VERSION = "v2"  # current static-hash protocol version
 MANAGED_BY_ANNOTATION = f"{GROUP}/managed-by"
 
 # finalizers

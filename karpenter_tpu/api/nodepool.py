@@ -66,7 +66,10 @@ class Budget:
             return total_nodes  # inactive budget imposes no cap
         s = str(self.nodes).strip()
         if s.endswith("%"):
-            return int(math.floor(total_nodes * float(s[:-1]) / 100.0))
+            # percentages round UP (intstr.GetScaledValueFromIntOrPercent
+            # with roundUp=true in GetAllowedDisruptions): a 10% budget on a
+            # 1-node pool still allows one disruption
+            return int(math.ceil(total_nodes * float(s[:-1]) / 100.0))
         return int(s)
 
 

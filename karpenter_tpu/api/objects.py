@@ -262,6 +262,16 @@ class DaemonSet:
 
 
 @dataclass
+class Deployment:
+    """Replica workload: the hermetic runtime's replicaset analog — evicted
+    pods are recreated so drains actually displace work."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    replicas: int = 1
+    template: "Pod" = None
+
+
+@dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: LabelSelector = field(default_factory=LabelSelector)

@@ -1,0 +1,4 @@
+from karpenter_tpu.controllers.disruption.controller import DisruptionController
+from karpenter_tpu.controllers.disruption.types import Candidate, Command
+
+__all__ = ["DisruptionController", "Candidate", "Command"]

@@ -1,0 +1,203 @@
+"""The disruption singleton controller.
+
+Mirror of the reference's pkg/controllers/disruption/controller.go: a 10 s
+polling loop (:65) that — after the cluster-state sync gate (:116) and
+idempotent cleanup of taints left by a dead process (:121-128) — tries each
+method in order, executing the first command produced (:130-141). Commands
+from consolidation methods are held for a validation TTL (15 s,
+consolidation.go:44) and revalidated against fresh state before execution
+(validation.go:55-212); our synchronous runtime models the reference's
+blocking TTL wait as a pending-command slot re-checked on later polls.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api.nodepool import REASON_EMPTY
+from karpenter_tpu.controllers.disruption.helpers import (
+    build_disruption_budgets,
+    get_candidates,
+    simulate_scheduling,
+)
+from karpenter_tpu.controllers.disruption.methods import (
+    Drift,
+    Emptiness,
+    EmptyNodeConsolidation,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.disruption.queue import (
+    OrchestrationQueue,
+    add_disruption_taint,
+)
+
+POLL_PERIOD = 10.0  # controller.go:65
+VALIDATION_TTL = 15.0  # consolidation.go:44
+
+
+class DisruptionContext:
+    def __init__(self, provisioner, cluster, store, clock, options=None):
+        self.provisioner = provisioner
+        self.cluster = cluster
+        self.store = store
+        self.clock = clock
+        self.options = options or {}
+
+
+class DisruptionController:
+    def __init__(
+        self,
+        store,
+        cluster,
+        cloud,
+        provisioner,
+        clock=None,
+        recorder=None,
+        options=None,
+        poll_period: float = POLL_PERIOD,
+        validation_ttl: float = VALIDATION_TTL,
+    ):
+        from karpenter_tpu.utils.clock import Clock
+
+        self.store = store
+        self.cluster = cluster
+        self.cloud = cloud
+        self.provisioner = provisioner
+        self.clock = clock or Clock()
+        self.recorder = recorder
+        self.poll_period = poll_period
+        self.validation_ttl = validation_ttl
+        self.ctx = DisruptionContext(provisioner, cluster, store, self.clock, options)
+        self.queue = OrchestrationQueue(store, cluster, self.clock, recorder)
+        self.methods = [
+            Drift(self.ctx),
+            Emptiness(self.ctx),
+            EmptyNodeConsolidation(self.ctx),
+            MultiNodeConsolidation(self.ctx),
+            SingleNodeConsolidation(self.ctx),
+        ]
+        self._last_run: float = -1e18
+        self._pending = None  # (command, method, computed_at)
+        # fence from the last consolidation round that found nothing: while
+        # cluster state is unchanged, re-searching is pointless
+        # (consolidation.go isConsolidated)
+        self._noop_fence = None
+
+    def on_event(self, event):
+        pass
+
+    def poll(self) -> bool:
+        progressed = self.queue.poll()
+        now = self.clock.now()
+        if now - self._last_run < self.poll_period:
+            return progressed
+        self._last_run = now
+        if not self.cluster.synced():
+            return progressed
+        self._cleanup_orphan_taints()
+        if self._pending is not None:
+            return self._handle_pending() or progressed
+        return self._compute_round() or progressed
+
+    # -- taint hygiene (controller.go:121-128) ---------------------------
+    def _cleanup_orphan_taints(self):
+        from karpenter_tpu.api import labels as wk
+
+        queued = {
+            c.provider_id for cmd in self.queue.commands for c in cmd.candidates
+        }
+        for node in self.store.list("nodes"):
+            if not any(t.key == wk.DISRUPTION_TAINT_KEY for t in node.taints):
+                continue
+            sn = self.cluster.node_by_name(node.name)
+            pid = sn.provider_id if sn is not None else None
+            if pid not in queued and node.metadata.deletion_timestamp is None:
+                from karpenter_tpu.controllers.disruption.queue import (
+                    remove_disruption_taint,
+                )
+
+                remove_disruption_taint(self.store, node)
+
+    # -- the method ladder (controller.go:130-141) -----------------------
+    def _compute_round(self) -> bool:
+        candidates = get_candidates(
+            self.cluster, self.store, self.cloud, self.clock, queue=self.queue
+        )
+        if not candidates:
+            return False
+        budgets = build_disruption_budgets(self.cluster, self.store, self.clock)
+        fence = self.cluster.consolidation_state()
+        for method in self.methods:
+            if method.is_consolidation and fence == self._noop_fence:
+                continue  # nothing moved since the last fruitless search
+            cmd = method.compute_command(list(candidates), budgets)
+            if cmd is None or not cmd.candidates:
+                continue
+            if method.needs_validation:
+                self._pending = (cmd, method, self.clock.now())
+                return True
+            return self._execute(cmd)
+        self._noop_fence = fence
+        return False
+
+    # -- validation TTL (validation.go:55-212) ---------------------------
+    def _handle_pending(self) -> bool:
+        cmd, method, computed_at = self._pending
+        if self.clock.now() - computed_at < self.validation_ttl:
+            return False  # still inside the TTL window
+        self._pending = None
+        if not self._validate(cmd, method):
+            return True  # dropped; next round recomputes
+        return self._execute(cmd)
+
+    def _validate(self, cmd, method) -> bool:
+        """Re-check the command against fresh state (validation.go:67)."""
+        budgets = build_disruption_budgets(self.cluster, self.store, self.clock)
+        fresh = {
+            c.provider_id: c
+            for c in get_candidates(
+                self.cluster, self.store, self.cloud, self.clock, queue=self.queue
+            )
+        }
+        spent: dict = {}
+        for c in cmd.candidates:
+            fc = fresh.get(c.provider_id)
+            if fc is None:
+                return False  # candidate vanished or became non-disruptable
+            pool = fc.node_pool.name
+            spent[pool] = spent.get(pool, 0) + 1
+            if spent[pool] > budgets.get(pool, {}).get(method.reason, 0):
+                return False
+            if method.reason == REASON_EMPTY and fc.reschedulable_pods:
+                return False  # no longer empty
+        if cmd.replacements:
+            # re-simulate: the replacement types must still cover the need
+            # (validation.go:186: new sim's types ⊇ command's types)
+            sim = simulate_scheduling(
+                self.provisioner, self.cluster, self.store, list(cmd.candidates)
+            )
+            if not sim.all_pods_scheduled() or len(sim.new_claims) > len(cmd.replacements):
+                return False
+        return True
+
+    # -- execution (controller.go executeCommand:188) --------------------
+    def _execute(self, cmd) -> bool:
+        # 1. taint candidates so nothing schedules onto them (:196)
+        for c in cmd.candidates:
+            node = self.store.try_get("nodes", c.name)
+            if node is not None:
+                add_disruption_taint(self.store, node)
+        # 2. launch replacements (:203)
+        for claim in cmd.replacements:
+            nc = claim.to_node_claim()
+            self.store.create("nodeclaims", nc)
+            cmd.replacement_names.append(nc.name)
+        # 3. fence the state (:223)
+        self.cluster.mark_for_deletion(*[c.provider_id for c in cmd.candidates])
+        # 4. orchestrate deletion (:225)
+        self.queue.add(cmd)
+        if self.recorder is not None:
+            self.recorder.publish(
+                "DisruptionLaunching",
+                f"{cmd.reason}: {cmd.action} {[c.name for c in cmd.candidates]}",
+            )
+        return True

@@ -1,0 +1,114 @@
+"""Candidate discovery, budgets, and the counterfactual simulation.
+
+Mirror of the reference's pkg/controllers/disruption/helpers.go:
+`get_candidates` (:146-193) filters cluster state to disruptable nodes;
+`build_disruption_budgets` (:199-254) computes per-nodepool per-reason
+allowances net of nodes already disrupting; `simulate_scheduling` (:51-115)
+answers "if these nodes were gone, where would their pods go?" by running
+the full solver against the remaining state.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import ALL_REASONS
+from karpenter_tpu.controllers.disruption.types import Candidate
+from karpenter_tpu.utils import pod as pod_util
+from karpenter_tpu.utils.pdb import PdbLimits
+
+
+def get_candidates(cluster, store, cloud, clock, queue=None) -> list:
+    """Disruptable nodes as Candidates (helpers.go:146)."""
+    pdb_limits = PdbLimits(store)
+    pools = {np.name: np for np in store.list("nodepools")}
+    catalogs: dict = {}
+    out = []
+    for sn in cluster.nodes():
+        if sn.deleting() or sn.marked_for_deletion:
+            continue
+        if queue is not None and queue.has_candidate(sn.provider_id):
+            continue
+        if sn.nominated(clock.now()):
+            continue
+        if sn.validate_disruptable(pdb_limits) is not None:
+            continue
+        np = pools.get(sn.nodepool_name)
+        if np is None:
+            continue
+        if np.name not in catalogs:
+            catalogs[np.name] = {it.name: it for it in cloud.get_instance_types(np)}
+        it = catalogs[np.name].get(sn.labels().get(wk.INSTANCE_TYPE_LABEL, ""))
+        out.append(Candidate(sn, np, it, clock))
+    return out
+
+
+def build_disruption_budgets(cluster, store, clock) -> dict:
+    """nodepool name -> reason -> allowed disruptions (helpers.go:199)."""
+    totals: dict = {}
+    disrupting: dict = {}
+    for sn in cluster.nodes():
+        pool = sn.nodepool_name
+        if not pool:
+            continue
+        totals[pool] = totals.get(pool, 0) + 1
+        if sn.marked_for_deletion or sn.deleting() or not sn.initialized():
+            disrupting[pool] = disrupting.get(pool, 0) + 1
+    budgets: dict = {}
+    now = clock.now()
+    for np in store.list("nodepools"):
+        total = totals.get(np.name, 0)
+        already = disrupting.get(np.name, 0)
+        budgets[np.name] = {
+            reason: max(np.allowed_disruptions(reason, total, now) - already, 0)
+            for reason in ALL_REASONS
+        }
+    return budgets
+
+
+def within_budget(budgets: dict, reason: str, candidates) -> list:
+    """Longest prefix of candidates whose per-pool budgets all hold
+    (the reference trims candidate lists per nodepool budget)."""
+    spent: dict = {}
+    out = []
+    for c in candidates:
+        pool = c.node_pool.name
+        allowed = budgets.get(pool, {}).get(reason, 0)
+        if spent.get(pool, 0) + 1 > allowed:
+            continue
+        spent[pool] = spent.get(pool, 0) + 1
+        out.append(c)
+    return out
+
+
+class SimulationResults:
+    def __init__(self, results, candidate_pods):
+        self.results = results
+        self.candidate_pods = candidate_pods
+
+    @property
+    def new_claims(self):
+        return self.results.new_claims
+
+    def all_pods_scheduled(self) -> bool:
+        """Every reschedulable pod from the candidates found a home
+        (helpers.go:104: pods failing or landing nowhere block the
+        command)."""
+        placed = set()
+        for claim in self.results.new_claims:
+            placed.update(p.uid for p in claim.pods)
+        for node in self.results.existing_nodes:
+            placed.update(p.uid for p in getattr(node, "scheduled_pods", []) or [])
+        return all(p.uid in placed for p in self.candidate_pods)
+
+
+def simulate_scheduling(provisioner, cluster, store, candidates) -> SimulationResults:
+    """Counterfactual solve: cluster minus candidates (helpers.go:51)."""
+    excluded = {c.provider_id for c in candidates}
+    state_nodes = [sn for sn in cluster.nodes() if sn.provider_id not in excluded]
+    candidate_pods = [p for c in candidates for p in c.reschedulable_pods]
+    pending = [p for p in store.list("pods") if pod_util.is_provisionable(p)]
+    deleting = provisioner.deleting_node_pods(state_nodes, pending + candidate_pods)
+    results = provisioner.schedule(
+        pods=pending + candidate_pods + deleting, state_nodes=state_nodes
+    )
+    return SimulationResults(results, candidate_pods)

@@ -1,0 +1,235 @@
+"""Disruption methods, tried in order — first success wins.
+
+Mirror of the reference's method set (disruption/controller.go:80-91):
+Drift → Emptiness → EmptyNodeConsolidation → MultiNodeConsolidation →
+SingleNodeConsolidation. Consolidation shares `compute_consolidation`
+(consolidation.go:112-296): simulate, require every displaced pod to
+schedule, allow at most one replacement node, and apply the price filter
+(the replacement must be launchable strictly cheaper than what the
+candidates currently cost; spot→spot additionally requires the feature gate
+and ≥15 cheaper types to prevent churn).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodeclaim import COND_DRIFTED, COND_EMPTY
+from karpenter_tpu.api.nodepool import (
+    CONSOLIDATION_WHEN_EMPTY,
+    CONSOLIDATION_WHEN_UNDERUTILIZED,
+    REASON_DRIFTED,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+)
+from karpenter_tpu.controllers.disruption.helpers import (
+    simulate_scheduling,
+    within_budget,
+)
+from karpenter_tpu.controllers.disruption.types import Command
+
+MULTI_NODE_CANDIDATE_CAP = 100  # multinodeconsolidation.go:82
+SPOT_TO_SPOT_MIN_TYPES = 15  # consolidation.go:253-277
+
+
+class Method:
+    reason: str = ""
+    needs_validation: bool = False
+    # consolidation methods honor the isConsolidated fence: skipped while
+    # cluster state is unchanged since the last fruitless search
+    is_consolidation: bool = False
+
+    def __init__(self, ctx):
+        self.ctx = ctx  # DisruptionContext: provisioner, cluster, store, clock, options
+
+    def compute_command(self, candidates, budgets) -> Command | None:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+def _claim_condition(candidate, cond) -> bool:
+    claim = candidate.state_node.node_claim
+    return claim is not None and claim.is_true(cond)
+
+
+class Drift(Method):
+    """Replace nodes whose claims carry the Drifted condition
+    (disruption/drift.go:35-115)."""
+
+    reason = REASON_DRIFTED
+
+    def compute_command(self, candidates, budgets):
+        drifted = [c for c in candidates if _claim_condition(c, COND_DRIFTED)]
+        drifted.sort(
+            key=lambda c: (
+                c.state_node.node_claim.get_condition(COND_DRIFTED).last_transition_time
+            )
+        )
+        drifted = within_budget(budgets, self.reason, drifted)
+        if not drifted:
+            return None
+        # empty drifted candidates can all go at once, no simulation
+        empty = [c for c in drifted if not c.reschedulable_pods]
+        if empty:
+            return Command(empty, reason=self.reason)
+        # else one at a time, with replacement simulation
+        for c in drifted:
+            sim = simulate_scheduling(
+                self.ctx.provisioner, self.ctx.cluster, self.ctx.store, [c]
+            )
+            if not sim.all_pods_scheduled():
+                continue
+            return Command([c], replacements=sim.new_claims, reason=self.reason)
+        return None
+
+
+class Emptiness(Method):
+    """Delete nodes empty for ≥ consolidateAfter under WhenEmpty
+    (disruption/emptiness.go:32-85). No simulation."""
+
+    reason = REASON_EMPTY
+
+    def compute_command(self, candidates, budgets):
+        clock = self.ctx.clock
+        empty = []
+        for c in candidates:
+            if c.node_pool.spec.disruption.consolidation_policy != CONSOLIDATION_WHEN_EMPTY:
+                continue
+            claim = c.state_node.node_claim
+            if claim is None or not claim.is_true(COND_EMPTY):
+                continue
+            if c.reschedulable_pods:
+                continue
+            wait = c.node_pool.spec.disruption.consolidate_after or 0.0
+            since = claim.get_condition(COND_EMPTY).last_transition_time
+            if clock.now() - since < wait:
+                continue
+            empty.append(c)
+        empty = within_budget(budgets, self.reason, empty)
+        if not empty:
+            return None
+        return Command(empty, reason=self.reason)
+
+
+def _consolidatable(candidates):
+    out = []
+    for c in candidates:
+        d = c.node_pool.spec.disruption
+        if d.consolidation_policy != CONSOLIDATION_WHEN_UNDERUTILIZED:
+            continue
+        out.append(c)
+    return out
+
+
+class EmptyNodeConsolidation(Method):
+    """Bulk-delete empty nodes under WhenUnderutilized
+    (disruption/emptynodeconsolidation.go:30-115)."""
+
+    reason = REASON_EMPTY
+    needs_validation = True
+    is_consolidation = True
+
+    def compute_command(self, candidates, budgets):
+        empty = [c for c in _consolidatable(candidates) if not c.reschedulable_pods]
+        empty = within_budget(budgets, self.reason, empty)
+        if not empty:
+            return None
+        return Command(empty, reason=self.reason)
+
+
+def candidate_prices(candidates) -> float:
+    return sum(c.price for c in candidates)
+
+
+def compute_consolidation(ctx, candidates) -> Command | None:
+    """Shared consolidation core (consolidation.go:112-296)."""
+    sim = simulate_scheduling(ctx.provisioner, ctx.cluster, ctx.store, candidates)
+    if not sim.all_pods_scheduled():
+        return None
+    if len(sim.new_claims) == 0:
+        return Command(candidates, reason=REASON_UNDERUTILIZED)
+    if len(sim.new_claims) > 1:
+        return None  # m→1 replacement only (consolidation.go:164)
+
+    replacement = sim.new_claims[0]
+    current_price = candidate_prices(candidates)
+    all_spot = all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates)
+
+    # the replacement must launch strictly cheaper than the candidates cost
+    # now: filter its instance types to the cheaper-than-current set
+    # (consolidation.go filterByPrice:210)
+    cheaper = []
+    for it in replacement.instance_types:
+        ofs = it.offerings.available().compatible(replacement.requirements)
+        if all_spot:
+            # spot→spot: compare within spot offerings only
+            ofs = type(ofs)(o for o in ofs if o.capacity_type == wk.CAPACITY_TYPE_SPOT)
+        if ofs and min(o.price for o in ofs) < current_price:
+            cheaper.append(it)
+    if not cheaper:
+        return None
+
+    if all_spot:
+        if not ctx.options.get("spot_to_spot_consolidation", False):
+            return None  # feature-gated (consolidation.go:214)
+        if len(candidates) == 1 and len(cheaper) < SPOT_TO_SPOT_MIN_TYPES:
+            return None  # anti-churn floor (consolidation.go:253-277)
+        cheaper = cheaper[:SPOT_TO_SPOT_MIN_TYPES]
+    else:
+        # on-demand (or mixed) candidates: replacement may be spot or a
+        # cheaper on-demand type; requirements keep both capacity types
+        pass
+
+    replacement.instance_types = cheaper
+    return Command(candidates, replacements=[replacement], reason=REASON_UNDERUTILIZED)
+
+
+class MultiNodeConsolidation(Method):
+    """Binary search for the largest N where candidates[0..N] collapse into
+    ≤1 replacement (disruption/multinodeconsolidation.go:47-163)."""
+
+    reason = REASON_UNDERUTILIZED
+    needs_validation = True
+    is_consolidation = True
+
+    def compute_command(self, candidates, budgets):
+        cands = _consolidatable(candidates)
+        cands.sort(key=lambda c: c.disruption_cost)
+        cands = within_budget(budgets, self.reason, cands)[:MULTI_NODE_CANDIDATE_CAP]
+        if len(cands) < 2:
+            return None
+        # binary search on prefix length (multinodeconsolidation.go:111-163)
+        lo, hi = 1, len(cands)
+        best = None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            cmd = compute_consolidation(self.ctx, cands[:mid])
+            if cmd is not None and cmd.action != "no-op":
+                best = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        if best is not None and len(best.candidates) < 2:
+            return None  # single-node results belong to SingleNodeConsolidation
+        return best
+
+
+class SingleNodeConsolidation(Method):
+    """Linear scan, one candidate at a time
+    (disruption/singlenodeconsolidation.go:47-120)."""
+
+    reason = REASON_UNDERUTILIZED
+    needs_validation = True
+    is_consolidation = True
+
+    def compute_command(self, candidates, budgets):
+        cands = _consolidatable(candidates)
+        cands.sort(key=lambda c: c.disruption_cost)
+        cands = within_budget(budgets, self.reason, cands)
+        for c in cands:
+            cmd = compute_consolidation(self.ctx, [c])
+            if cmd is not None:
+                return cmd
+        return None

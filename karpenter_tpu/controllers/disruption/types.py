@@ -1,0 +1,82 @@
+"""Disruption candidates and commands.
+
+Mirror of the reference's pkg/controllers/disruption/types.go: a `Candidate`
+is a disruptable StateNode annotated with its pool, instance type, offering
+price, reschedulable pods, and disruption cost (types.go:53-101); a
+`Command` is a set of candidates plus the replacement claims that the
+simulation produced, with the resulting action (types.go:103-169).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.utils.disruption import disruption_cost
+
+
+class Candidate:
+    def __init__(self, state_node, node_pool, instance_type, clock):
+        self.state_node = state_node
+        self.node_pool = node_pool
+        self.instance_type = instance_type
+        labels = state_node.labels()
+        self.zone = labels.get(wk.TOPOLOGY_ZONE_LABEL, "")
+        self.capacity_type = labels.get(wk.CAPACITY_TYPE_LABEL, wk.CAPACITY_TYPE_ON_DEMAND)
+        self.reschedulable_pods = state_node.reschedulable_pods()
+        self.disruption_cost = disruption_cost(
+            self.reschedulable_pods,
+            state_node=state_node,
+            expire_after=node_pool.spec.disruption.expire_after,
+            now=clock.now(),
+        )
+
+    @property
+    def name(self) -> str:
+        return self.state_node.name
+
+    @property
+    def provider_id(self) -> str:
+        return self.state_node.provider_id
+
+    @property
+    def price(self) -> float:
+        """Current offering price for this node's (zone, capacity type)."""
+        if self.instance_type is None:
+            return 0.0
+        for o in self.instance_type.offerings:
+            if o.zone == self.zone and o.capacity_type == self.capacity_type:
+                return o.price
+        return 0.0
+
+    def __repr__(self):
+        return f"Candidate({self.name}, cost={self.disruption_cost:.2f})"
+
+
+DELETE = "delete"
+REPLACE = "replace"
+NOOP = "no-op"
+
+
+class Command:
+    def __init__(self, candidates, replacements=(), reason: str = ""):
+        self.candidates = list(candidates)
+        self.replacements = list(replacements)  # [InFlightNodeClaim]
+        self.reason = reason
+        # orchestration bookkeeping
+        self.replacement_names: list = []
+        self.created_at: float = 0.0
+        self.last_error: str | None = None
+
+    @property
+    def action(self) -> str:
+        if self.replacements:
+            return REPLACE
+        if self.candidates:
+            return DELETE
+        return NOOP
+
+    def __repr__(self):
+        return (
+            f"Command({self.action}, reason={self.reason}, "
+            f"candidates={[c.name for c in self.candidates]}, "
+            f"replacements={len(self.replacements)})"
+        )

@@ -1,0 +1,71 @@
+"""Node termination: the graceful drain finalizer.
+
+Mirror of the reference's pkg/controllers/node/termination
+(controller.go:70-170) + terminator (terminator.go:51-109,
+eviction.go:129-193): a deleting node is tainted, its evictable pods are
+evicted through the PDB-gated Eviction subresource (429s retried on later
+polls), and only when the drain completes does the finalizer release the
+node object. Daemonset- and node-owned pods are not evicted — they die with
+the node.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.controllers.disruption.queue import add_disruption_taint
+from karpenter_tpu.kube.store import TooManyRequests
+from karpenter_tpu.utils import pod as pod_util
+
+
+class NodeTerminationController:
+    def __init__(self, store, clock=None, recorder=None):
+        from karpenter_tpu.utils.clock import Clock
+
+        self.store = store
+        self.clock = clock or Clock()
+        self.recorder = recorder
+
+    def on_event(self, event):
+        pass
+
+    def poll(self) -> bool:
+        progressed = False
+        for node in list(self.store.list("nodes")):
+            if node.metadata.deletion_timestamp is None:
+                continue
+            if wk.TERMINATION_FINALIZER not in node.metadata.finalizers:
+                continue
+            if self._reconcile(node):
+                progressed = True
+        return progressed
+
+    def _reconcile(self, node) -> bool:
+        progressed = add_disruption_taint(self.store, node)
+        draining = False
+        for pod in self.store.list("pods"):
+            if pod.node_name != node.name:
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            if pod.owned_by_daemonset() or pod_util.is_owned_by_node(pod):
+                continue
+            if not pod_util.is_evictable(pod):
+                continue
+            draining = True
+            try:
+                self.store.evict(pod)
+                progressed = True
+            except TooManyRequests:
+                # PDB-blocked: retry on a later poll (eviction.go 429 path)
+                if self.recorder is not None:
+                    self.recorder.publish(
+                        "EvictionBlocked", f"pdb blocks eviction of {pod.key()}"
+                    )
+        if draining:
+            return progressed
+        # drain complete: release the node
+        node.metadata.finalizers = [
+            f for f in node.metadata.finalizers if f != wk.TERMINATION_FINALIZER
+        ]
+        self.store.update("nodes", node)
+        return True

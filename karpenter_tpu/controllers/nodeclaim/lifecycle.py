@@ -89,6 +89,10 @@ class NodeClaimLifecycleController:
         node.metadata.labels.update(claim.metadata.labels)
         node.metadata.labels[wk.NODE_REGISTERED_LABEL] = "true"
         node.taints = [t for t in node.taints if t.key != wk.UNREGISTERED_TAINT_KEY]
+        # managed nodes drain through the termination finalizer
+        # (registration.go syncs it onto the node)
+        if wk.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
         self.store.update("nodes", node)
         claim.status.node_name = node.name
         claim.set_condition(COND_REGISTERED, now=self.clock.now())
@@ -126,14 +130,19 @@ class NodeClaimLifecycleController:
     def _finalize(self, claim) -> bool:
         if wk.TERMINATION_FINALIZER not in claim.metadata.finalizers:
             return False
+        node = self._node_for(claim)
+        if node is not None:
+            if node.metadata.deletion_timestamp is None:
+                # start the graceful drain; the node.termination controller
+                # evicts pods and releases the node's finalizer
+                self.store.delete("nodes", node)
+                return True
+            return False  # drain in progress: wait for the node to go away
         if claim.status.provider_id:
             try:
                 self.cloud.delete(claim)
             except NodeClaimNotFoundError:
                 pass
-        node = self._node_for(claim)
-        if node is not None:
-            self.store.delete("nodes", node)
         claim.metadata.finalizers = [
             f for f in claim.metadata.finalizers if f != wk.TERMINATION_FINALIZER
         ]

@@ -20,9 +20,39 @@ from karpenter_tpu.utils import pod as pod_util
 from karpenter_tpu.utils import resources as resutil
 
 
+class ClusterStateView:
+    """Topology's window onto bound pods, served from the state plane —
+    no per-solve full-store rescans: bindings and the anti-affinity index
+    are maintained incrementally by Cluster (state/cluster.py)."""
+
+    def __init__(self, cluster, store):
+        self.cluster = cluster
+        self.store = store
+
+    def pods_matching(self, namespaces, selector):
+        for sn in self.cluster.state_nodes():
+            labels = sn.labels()
+            for pod in sn.pods.values():
+                if pod.namespace not in namespaces:
+                    continue
+                if selector is not None and not selector.matches(pod.metadata.labels):
+                    continue
+                yield pod, labels
+
+    def pods_with_anti_affinity(self):
+        yield from self.cluster.pods_with_anti_affinity()
+
+    def namespaces_matching(self, selector):
+        return [
+            ns.metadata.name
+            for ns in self.store.list("namespaces")
+            if selector.matches(ns.metadata.labels)
+        ]
+
+
 class StoreClusterView:
     """Adapter giving the topology engine visibility into bound pods
-    (replaced by state.Cluster once the state plane lands)."""
+    (fallback when no state plane is wired, e.g. bare-solver use)."""
 
     def __init__(self, store):
         self.store = store
@@ -137,12 +167,14 @@ class Provisioner:
             out.append(p)
         return out
 
-    def schedule(self, pods=None):
+    def schedule(self, pods=None, state_nodes=None):
         # nodes are snapshotted BEFORE pods are listed: a pod that binds in
         # between appears both as pending and in its node's usage, which
         # over-provisions (safe); the reverse order would under-provision
-        # (provisioner.go:318-329)
-        state_nodes = self.cluster.nodes() if self.cluster is not None else []
+        # (provisioner.go:318-329). The disruption simulation passes its own
+        # candidate-free snapshot (disruption/helpers.go:51).
+        if state_nodes is None:
+            state_nodes = self.cluster.nodes() if self.cluster is not None else []
         if pods is None:
             pods = self.pending_pods()
             pods.extend(self.deleting_node_pods(state_nodes, pods))
@@ -167,9 +199,12 @@ class Provisioner:
                     for r, v in resutil.parse_resources(np.spec.limits).items()
                 }
 
-        topology = Topology(
-            cluster=StoreClusterView(self.store), domains=domains, pods=pods
+        view = (
+            ClusterStateView(self.cluster, self.store)
+            if self.cluster is not None
+            else StoreClusterView(self.store)
         )
+        topology = Topology(cluster=view, domains=domains, pods=pods)
         existing_nodes = self._existing_nodes(state_nodes, topology)
         results = self.solver.solve(
             pods,

@@ -22,12 +22,19 @@ class DaemonSetController:
         pass
 
     def poll(self) -> bool:
+        from karpenter_tpu.utils import resources as resutil
+
         progressed = False
         nodes = [
             n
             for n in self.store.list("nodes")
             if n.ready and n.metadata.deletion_timestamp is None
         ]
+        # remaining capacity per node: daemon pods only land where they fit
+        used: dict = {n.name: {} for n in nodes}
+        for p in self.store.list("pods"):
+            if p.node_name in used and p.metadata.deletion_timestamp is None:
+                used[p.node_name] = resutil.merge(used[p.node_name], p.effective_requests())
         for ds in self.store.list("daemonsets"):
             if ds.template is None:
                 continue
@@ -38,6 +45,9 @@ class DaemonSetController:
                 tmpl = ds.template
                 if not daemon_schedulable(tmpl, node.taints, label_requirements(node.labels)):
                     continue
+                free = resutil.subtract(node.allocatable, used[node.name])
+                if not resutil.fits(tmpl.effective_requests(), free):
+                    continue  # would overcommit: the real scheduler leaves it Pending
                 p = tmpl.clone()
                 p.metadata.name = name
                 p.metadata.namespace = ds.metadata.namespace
@@ -49,5 +59,6 @@ class DaemonSetController:
                 ]
                 self.store.create("pods", p)
                 self.store.bind(p, node.name)
+                used[node.name] = resutil.merge(used[node.name], p.effective_requests())
                 progressed = True
         return progressed

@@ -1,0 +1,70 @@
+"""Workload controller stand-in: replicasets + pod garbage collection.
+
+The reference relies on the real controller-manager to recreate evicted
+pods (deployments → replicasets) and to delete pods orphaned by node
+deletion (pod GC). The hermetic cluster needs both for disruption to be
+observable end-to-end: a drain evicts pods, this controller recreates them
+as fresh pending pods, and the provisioner/binder land them on surviving or
+replacement capacity.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+_pod_seq = itertools.count(1)
+
+
+class WorkloadController:
+    def __init__(self, store):
+        self.store = store
+
+    def on_event(self, event):
+        pass
+
+    def poll(self) -> bool:
+        progressed = self._gc_orphans()
+        for deploy in self.store.list("deployments"):
+            if deploy.template is None:
+                continue
+            owned = [
+                p
+                for p in self.store.list("pods", namespace=deploy.metadata.namespace)
+                if p.metadata.deletion_timestamp is None
+                and any(
+                    o.get("kind") == "Deployment" and o.get("name") == deploy.metadata.name
+                    for o in p.metadata.owner_references
+                )
+            ]
+            for extra in owned[deploy.replicas :]:
+                # scale-down: newest-first would need creation ordering;
+                # owned list order (store insertion) approximates it
+                self.store.delete("pods", extra)
+                progressed = True
+            for _ in range(deploy.replicas - len(owned)):
+                p = deploy.template.clone()
+                from karpenter_tpu.api.objects import new_uid
+
+                p.metadata.name = f"{deploy.metadata.name}-{next(_pod_seq)}"
+                p.metadata.namespace = deploy.metadata.namespace
+                p.metadata.uid = new_uid("pod")
+                p.metadata.owner_references = [
+                    {"kind": "Deployment", "name": deploy.metadata.name, "controller": True}
+                ]
+                p.node_name = ""
+                p.phase = "Pending"
+                p.conditions = []
+                self.store.create("pods", p)
+                progressed = True
+        return progressed
+
+    def _gc_orphans(self) -> bool:
+        """Delete pods bound to nodes that no longer exist (kube pod GC)."""
+        progressed = False
+        node_names = {n.name for n in self.store.list("nodes")}
+        for p in list(self.store.list("pods")):
+            if p.node_name and p.node_name not in node_names:
+                if p.metadata.deletion_timestamp is None:
+                    self.store.delete("pods", p)
+                    progressed = True
+        return progressed

@@ -48,7 +48,14 @@ class ClaimTemplate:
         self.weight = node_pool.spec.weight
         t = node_pool.spec.template
         self.labels = dict(t.labels)
-        self.annotations = dict(t.annotations)
+        # claims carry the pool's static-field hash; the drift condition
+        # controller compares it against the pool's current annotation
+        # (nodeclaimtemplate.go stamps karpenter.sh/nodepool-hash)
+        self.annotations = {
+            **t.annotations,
+            wk.NODEPOOL_HASH_ANNOTATION: node_pool.static_hash(),
+            wk.NODEPOOL_HASH_VERSION_ANNOTATION: wk.NODEPOOL_HASH_VERSION,
+        }
         self.taints = Taints(t.taints)
         self.startup_taints = Taints(t.startup_taints)
         self.kubelet = dict(t.kubelet)

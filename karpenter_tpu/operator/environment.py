@@ -19,7 +19,17 @@ from karpenter_tpu.utils.clock import FakeClock
 
 
 class Environment:
-    def __init__(self, instance_types=None, clock=None, cloud=None, solver=None, sync: bool = True):
+    def __init__(
+        self,
+        instance_types=None,
+        clock=None,
+        cloud=None,
+        solver=None,
+        sync: bool = True,
+        enable_disruption: bool = False,
+        disruption_options: dict | None = None,
+        validation_ttl: float | None = None,
+    ):
         from karpenter_tpu.controllers.provisioning.batcher import Batcher
 
         self.clock = clock or FakeClock()
@@ -37,12 +47,40 @@ class Environment:
             batcher=batcher,
             cluster=self.cluster,
         )
+        from karpenter_tpu.controllers.disruption import DisruptionController
+        from karpenter_tpu.controllers.node.termination import NodeTerminationController
+        from karpenter_tpu.controllers.nodeclaim.disruption import (
+            NodeClaimDisruptionController,
+        )
+        from karpenter_tpu.controllers.nodepool.hash import NodePoolHashController
         from karpenter_tpu.kube.daemonset import DaemonSetController
+        from karpenter_tpu.kube.workload import WorkloadController
 
         self.controllers = [
+            NodePoolHashController(self.store),
             NodeClaimLifecycleController(self.store, self.cloud, clock=self.clock),
+            NodeClaimDisruptionController(
+                self.store, self.cloud, self.cluster, clock=self.clock
+            ),
+            NodeTerminationController(self.store, clock=self.clock),
             DaemonSetController(self.store),
+            WorkloadController(self.store),
         ]
+        self.disruption = None
+        if enable_disruption:
+            self.disruption = DisruptionController(
+                self.store,
+                self.cluster,
+                self.cloud,
+                self.provisioner,
+                clock=self.clock,
+                options=disruption_options,
+                poll_period=0.0 if sync else 10.0,
+                validation_ttl=(
+                    validation_ttl if validation_ttl is not None else (0.0 if sync else 15.0)
+                ),
+            )
+            self.controllers.append(self.disruption)
 
     def run_until_idle(self, max_rounds: int = 100) -> int:
         """Drain events and reconcile until nothing changes; returns rounds."""

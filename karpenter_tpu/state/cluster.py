@@ -35,7 +35,7 @@ class Cluster:
         self._claim_name_to_pid: dict = {}  # claim name -> provider_id
         self._bindings: dict = {}  # pod key -> node name
         self._antiaffinity_pods: dict = {}  # pod key -> Pod (bound, w/ required anti-affinity)
-        self._consolidated_at: float = 0.0
+        self._state_seq: int = 0
 
     # -- informer entry point -------------------------------------------
     def on_event(self, event):
@@ -189,6 +189,10 @@ class Cluster:
         the disruption simulation mutate them, cluster.go Nodes())."""
         return [sn.snapshot() for sn in self._nodes.values()]
 
+    def state_nodes(self):
+        """The live (unsnapshotted) StateNodes — read-only iteration."""
+        return self._nodes.values()
+
     def node_for(self, provider_id: str):
         return self._nodes.get(provider_id)
 
@@ -236,12 +240,15 @@ class Cluster:
                 sn.marked_for_deletion = False
         self.mark_unconsolidated()
 
-    # -- consolidation timestamp (cluster.go:310-337) --------------------
-    def mark_unconsolidated(self) -> float:
-        self._consolidated_at = self.clock.now()
-        return self._consolidated_at
+    # -- consolidation fence (cluster.go:310-337) ------------------------
+    def mark_unconsolidated(self) -> int:
+        """Bump the state sequence. The reference uses a timestamp; a
+        sequence number gives the same fencing under a fake clock."""
+        self._state_seq += 1
+        return self._state_seq
 
-    def consolidation_state(self) -> float:
-        """A timestamp fencing consolidation decisions: a command computed
-        against state older than the latest mutation must revalidate."""
-        return self._consolidated_at
+    def consolidation_state(self) -> int:
+        """Fence for consolidation decisions: if unchanged since the last
+        fruitless consolidation round, nothing relevant moved and the
+        search can be skipped (consolidation.go isConsolidated)."""
+        return self._state_seq

@@ -81,7 +81,10 @@ class TestBudgets:
     def test_always_active_percent(self):
         b = Budget(nodes="10%")
         assert b.allowed(100) == 10
-        assert b.allowed(5) == 0
+        # percentages round UP (intstr roundUp=true in the reference's
+        # GetAllowedDisruptions): small pools still get one disruption
+        assert b.allowed(5) == 1
+        assert b.allowed(0) == 0
 
     def test_absolute(self):
         assert Budget(nodes="3").allowed(100) == 3

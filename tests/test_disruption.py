@@ -1,0 +1,289 @@
+"""M5: disruption — emptiness, consolidation, drift, budgets, safety gates.
+
+Scenario sources: the reference's disruption suites
+(pkg/controllers/disruption/{emptiness,consolidation,drift}_test.go) and the
+orchestration queue suite, exercised through the hermetic runtime the way
+the reference drives envtest.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodeclaim import COND_DRIFTED, COND_EMPTY
+from karpenter_tpu.api.nodepool import (
+    CONSOLIDATION_WHEN_EMPTY,
+    Budget,
+    NodePool,
+)
+from karpenter_tpu.api.objects import (
+    Deployment,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.operator import Environment
+
+GIB = 2**30
+
+
+def nodepool(name="default", **kw):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    for k, v in kw.items():
+        setattr(np_.spec.template, k, v)
+    return np_
+
+
+def pod_template(name, cpu=0.7, labels=None):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=labels or {"app": name}),
+        requests={"cpu": cpu, "memory": 0.25 * GIB},
+    )
+
+
+def deployment(name, replicas, cpu=0.7, labels=None):
+    return Deployment(
+        metadata=ObjectMeta(name=name),
+        replicas=replicas,
+        template=pod_template(name, cpu=cpu, labels=labels or {"app": name}),
+    )
+
+
+@pytest.fixture
+def env():
+    return Environment(
+        instance_types=[
+            make_instance_type("small", 2, 8),
+            make_instance_type("medium", 8, 32),
+        ],
+        enable_disruption=True,
+    )
+
+
+def live_nodes(env):
+    return [n for n in env.store.list("nodes") if n.metadata.deletion_timestamp is None]
+
+
+class TestEmptiness:
+    def test_when_empty_policy_deletes_after_ttl(self):
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)], enable_disruption=True
+        )
+        np_ = nodepool()
+        np_.spec.disruption.consolidation_policy = CONSOLIDATION_WHEN_EMPTY
+        np_.spec.disruption.consolidate_after = 30.0
+        env.create("nodepools", np_)
+        (p,) = env.provision(pod_template("p1"))
+        assert len(live_nodes(env)) == 1
+        env.store.delete("pods", p)
+        env.run_until_idle()
+        # Empty condition set, but TTL not yet elapsed
+        claim = env.store.list("nodeclaims")[0]
+        assert claim.is_true(COND_EMPTY)
+        assert len(live_nodes(env)) == 1
+        env.clock.step(31.0)
+        env.run_until_idle()
+        assert env.store.list("nodeclaims") == []
+        assert live_nodes(env) == []
+
+    def test_empty_node_consolidation_when_underutilized(self, env):
+        env.create("nodepools", nodepool())
+        d = deployment("a", 1)
+        env.create("deployments", d)
+        env.run_until_idle()
+        assert len(live_nodes(env)) == 1
+        d.replicas = 0
+        env.store.update("deployments", d)
+        for p in env.store.list("pods"):
+            env.store.delete("pods", p)
+        env.run_until_idle()
+        assert live_nodes(env) == []
+        assert env.store.list("nodeclaims") == []
+
+
+class TestConsolidation:
+    def _two_nodes(self, env):
+        """Two small nodes, one lightly-used each."""
+        env.create("nodepools", nodepool())
+        a = deployment("a", 2, cpu=0.7)
+        env.create("deployments", a)
+        env.run_until_idle()
+        assert len(live_nodes(env)) == 1
+        b = deployment("b", 1, cpu=0.7)
+        env.create("deployments", b)
+        env.run_until_idle()
+        assert len(live_nodes(env)) == 2
+        return a, b
+
+    def test_single_node_delete_moves_pods(self, env):
+        a, b = self._two_nodes(env)
+        # scale a down: 1 pod on each node; they fit together on one
+        a.replicas = 1
+        env.store.update("deployments", a)
+        pods_a = [
+            p
+            for p in env.store.list("pods")
+            if p.metadata.labels.get("app") == "a" and p.metadata.deletion_timestamp is None
+        ]
+        env.store.delete("pods", pods_a[0])
+        env.run_until_idle()
+        assert len(live_nodes(env)) == 1
+        # every surviving workload pod is bound
+        for p in env.store.list("pods"):
+            assert p.node_name, f"{p.key()} unbound after consolidation"
+
+    def test_replace_with_cheaper_node(self):
+        env = Environment(
+            instance_types=[
+                make_instance_type("small", 2, 8),
+                make_instance_type("large", 16, 64),
+            ],
+            enable_disruption=True,
+        )
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        # on-demand pool: spot→spot consolidation is feature-gated off,
+        # matching the reference (consolidation.go:214)
+        env.create(
+            "nodepools",
+            nodepool(
+                requirements=[
+                    NodeSelectorRequirement(
+                        wk.CAPACITY_TYPE_LABEL, "In", [wk.CAPACITY_TYPE_ON_DEMAND]
+                    )
+                ]
+            ),
+        )
+        # force a large node with a big deployment, then shrink the workload
+        big = deployment("big", 1, cpu=10.0)
+        env.create("deployments", big)
+        env.run_until_idle()
+        nodes = live_nodes(env)
+        assert len(nodes) == 1
+        assert nodes[0].labels[wk.INSTANCE_TYPE_LABEL] == "large"
+        big.replicas = 0
+        env.store.update("deployments", big)
+        for p in list(env.store.list("pods")):
+            if p.metadata.labels.get("app") == "big":
+                env.store.delete("pods", p)
+        small = deployment("small", 1, cpu=0.5)
+        env.create("deployments", small)
+        env.run_until_idle()
+        nodes = live_nodes(env)
+        assert len(nodes) == 1
+        assert nodes[0].labels[wk.INSTANCE_TYPE_LABEL] == "small"
+
+    def test_budget_zero_blocks_disruption(self, env):
+        env.create("nodepools", nodepool())
+        np_ = env.store.list("nodepools")[0]
+        np_.spec.disruption.budgets = [Budget(nodes="0")]
+        d = deployment("a", 1)
+        env.create("deployments", d)
+        env.run_until_idle()
+        assert len(live_nodes(env)) == 1
+        d.replicas = 0
+        env.store.update("deployments", d)
+        for p in list(env.store.list("pods")):
+            env.store.delete("pods", p)
+        env.run_until_idle()
+        # empty node survives: budget forbids disruption
+        assert len(live_nodes(env)) == 1
+
+    def test_do_not_disrupt_annotation_blocks(self, env):
+        env.create("nodepools", nodepool())
+        p = pod_template("p1")
+        p.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.provision(p)
+        assert len(live_nodes(env)) == 1
+        # the pod makes its node non-disruptable even when underutilized
+        env.run_until_idle()
+        assert len(live_nodes(env)) == 1
+
+    def test_pdb_blocks_candidate(self, env):
+        env.create("nodepools", nodepool())
+        env.create(
+            "pdbs",
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb-a"),
+                selector=LabelSelector(match_labels={"app": "a"}),
+                max_unavailable=0,
+            ),
+        )
+        a, b = self._setup_movable(env)
+        env.run_until_idle()
+        # consolidation cannot pick either node: each holds a PDB-protected pod
+        assert len(live_nodes(env)) == 2
+
+    def _setup_movable(self, env):
+        a = deployment("a", 2, cpu=0.7, labels={"app": "a"})
+        env.create("deployments", a)
+        env.run_until_idle()
+        b = deployment("b", 1, cpu=0.7, labels={"app": "a"})
+        env.create("deployments", b)
+        env.run_until_idle()
+        a.replicas = 1
+        env.store.update("deployments", a)
+        pods_a = [
+            p
+            for p in env.store.list("pods")
+            if p.metadata.labels.get("app") == "a"
+            and p.metadata.name.startswith("a-")
+            and p.metadata.deletion_timestamp is None
+        ]
+        if pods_a:
+            env.store.delete("pods", pods_a[0])
+        return a, b
+
+
+class TestDrift:
+    def test_nodepool_change_drifts_and_replaces(self, env):
+        env.create("nodepools", nodepool())
+        d = deployment("a", 1)
+        env.create("deployments", d)
+        env.run_until_idle()
+        (old_node,) = live_nodes(env)
+        np_ = env.store.list("nodepools")[0]
+        np_.spec.template.labels["team"] = "blue"
+        env.store.update("nodepools", np_)
+        env.run_until_idle()
+        claims = env.store.list("nodeclaims")
+        assert len(claims) == 1
+        nodes = live_nodes(env)
+        assert len(nodes) == 1
+        assert nodes[0].name != old_node.name, "drifted node was not replaced"
+        assert nodes[0].labels.get("team") == "blue"
+        for p in env.store.list("pods"):
+            assert p.node_name == nodes[0].name
+
+    def test_empty_drifted_deleted_in_bulk(self, env):
+        np_ = nodepool()
+        env.create("nodepools", np_)
+        env.provision(pod_template("p1"))
+        (p,) = [x for x in env.store.list("pods")]
+        env.store.delete("pods", p)
+        env.run_until_idle()
+        np_.spec.template.labels["team"] = "red"
+        env.store.update("nodepools", np_)
+        env.run_until_idle()
+        # drifted empty node removed without replacement
+        assert live_nodes(env) == []
+
+
+class TestConditions:
+    def test_drift_condition_set_and_cleared(self, env):
+        env.create("nodepools", nodepool())
+        # disable active disruption so only conditions flip
+        env.controllers.remove(env.disruption)
+        env.provision(pod_template("p1"))
+        claim = env.store.list("nodeclaims")[0]
+        assert not claim.is_true(COND_DRIFTED)
+        np_ = env.store.list("nodepools")[0]
+        np_.spec.template.labels["x"] = "y"
+        env.store.update("nodepools", np_)
+        env.run_until_idle()
+        assert claim.is_true(COND_DRIFTED)
+        del np_.spec.template.labels["x"]
+        env.store.update("nodepools", np_)
+        env.run_until_idle()
+        assert not claim.is_true(COND_DRIFTED)
