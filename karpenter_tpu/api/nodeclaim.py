@@ -9,10 +9,17 @@ Drifted/Empty/Expired set by pkg/controllers/nodeclaim/disruption).
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 
+from karpenter_tpu.api.conditions import Condition, ConditionedObject
 from karpenter_tpu.api.objects import ObjectMeta
+
+__all__ = [
+    "Condition",
+    "NodeClaim",
+    "NodeClaimSpec",
+    "NodeClaimStatus",
+]
 
 # condition types
 COND_LAUNCHED = "Launched"
@@ -23,15 +30,6 @@ COND_EMPTY = "Empty"
 COND_EXPIRED = "Expired"
 COND_CONSISTENT = "ConsistentStateFound"
 COND_TERMINATING = "Terminating"
-
-
-@dataclass
-class Condition:
-    type: str
-    status: str = "True"  # True | False | Unknown
-    reason: str = ""
-    message: str = ""
-    last_transition_time: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -56,7 +54,7 @@ class NodeClaimStatus:
 
 
 @dataclass
-class NodeClaim:
+class NodeClaim(ConditionedObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodeClaimSpec = field(default_factory=NodeClaimSpec)
     status: NodeClaimStatus = field(default_factory=NodeClaimStatus)
@@ -64,33 +62,6 @@ class NodeClaim:
     @property
     def name(self) -> str:
         return self.metadata.name
-
-    def get_condition(self, cond_type: str) -> Condition | None:
-        for c in self.status.conditions:
-            if c.type == cond_type:
-                return c
-        return None
-
-    def set_condition(self, cond_type: str, status: str = "True", reason: str = "", message: str = "", now: float | None = None):
-        existing = self.get_condition(cond_type)
-        if existing is not None:
-            if existing.status != status:
-                existing.status = status
-                existing.last_transition_time = time.time() if now is None else now
-            existing.reason = reason
-            existing.message = message
-            return existing
-        c = Condition(type=cond_type, status=status, reason=reason, message=message,
-                      last_transition_time=time.time() if now is None else now)
-        self.status.conditions.append(c)
-        return c
-
-    def clear_condition(self, cond_type: str):
-        self.status.conditions = [c for c in self.status.conditions if c.type != cond_type]
-
-    def is_true(self, cond_type: str) -> bool:
-        c = self.get_condition(cond_type)
-        return c is not None and c.status == "True"
 
     @property
     def launched(self) -> bool:

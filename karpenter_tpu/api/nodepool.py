@@ -15,6 +15,7 @@ import math
 import time
 from dataclasses import dataclass, field
 
+from karpenter_tpu.api.conditions import ConditionedObject
 from karpenter_tpu.api.objects import ObjectMeta
 from karpenter_tpu.utils import resources as resutil
 from karpenter_tpu.utils.cron import parse_schedule
@@ -111,7 +112,7 @@ class NodePoolStatus:
 
 
 @dataclass
-class NodePool:
+class NodePool(ConditionedObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodePoolSpec = field(default_factory=NodePoolSpec)
     status: NodePoolStatus = field(default_factory=NodePoolStatus)

@@ -272,6 +272,41 @@ class Deployment:
 
 
 @dataclass
+class Lease:
+    """coordination.k8s.io Lease: kubelet heartbeats in kube-node-lease.
+    Only the ownership shape matters here — leasegarbagecollection
+    (leasegarbagecollection/controller.go:48) deletes leases whose owning
+    Node is gone."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+
+
+@dataclass
+class NodeClass:
+    """Provider-specific node configuration object (the KWOKNodeClass
+    analog, kwok/apis/v1alpha1). NodePools reference one via
+    spec.template.node_class_ref; nodepool.readiness
+    (readiness/controller.go:52) mirrors its Ready condition."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    kind: str = "KWOKNodeClass"
+    conditions: list = field(default_factory=list)  # [{"type","status"}]
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def ready(self) -> bool:
+        for c in self.conditions:
+            ctype = c.type if hasattr(c, "type") else c.get("type")
+            status = c.status if hasattr(c, "status") else c.get("status")
+            if ctype == "Ready":
+                return status == "True"
+        return True  # no explicit condition = ready (kwok nodeclass is static)
+
+
+@dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: LabelSelector = field(default_factory=LabelSelector)

@@ -1,0 +1,77 @@
+"""NodeClaim consistency: invariant checks between a claim and its node.
+
+Mirror of the reference's pkg/controllers/nodeclaim/consistency
+(controller.go:78-143): once a claim is initialized, verify the machine the
+cloud delivered matches what was promised — the node advertises at least
+the claim's requested resources (NodeShape check) and carries the labels
+the claim's requirements demanded. Violations emit FailedConsistencyCheck
+events and set the ConsistentStateFound condition False; the check is a
+canary for provider bugs, not an enforcement path.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api.nodeclaim import COND_CONSISTENT
+from karpenter_tpu.scheduling import label_requirements, node_selector_requirements
+
+
+class NodeClaimConsistencyController:
+    def __init__(self, store, clock=None, recorder=None):
+        from karpenter_tpu.utils.clock import Clock
+
+        self.store = store
+        self.clock = clock or Clock()
+        self.recorder = recorder
+
+    def on_event(self, event):
+        pass
+
+    def poll(self) -> bool:
+        progressed = False
+        for claim in list(self.store.list("nodeclaims")):
+            if claim.metadata.deletion_timestamp is not None or not claim.initialized:
+                continue
+            node = self._node_for(claim)
+            if node is None:
+                continue
+            failures = self._check(claim, node)
+            want = "False" if failures else "True"
+            cond = claim.get_condition(COND_CONSISTENT)
+            if cond is None or cond.status != want:
+                claim.set_condition(
+                    COND_CONSISTENT, status=want,
+                    reason="ConsistencyCheckFailed" if failures else "ConsistentStateFound",
+                    message="; ".join(failures), now=self.clock.now())
+                self.store.update("nodeclaims", claim)
+                if failures and self.recorder is not None:
+                    self.recorder.publish(
+                        "FailedConsistencyCheck", "; ".join(failures), obj=claim)
+                progressed = True
+        return progressed
+
+    def _check(self, claim, node) -> list[str]:
+        failures = []
+        # NodeShape: the node must register at least the allocatable the
+        # claim's instance type promised (consistency/nodeshape.go)
+        for r, want in (claim.status.allocatable or {}).items():
+            got = node.allocatable.get(r, 0.0)
+            if got < want * 0.9:  # kubelet reserves a little; 10% slack
+                failures.append(
+                    f"node {node.name} allocatable {r}={got} below claim's {want}")
+        # node labels must satisfy the claim's requirements
+        # two-way overlap only: an Exists/complement requirement stamps no
+        # node label by design (Requirements.labels() skips unbounded sets),
+        # so a one-way Compatible check would false-positive on it forever
+        reqs = node_selector_requirements(claim.spec.requirements)
+        err = label_requirements(node.labels).intersects(reqs)
+        if err is not None:
+            failures.append(f"node {node.name} labels conflict with claim requirements: {err}")
+        return failures
+
+    def _node_for(self, claim):
+        if not claim.status.provider_id:
+            return None
+        for node in self.store.list("nodes"):
+            if node.provider_id == claim.status.provider_id:
+                return node
+        return None

@@ -252,13 +252,13 @@ class Provisioner:
         return total
 
     def _nodepool_usage(self, np) -> dict:
-        if np.status.resources:
-            return dict(np.status.resources)
-        total: dict = {}
-        for node in self.store.list("nodes"):
-            if node.labels.get(wk.NODEPOOL_LABEL) == np.name:
-                total = resutil.merge(total, node.capacity)
-        return total
+        # live aggregation, not status.resources: the counter controller's
+        # status snapshot lags within a reconcile round, and a stale zero
+        # would let a launch overshoot the limit (the reference tolerates
+        # this transient; we don't have to)
+        from karpenter_tpu.controllers.nodepool.counter import aggregate_pool_usage
+
+        return aggregate_pool_usage(self.store, np)
 
     def deleting_node_pods(self, state_nodes, already: list) -> list:
         """Reschedulable pods bound to nodes being drained or marked for

@@ -59,6 +59,7 @@ KINDS = (
     "namespaces",
     "leases",
     "events",
+    "nodeclasses",
 )
 
 _NAMESPACED = {"pods", "daemonsets", "deployments", "pdbs", "pvcs", "leases", "events"}

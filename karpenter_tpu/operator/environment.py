@@ -48,21 +48,41 @@ class Environment:
             cluster=self.cluster,
         )
         from karpenter_tpu.controllers.disruption import DisruptionController
+        from karpenter_tpu.controllers.node.leasegc import LeaseGarbageCollectionController
         from karpenter_tpu.controllers.node.termination import NodeTerminationController
+        from karpenter_tpu.controllers.nodeclaim.consistency import (
+            NodeClaimConsistencyController,
+        )
         from karpenter_tpu.controllers.nodeclaim.disruption import (
             NodeClaimDisruptionController,
         )
+        from karpenter_tpu.controllers.nodeclaim.garbagecollection import (
+            NodeClaimGarbageCollectionController,
+        )
+        from karpenter_tpu.controllers.nodepool.counter import NodePoolCounterController
         from karpenter_tpu.controllers.nodepool.hash import NodePoolHashController
+        from karpenter_tpu.controllers.nodepool.readiness import (
+            NodePoolReadinessController,
+        )
+        from karpenter_tpu.controllers.nodepool.validation import (
+            NodePoolValidationController,
+        )
         from karpenter_tpu.kube.daemonset import DaemonSetController
         from karpenter_tpu.kube.workload import WorkloadController
 
         self.controllers = [
             NodePoolHashController(self.store),
+            NodePoolValidationController(self.store),
+            NodePoolReadinessController(self.store),
+            NodePoolCounterController(self.store),
             NodeClaimLifecycleController(self.store, self.cloud, clock=self.clock),
             NodeClaimDisruptionController(
                 self.store, self.cloud, self.cluster, clock=self.clock
             ),
+            NodeClaimGarbageCollectionController(self.store, self.cloud, clock=self.clock),
+            NodeClaimConsistencyController(self.store, clock=self.clock),
             NodeTerminationController(self.store, clock=self.clock),
+            LeaseGarbageCollectionController(self.store),
             DaemonSetController(self.store),
             WorkloadController(self.store),
         ]

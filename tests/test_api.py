@@ -125,7 +125,10 @@ class TestCron:
 class TestCatalog:
     def test_kwok_catalog_size(self):
         cat = kwok_catalog()
-        assert len(cat) == 4 * 8 * 2  # families x cpus x archs
+        # 12 cpu sizes x 3 mem-factor families x 2 os x 2 archs
+        # (kwok/tools/gen_instance_types.go:71-74; instance_types.json has 144)
+        assert len(cat) == 144
+        assert len({it.name for it in cat}) == 144
 
     def test_allocatable_below_capacity(self):
         it = kwok_catalog()[0]

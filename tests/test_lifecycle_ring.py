@@ -1,0 +1,232 @@
+"""M6b lifecycle-ring controllers: nodepool counter/readiness/validation,
+nodeclaim garbage collection/consistency, lease GC.
+
+Scenario sources: the reference's nodepool/counter, nodepool/readiness,
+nodepool/validation, nodeclaim/garbagecollection, nodeclaim/consistency,
+and leasegarbagecollection suites (SURVEY.md §2.7).
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodeclaim import COND_CONSISTENT
+from karpenter_tpu.api.nodepool import Budget, NodePool
+from karpenter_tpu.api.objects import Lease, NodeClass, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.controllers.nodeclaim.garbagecollection import GRACE_PERIOD
+from karpenter_tpu.operator import Environment
+
+GIB = 2**30
+
+
+def nodepool(name="default", **kw):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    for k, v in kw.items():
+        setattr(np_.spec.template, k, v)
+    return np_
+
+
+def pod(name, cpu=1.0, mem_gib=1.0, **kw):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+        requests={"cpu": cpu, "memory": mem_gib * GIB},
+        **kw,
+    )
+
+
+@pytest.fixture
+def env():
+    return Environment(
+        instance_types=[
+            make_instance_type("small", 2, 8),
+            make_instance_type("medium", 8, 32),
+        ]
+    )
+
+
+class TestNodePoolCounter:
+    def test_counts_owned_nodes(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(*[pod(f"p{i}", cpu=1.5) for i in range(3)])
+        np_ = env.store.get("nodepools", "default")
+        assert np_.status.resources["nodes"] == len(env.store.list("nodes"))
+        assert np_.status.resources["cpu"] > 0
+
+    def test_ignores_foreign_nodes(self, env):
+        env.create("nodepools", nodepool())
+        from karpenter_tpu.api.objects import Node
+
+        env.create("nodes", Node(metadata=ObjectMeta(name="alien", namespace=""),
+                                 capacity={"cpu": 64.0}))
+        env.run_until_idle()
+        np_ = env.store.get("nodepools", "default")
+        assert np_.status.resources.get("cpu", 0.0) == 0.0
+
+    def test_counter_feeds_limits(self, env):
+        np_ = nodepool()
+        np_.spec.limits = {"cpu": 2.0}
+        env.create("nodepools", np_)
+        env.provision(*[pod(f"p{i}", cpu=1.5) for i in range(4)])
+        # first node (2 cpu) exhausts the limit; later rounds must not launch
+        assert len(env.store.list("nodes")) == 1
+
+
+class TestNodePoolReadiness:
+    def test_ready_without_nodeclass_ref(self, env):
+        env.create("nodepools", nodepool())
+        env.run_until_idle()
+        assert env.store.get("nodepools", "default").is_true("Ready")
+
+    def test_not_ready_when_nodeclass_missing(self, env):
+        env.create("nodepools", nodepool(node_class_ref={"kind": "KWOKNodeClass", "name": "missing"}))
+        env.run_until_idle()
+        np_ = env.store.get("nodepools", "default")
+        assert not np_.is_true("Ready")
+        # not-ready pools are skipped by the provisioner
+        env.provision(pod("p1"))
+        assert env.store.list("nodes") == []
+
+    def test_ready_when_nodeclass_exists(self, env):
+        env.create("nodeclasses", NodeClass(metadata=ObjectMeta(name="nc", namespace="")))
+        env.create("nodepools", nodepool(node_class_ref={"kind": "KWOKNodeClass", "name": "nc"}))
+        env.provision(pod("p1"))
+        assert env.store.get("nodepools", "default").is_true("Ready")
+        assert len(env.store.list("nodes")) == 1
+
+    def test_nodeclass_not_ready(self, env):
+        env.create("nodeclasses", NodeClass(
+            metadata=ObjectMeta(name="nc", namespace=""),
+            conditions=[{"type": "Ready", "status": "False"}]))
+        env.create("nodepools", nodepool(node_class_ref={"kind": "KWOKNodeClass", "name": "nc"}))
+        env.run_until_idle()
+        assert not env.store.get("nodepools", "default").is_true("Ready")
+
+
+class TestNodePoolValidation:
+    def test_bad_cron_fails_validation(self, env):
+        np_ = nodepool()
+        np_.spec.disruption.budgets = [Budget(nodes="1", schedule="not a cron", duration=600.0)]
+        env.create("nodepools", np_)
+        env.run_until_idle()
+        got = env.store.get("nodepools", "default")
+        assert not got.is_true("ValidationSucceeded")
+        assert not got.is_true("Ready")
+
+    def test_schedule_without_duration_fails(self, env):
+        np_ = nodepool()
+        np_.spec.disruption.budgets = [Budget(nodes="1", schedule="0 * * * *")]
+        env.create("nodepools", np_)
+        env.run_until_idle()
+        assert not env.store.get("nodepools", "default").is_true("ValidationSucceeded")
+
+    def test_negative_budget_count_fails(self, env):
+        np_ = nodepool()
+        np_.spec.disruption.budgets = [Budget(nodes="-5")]
+        env.create("nodepools", np_)
+        env.run_until_idle()
+        assert not env.store.get("nodepools", "default").is_true("ValidationSucceeded")
+
+    def test_over_100_percent_fails(self, env):
+        np_ = nodepool()
+        np_.spec.disruption.budgets = [Budget(nodes="150%")]
+        env.create("nodepools", np_)
+        env.run_until_idle()
+        assert not env.store.get("nodepools", "default").is_true("ValidationSucceeded")
+
+    def test_restricted_label_fails(self, env):
+        env.create("nodepools", nodepool(labels={"karpenter.sh/custom": "x"}))
+        env.run_until_idle()
+        assert not env.store.get("nodepools", "default").is_true("ValidationSucceeded")
+
+    def test_valid_pool_passes(self, env):
+        np_ = nodepool()
+        np_.spec.disruption.budgets = [Budget(nodes="10%", schedule="0 9 * * 1-5", duration=3600.0)]
+        env.create("nodepools", np_)
+        env.run_until_idle()
+        assert env.store.get("nodepools", "default").is_true("ValidationSucceeded")
+
+
+class TestNodeClaimGarbageCollection:
+    def test_leaked_instance_deleted(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        claim = env.store.list("nodeclaims")[0]
+        # simulate a claim lost without finalization: remove from store only
+        claim.metadata.finalizers = []
+        env.store._objects["nodeclaims"].clear()
+        assert len(env.cloud.list()) == 1
+        env.clock.step(GRACE_PERIOD + 1)
+        env.run_until_idle()
+        assert env.cloud.list() == []
+
+    def test_fresh_instance_not_reaped(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        env.store._objects["nodeclaims"].clear()
+        env.run_until_idle()  # inside grace period
+        assert len(env.cloud.list()) == 1
+
+    def test_dead_instance_deletes_claim(self, env):
+        env.create("nodepools", nodepool())
+        (p,) = env.provision(pod("p1"))
+        claim = env.store.list("nodeclaims")[0]
+        # cloud loses the machine out from under us
+        env.cloud.created.pop(claim.status.provider_id)
+        env.run_until_idle()
+        assert env.store.list("nodeclaims") == []
+
+
+class TestNodeClaimConsistency:
+    def test_consistent_claim_marked(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        claim = env.store.list("nodeclaims")[0]
+        assert claim.is_true(COND_CONSISTENT)
+
+    def test_exists_requirement_not_false_positive(self, env):
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        env.create("nodepools", nodepool(
+            requirements=[NodeSelectorRequirement("team", "Exists", [])]))
+        env.provision(pod("p1", tolerations=[]))
+        claims = env.store.list("nodeclaims")
+        assert claims, "pod did not provision"
+        # an unbounded Exists requirement stamps no node label; the check
+        # must not flag the healthy node forever
+        assert claims[0].is_true(COND_CONSISTENT)
+
+    def test_shrunken_node_flagged(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        node = env.store.list("nodes")[0]
+        node.allocatable = {**node.allocatable, "cpu": 0.1}
+        env.store.update("nodes", node)
+        env.run_until_idle()
+        claim = env.store.list("nodeclaims")[0]
+        cond = claim.get_condition(COND_CONSISTENT)
+        assert cond is not None and cond.status == "False"
+
+
+class TestLeaseGC:
+    def _lease(self, node_name):
+        return Lease(metadata=ObjectMeta(
+            name=node_name, namespace="kube-node-lease",
+            owner_references=[{"kind": "Node", "name": node_name}]))
+
+    def test_orphaned_lease_deleted(self, env):
+        env.create("leases", self._lease("gone-node"))
+        env.run_until_idle()
+        assert env.store.list("leases") == []
+
+    def test_live_lease_kept(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        node = env.store.list("nodes")[0]
+        env.create("leases", self._lease(node.name))
+        env.run_until_idle()
+        assert len(env.store.list("leases")) == 1
+
+    def test_unowned_lease_ignored(self, env):
+        env.create("leases", Lease(metadata=ObjectMeta(name="x", namespace="kube-node-lease")))
+        env.run_until_idle()
+        assert len(env.store.list("leases")) == 1
