@@ -28,8 +28,19 @@ class ConditionedObject:
     """
 
     def get_condition(self, cond_type: str):
-        for c in self.status.conditions:
-            if (c.type if hasattr(c, "type") else c.get("type")) == cond_type:
+        for i, c in enumerate(self.status.conditions):
+            if isinstance(c, dict):
+                if c.get("type") != cond_type:
+                    continue
+                # normalize dict-shaped conditions in place so set_condition
+                # and clear_condition can rely on attribute access
+                c = Condition(
+                    type=c["type"], status=c.get("status", "Unknown"),
+                    reason=c.get("reason", ""), message=c.get("message", ""),
+                )
+                self.status.conditions[i] = c
+                return c
+            if c.type == cond_type:
                 return c
         return None
 
@@ -49,8 +60,11 @@ class ConditionedObject:
         return c
 
     def clear_condition(self, cond_type: str):
-        self.status.conditions = [c for c in self.status.conditions if c.type != cond_type]
+        self.status.conditions = [
+            c for c in self.status.conditions
+            if (c.get("type") if isinstance(c, dict) else c.type) != cond_type
+        ]
 
     def is_true(self, cond_type: str) -> bool:
         c = self.get_condition(cond_type)
-        return c is not None and (c.status if hasattr(c, "status") else c.get("status")) == "True"
+        return c is not None and c.status == "True"

@@ -1,0 +1,57 @@
+"""Metrics decorator for any CloudProvider.
+
+Mirror of the reference's pkg/cloudprovider/metrics/cloudprovider.go: wraps
+an inner provider, timing every SPI method into a duration histogram and
+counting errors by method — the decorator precedent the Solver interface
+follows for wrapping device and host implementations behind one seam.
+"""
+
+from __future__ import annotations
+
+import time
+
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.operator import metrics as m
+
+
+class MetricsCloudProvider(CloudProvider):
+    def __init__(self, inner: CloudProvider, registry=None):
+        self.inner = inner
+        self.registry = registry or m.REGISTRY
+
+    def _timed(self, method: str, fn, *args, **kw):
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kw)
+        except Exception as e:
+            self.registry.counter(m.CLOUDPROVIDER_ERRORS).inc(
+                method=method, provider=self.inner.name(), error=type(e).__name__)
+            raise
+        finally:
+            self.registry.histogram(m.CLOUDPROVIDER_DURATION).observe(
+                time.perf_counter() - t0, method=method, provider=self.inner.name())
+
+    def name(self) -> str:
+        return self.inner.name()
+
+    def create(self, node_claim):
+        return self._timed("Create", self.inner.create, node_claim)
+
+    def delete(self, node_claim):
+        return self._timed("Delete", self.inner.delete, node_claim)
+
+    def get(self, provider_id):
+        return self._timed("Get", self.inner.get, provider_id)
+
+    def list(self):
+        return self._timed("List", self.inner.list)
+
+    def get_instance_types(self, node_pool):
+        return self._timed("GetInstanceTypes", self.inner.get_instance_types, node_pool)
+
+    def is_drifted(self, node_claim):
+        return self._timed("IsDrifted", self.inner.is_drifted, node_claim)
+
+    def __getattr__(self, item):
+        # pass through provider-specific surface (e.g. kwok's .created)
+        return getattr(self.inner, item)

@@ -55,9 +55,12 @@ class DisruptionController:
         options=None,
         poll_period: float = POLL_PERIOD,
         validation_ttl: float = VALIDATION_TTL,
+        registry=None,
     ):
+        from karpenter_tpu.operator import metrics as _m
         from karpenter_tpu.utils.clock import Clock
 
+        self.registry = registry or _m.REGISTRY
         self.store = store
         self.cluster = cluster
         self.cloud = cloud
@@ -119,9 +122,13 @@ class DisruptionController:
 
     # -- the method ladder (controller.go:130-141) -----------------------
     def _compute_round(self) -> bool:
+        from karpenter_tpu.operator import metrics as m
+
         candidates = get_candidates(
             self.cluster, self.store, self.cloud, self.clock, queue=self.queue
         )
+        self.registry.gauge(m.DISRUPTION_ELIGIBLE_NODES, "disruptable candidates").set(
+            len(candidates))
         if not candidates:
             return False
         budgets = build_disruption_budgets(self.cluster, self.store, self.clock)
@@ -129,7 +136,8 @@ class DisruptionController:
         for method in self.methods:
             if method.is_consolidation and fence == self._noop_fence:
                 continue  # nothing moved since the last fruitless search
-            cmd = method.compute_command(list(candidates), budgets)
+            with self.registry.measure(m.DISRUPTION_EVAL_DURATION, method=type(method).__name__):
+                cmd = method.compute_command(list(candidates), budgets)
             if cmd is None or not cmd.candidates:
                 continue
             if method.needs_validation:
@@ -195,6 +203,12 @@ class DisruptionController:
         self.cluster.mark_for_deletion(*[c.provider_id for c in cmd.candidates])
         # 4. orchestrate deletion (:225)
         self.queue.add(cmd)
+        from karpenter_tpu.operator import metrics as m
+
+        self.registry.counter(m.DISRUPTION_ACTIONS, "disruption commands executed").inc(
+            action=cmd.action, reason=cmd.reason)
+        self.registry.counter(m.DISRUPTION_PODS, "pods displaced by disruption").inc(
+            sum(len(c.reschedulable_pods) for c in cmd.candidates), reason=cmd.reason)
         if self.recorder is not None:
             self.recorder.publish(
                 "DisruptionLaunching",

@@ -1,0 +1,18 @@
+"""Metric exporter controllers: cluster state as Prometheus gauges.
+
+Mirror of the reference's pkg/controllers/metrics/{node,nodepool,pod}
+(controller.go in each): periodic sweeps rebuilding gauge families for
+node allocatable, pod phase/state counts, and nodepool usage vs limit.
+"""
+
+from karpenter_tpu.controllers.metrics.exporters import (
+    NodeMetricsController,
+    NodePoolMetricsController,
+    PodMetricsController,
+)
+
+__all__ = [
+    "NodeMetricsController",
+    "NodePoolMetricsController",
+    "PodMetricsController",
+]

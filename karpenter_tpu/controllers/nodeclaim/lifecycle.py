@@ -20,19 +20,27 @@ from karpenter_tpu.api.nodeclaim import (
     COND_REGISTERED,
 )
 from karpenter_tpu.cloudprovider.types import InsufficientCapacityError, NodeClaimNotFoundError
+from karpenter_tpu.operator import metrics as m
 from karpenter_tpu.scheduling.taints import KNOWN_EPHEMERAL_TAINTS
 
 REGISTRATION_TTL = 15 * 60.0  # liveness.go:40
 
 
 class NodeClaimLifecycleController:
-    def __init__(self, store, cloud, clock=None, recorder=None):
+    def __init__(self, store, cloud, clock=None, recorder=None, registry=None):
         from karpenter_tpu.utils.clock import Clock
 
         self.store = store
         self.cloud = cloud
         self.clock = clock or Clock()
         self.recorder = recorder
+        self.registry = registry or m.REGISTRY
+
+    def _count(self, family: str, claim):
+        """Machine-lifecycle counter labelled by nodepool
+        (pkg/metrics/metrics.go:30)."""
+        self.registry.counter(family).inc(
+            nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""))
 
     def on_event(self, event):
         pass  # reconciled via poll() sweeps in the hermetic runtime
@@ -77,6 +85,7 @@ class NodeClaimLifecycleController:
         claim.metadata.labels = dict(launched.metadata.labels)
         claim.set_condition(COND_LAUNCHED, now=self.clock.now())
         self.store.update("nodeclaims", claim)
+        self._count(m.NODECLAIMS_LAUNCHED, claim)
         return True
 
     # -- registration (lifecycle/registration.go:43) ---------------------
@@ -97,6 +106,7 @@ class NodeClaimLifecycleController:
         claim.status.node_name = node.name
         claim.set_condition(COND_REGISTERED, now=self.clock.now())
         self.store.update("nodeclaims", claim)
+        self._count(m.NODECLAIMS_REGISTERED, claim)
         return True
 
     # -- initialization (lifecycle/initialization.go:49) -----------------
@@ -116,6 +126,7 @@ class NodeClaimLifecycleController:
         self.store.update("nodes", node)
         claim.set_condition(COND_INITIALIZED, now=self.clock.now())
         self.store.update("nodeclaims", claim)
+        self._count(m.NODECLAIMS_INITIALIZED, claim)
         return True
 
     # -- liveness (lifecycle/liveness.go:40) -----------------------------
@@ -147,6 +158,7 @@ class NodeClaimLifecycleController:
             f for f in claim.metadata.finalizers if f != wk.TERMINATION_FINALIZER
         ]
         self.store.update("nodeclaims", claim)
+        self._count(m.NODECLAIMS_TERMINATED, claim)
         return True
 
     def _node_for(self, claim):

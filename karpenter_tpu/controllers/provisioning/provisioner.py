@@ -101,12 +101,14 @@ def nodepool_ready(np) -> bool:
 
 
 class Provisioner:
-    def __init__(self, store, cloud, solver=None, clock=None, batcher=None, recorder=None, cluster=None):
+    def __init__(self, store, cloud, solver=None, clock=None, batcher=None, recorder=None, cluster=None, registry=None):
+        from karpenter_tpu.operator import metrics as m
         from karpenter_tpu.utils.clock import Clock
 
         self.store = store
         self.cloud = cloud
         self.clock = clock or Clock()
+        self.registry = registry or m.REGISTRY
         self.solver = solver or make_solver()
         # production default: the reference's 1s idle / 10s max debounce
         # window (options.go:96-97); test environments inject a 0/0 batcher
@@ -141,7 +143,10 @@ class Provisioner:
         if self.cluster is not None and not self.cluster.synced():
             self.batcher.trigger()  # retry next round
             return False
-        results = self.schedule()
+        from karpenter_tpu.operator import metrics as m
+
+        with self.registry.measure(m.SCHEDULING_DURATION):
+            results = self.schedule()
         if results is None:
             return False
         return self.create_node_claims(results)
@@ -199,6 +204,27 @@ class Provisioner:
                     for r, v in resutil.parse_resources(np.spec.limits).items()
                 }
 
+        # pods with unresolvable PVCs can't schedule: report and drop from
+        # the batch (ValidatePersistentVolumeClaims, volumetopology.go:155)
+        from karpenter_tpu.scheduling.volumetopology import PVCError, VolumeTopology
+
+        vt = VolumeTopology(self.store)
+        valid_pods = []
+        for p in pods:
+            try:
+                vt.validate(p)
+                valid_pods.append(p)
+            except PVCError as e:
+                if self.recorder is not None:
+                    self.recorder.publish("FailedScheduling", str(e), obj=p)
+        pods = valid_pods
+        if not pods:
+            # explicit-pods callers (disruption simulation) expect a results
+            # object, never None — an all-filtered batch solves to nothing
+            from karpenter_tpu.models.scheduler import SchedulerResults
+
+            return SchedulerResults(new_claims=[], existing_nodes=[], pod_errors={})
+
         view = (
             ClusterStateView(self.cluster, self.store)
             if self.cluster is not None
@@ -214,6 +240,7 @@ class Provisioner:
             existing_nodes=existing_nodes,
             daemon_overhead=overhead,
             limits=limits or None,
+            volume_topology=vt,
         )
         results.truncate_instance_types()
         return results

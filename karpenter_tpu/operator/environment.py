@@ -29,16 +29,37 @@ class Environment:
         enable_disruption: bool = False,
         disruption_options: dict | None = None,
         validation_ttl: float | None = None,
+        provider_metrics: bool = True,
+        options=None,
     ):
+        from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
         from karpenter_tpu.controllers.provisioning.batcher import Batcher
+        from karpenter_tpu.operator.events import Recorder
+        from karpenter_tpu.operator.metrics import Registry
+        from karpenter_tpu.operator.options import Options
 
+        self.options = options or Options.from_env()
         self.clock = clock or FakeClock()
         self.store = KubeStore(self.clock)
+        self.recorder = Recorder(clock=self.clock)
+        # per-environment registry: two Environments in one process (the
+        # pytest norm) must not clobber each other's gauge sweeps
+        self.registry = Registry()
         self.cloud = cloud or KwokCloudProvider(self.store, instance_types)
+        if provider_metrics and not isinstance(self.cloud, MetricsCloudProvider):
+            self.cloud = MetricsCloudProvider(self.cloud, registry=self.registry)
         self.binder = Binder(self.store)
         self.cluster = Cluster(self.store, clock=self.clock)
         # sync mode collapses the batch window so tests drive deterministically
-        batcher = Batcher(self.clock, idle_duration=0.0, max_duration=0.0) if sync else None
+        batcher = (
+            Batcher(self.clock, idle_duration=0.0, max_duration=0.0)
+            if sync
+            else Batcher(
+                self.clock,
+                idle_duration=self.options.batch_idle_duration,
+                max_duration=self.options.batch_max_duration,
+            )
+        )
         self.provisioner = Provisioner(
             self.store,
             self.cloud,
@@ -46,6 +67,8 @@ class Environment:
             clock=self.clock,
             batcher=batcher,
             cluster=self.cluster,
+            recorder=self.recorder,
+            registry=self.registry,
         )
         from karpenter_tpu.controllers.disruption import DisruptionController
         from karpenter_tpu.controllers.node.leasegc import LeaseGarbageCollectionController
@@ -67,24 +90,39 @@ class Environment:
         from karpenter_tpu.controllers.nodepool.validation import (
             NodePoolValidationController,
         )
+        from karpenter_tpu.controllers.metrics import (
+            NodeMetricsController,
+            NodePoolMetricsController,
+            PodMetricsController,
+        )
         from karpenter_tpu.kube.daemonset import DaemonSetController
         from karpenter_tpu.kube.workload import WorkloadController
 
         self.controllers = [
             NodePoolHashController(self.store),
-            NodePoolValidationController(self.store),
+            NodePoolValidationController(self.store, recorder=self.recorder),
             NodePoolReadinessController(self.store),
             NodePoolCounterController(self.store),
-            NodeClaimLifecycleController(self.store, self.cloud, clock=self.clock),
+            NodeClaimLifecycleController(
+                self.store, self.cloud, clock=self.clock, recorder=self.recorder,
+                registry=self.registry,
+            ),
             NodeClaimDisruptionController(
                 self.store, self.cloud, self.cluster, clock=self.clock
             ),
-            NodeClaimGarbageCollectionController(self.store, self.cloud, clock=self.clock),
-            NodeClaimConsistencyController(self.store, clock=self.clock),
-            NodeTerminationController(self.store, clock=self.clock),
-            LeaseGarbageCollectionController(self.store),
+            NodeClaimGarbageCollectionController(
+                self.store, self.cloud, clock=self.clock, recorder=self.recorder
+            ),
+            NodeClaimConsistencyController(
+                self.store, clock=self.clock, recorder=self.recorder
+            ),
+            NodeTerminationController(self.store, clock=self.clock, recorder=self.recorder),
+            LeaseGarbageCollectionController(self.store, recorder=self.recorder),
             DaemonSetController(self.store),
             WorkloadController(self.store),
+            NodeMetricsController(self.store, registry=self.registry),
+            PodMetricsController(self.store, registry=self.registry),
+            NodePoolMetricsController(self.store, registry=self.registry),
         ]
         self.disruption = None
         if enable_disruption:
@@ -94,11 +132,15 @@ class Environment:
                 self.cloud,
                 self.provisioner,
                 clock=self.clock,
-                options=disruption_options,
+                recorder=self.recorder,
+                # feature gates feed the method ladder (spot_to_spot gate,
+                # consolidation.go:214); explicit disruption_options win
+                options={**self.options.feature_gates, **(disruption_options or {})},
                 poll_period=0.0 if sync else 10.0,
                 validation_ttl=(
                     validation_ttl if validation_ttl is not None else (0.0 if sync else 15.0)
                 ),
+                registry=self.registry,
             )
             self.controllers.append(self.disruption)
 

@@ -1,0 +1,200 @@
+"""In-process metrics: Prometheus-shaped counters/gauges/histograms.
+
+Mirror of the reference's pkg/metrics (metrics.go:30-148, constants.go:65):
+the same namespaced metric families (karpenter_*), a `measure()` timer that
+plays the role of the reference's `metrics.Measure` closure helper, and a
+text exposition dump compatible with the Prometheus format so an operator
+can scrape or snapshot it. No client library dependency — the registry is
+a couple of dicts guarded by a lock, cheap enough to sit on the solve path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+NAMESPACE = "karpenter"
+
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0,
+)
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, registry: "Registry"):
+        self.name = name
+        self.help = help
+        self._lock = registry._lock
+
+    def _expose_header(self, kind: str) -> list:
+        return [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {kind}"]
+
+
+class Counter(_Metric):
+    def __init__(self, name, help, registry):
+        super().__init__(name, help, registry)
+        self._values: dict = {}
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def expose(self) -> list:
+        out = self._expose_header("counter")
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help, registry):
+        super().__init__(name, help, registry)
+        self._values: dict = {}
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._values[_labels_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def clear(self):
+        """Exporters rebuild the full gauge family each sweep (the
+        controllers/metrics/* pattern of delete-then-set)."""
+        with self._lock:
+            self._values.clear()
+
+    def expose(self) -> list:
+        out = self._expose_header("gauge")
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help, registry, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, registry)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict = {}  # labels -> [per-bucket cumulative-ready counts]
+        self._sum: dict = {}
+        self._total: dict = {}
+
+    def observe(self, value: float, **labels):
+        key = _labels_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sum[key] = self._sum.get(key, 0.0) + value
+            self._total[key] = self._total.get(key, 0) + 1
+
+    def count(self, **labels) -> int:
+        return self._total.get(_labels_key(labels), 0)
+
+    def sum(self, **labels) -> float:
+        return self._sum.get(_labels_key(labels), 0.0)
+
+    def expose(self) -> list:
+        out = self._expose_header("histogram")
+        for key in sorted(self._total):
+            for i, b in enumerate(self.buckets):
+                bkey = key + (("le", str(b)),)
+                out.append(f"{self.name}_bucket{_fmt_labels(bkey)} {self._counts[key][i]}")
+            out.append(f"{self.name}_bucket{_fmt_labels(key + (('le', '+Inf'),))} {self._total[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sum[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {self._total[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: dict = {}
+
+    def _get_or_make(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, self, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_make(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(Histogram, name, help, buckets=buckets)
+
+    def expose(self) -> str:
+        """Prometheus text exposition of every registered family."""
+        lines = []
+        with self._lock:
+            for name in sorted(self._metrics):
+                lines.extend(self._metrics[name].expose())
+        return "\n".join(lines) + "\n"
+
+    @contextmanager
+    def measure(self, histogram_name: str, **labels):
+        """Timer context: the reference's metrics.Measure closure
+        (pkg/metrics/constants.go:65)."""
+        hist = self.histogram(histogram_name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            hist.observe(time.perf_counter() - t0, **labels)
+
+
+# the default in-process registry, the controller-runtime-registry analog
+REGISTRY = Registry()
+
+# well-known family names (pkg/metrics/constants.go + per-package metrics.go)
+SCHEDULING_DURATION = f"{NAMESPACE}_provisioner_scheduling_duration_seconds"
+SCHEDULING_QUEUE_DEPTH = f"{NAMESPACE}_provisioner_scheduling_queue_depth"
+IGNORED_PODS = f"{NAMESPACE}_provisioner_ignored_pod_count"
+NODECLAIMS_CREATED = f"{NAMESPACE}_nodeclaims_created_total"
+NODECLAIMS_TERMINATED = f"{NAMESPACE}_nodeclaims_terminated_total"
+NODECLAIMS_LAUNCHED = f"{NAMESPACE}_nodeclaims_launched_total"
+NODECLAIMS_REGISTERED = f"{NAMESPACE}_nodeclaims_registered_total"
+NODECLAIMS_INITIALIZED = f"{NAMESPACE}_nodeclaims_initialized_total"
+DISRUPTION_EVAL_DURATION = f"{NAMESPACE}_disruption_evaluation_duration_seconds"
+DISRUPTION_ACTIONS = f"{NAMESPACE}_disruption_actions_performed_total"
+DISRUPTION_ELIGIBLE_NODES = f"{NAMESPACE}_disruption_eligible_nodes"
+DISRUPTION_PODS = f"{NAMESPACE}_disruption_pods_disrupted_total"
+DISRUPTION_BUDGETS = f"{NAMESPACE}_disruption_allowed_disruptions"
+CLUSTER_STATE_SYNCED = f"{NAMESPACE}_cluster_state_synced"
+CLOUDPROVIDER_DURATION = f"{NAMESPACE}_cloudprovider_duration_seconds"
+CLOUDPROVIDER_ERRORS = f"{NAMESPACE}_cloudprovider_errors_total"
+PODS_STATE = f"{NAMESPACE}_pods_state"
+NODES_ALLOCATABLE = f"{NAMESPACE}_nodes_allocatable"
+NODES_TOTAL = f"{NAMESPACE}_nodes_count"
+NODEPOOL_USAGE = f"{NAMESPACE}_nodepool_usage"
+NODEPOOL_LIMIT = f"{NAMESPACE}_nodepool_limit"

@@ -1,0 +1,198 @@
+"""M7 ops layer: metrics registry, event recorder, cloudprovider decorator,
+metric exporters.
+
+Scenario sources: pkg/metrics (metrics.go, constants.go:65), pkg/events
+(recorder.go:47-98), pkg/cloudprovider/metrics, pkg/controllers/metrics/*.
+"""
+
+import pytest
+
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator import metrics as m
+from karpenter_tpu.operator.events import DEDUPE_TTL, Recorder
+from karpenter_tpu.operator.metrics import Registry
+from karpenter_tpu.utils.clock import FakeClock
+
+GIB = 2**30
+
+
+class TestRegistry:
+    def test_counter(self):
+        r = Registry()
+        c = r.counter("x_total", "help")
+        c.inc()
+        c.inc(2, method="Create")
+        assert c.value() == 1
+        assert c.value(method="Create") == 2
+
+    def test_gauge_clear(self):
+        r = Registry()
+        g = r.gauge("x")
+        g.set(5, pool="a")
+        g.clear()
+        assert g.value(pool="a") == 0
+
+    def test_histogram_buckets(self):
+        r = Registry()
+        h = r.histogram("d_seconds", buckets=(0.1, 1.0, 10.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        assert h.count() == 2
+        assert h.sum() == pytest.approx(5.05)
+
+    def test_measure(self):
+        r = Registry()
+        with r.measure("op_seconds", kind="solve"):
+            pass
+        assert r.histogram("op_seconds").count(kind="solve") == 1
+
+    def test_expose_format(self):
+        r = Registry()
+        r.counter("a_total", "a help").inc(3, x="1")
+        text = r.expose()
+        assert "# TYPE a_total counter" in text
+        assert 'a_total{x="1"} 3.0' in text
+
+    def test_type_conflict(self):
+        r = Registry()
+        r.counter("dup")
+        with pytest.raises(TypeError):
+            r.gauge("dup")
+
+
+class TestRecorder:
+    def test_dedupe_within_ttl(self):
+        clock = FakeClock()
+        rec = Recorder(clock=clock)
+        rec.publish("Reason", "same message")
+        rec.publish("Reason", "same message")
+        assert len(rec.events) == 1
+        assert rec.events[0].count == 2
+
+    def test_dedupe_expires(self):
+        clock = FakeClock()
+        rec = Recorder(clock=clock)
+        rec.publish("Reason", "msg")
+        clock.step(DEDUPE_TTL + 1)
+        rec.publish("Reason", "msg")
+        assert len(rec.events) == 2
+
+    def test_distinct_messages_not_deduped(self):
+        rec = Recorder(clock=FakeClock())
+        rec.publish("Reason", "a")
+        rec.publish("Reason", "b")
+        assert len(rec.events) == 2
+
+    def test_rate_limit(self):
+        clock = FakeClock()
+        rec = Recorder(clock=clock)
+        for i in range(100):
+            rec.publish("R", f"msg-{i}")  # distinct: dedupe can't absorb
+        assert len(rec.events) < 100
+        assert rec.dropped > 0
+
+    def test_object_attribution(self):
+        rec = Recorder(clock=FakeClock())
+        np_ = NodePool(metadata=ObjectMeta(name="default"))
+        rec.publish("Reason", "msg", obj=np_)
+        assert rec.events[0].object_kind == "NodePool"
+        assert rec.events[0].object_name == "default"
+
+
+class TestOptions:
+    def test_defaults(self):
+        from karpenter_tpu.operator.options import Options
+
+        o = Options.from_env()
+        assert o.batch_idle_duration == 1.0
+        assert o.batch_max_duration == 10.0
+        assert o.kube_client_qps == 200.0
+        assert not o.gate("spot_to_spot_consolidation")
+
+    def test_env_fallback(self, monkeypatch):
+        from karpenter_tpu.operator.options import Options
+
+        monkeypatch.setenv("KARPENTER_BATCH_IDLE_DURATION", "2.5")
+        monkeypatch.setenv("KARPENTER_FEATURE_GATES", "SpotToSpotConsolidation=true")
+        o = Options.from_env()
+        assert o.batch_idle_duration == 2.5
+        assert o.gate("spot_to_spot_consolidation")
+
+    def test_overrides_beat_env(self, monkeypatch):
+        from karpenter_tpu.operator.options import Options
+
+        monkeypatch.setenv("KARPENTER_BATCH_IDLE_DURATION", "2.5")
+        o = Options.from_env(batch_idle_duration=0.5)
+        assert o.batch_idle_duration == 0.5
+
+    def test_bad_gate_rejected(self):
+        from karpenter_tpu.operator.options import parse_feature_gates
+
+        with pytest.raises(ValueError):
+            parse_feature_gates("SpotToSpotConsolidation")
+        with pytest.raises(ValueError):
+            parse_feature_gates("X=maybe")
+
+    def test_gate_flows_to_disruption(self):
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+        )
+        assert env.disruption.ctx.options.get("spot_to_spot_consolidation") is False
+        from karpenter_tpu.operator.options import Options
+
+        env2 = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+            options=Options.from_env(feature_gates={"spot_to_spot_consolidation": True}),
+        )
+        assert env2.disruption.ctx.options.get("spot_to_spot_consolidation") is True
+
+
+@pytest.fixture
+def env():
+    return Environment(instance_types=[make_instance_type("small", 2, 8)])
+
+
+class TestWiring:
+    def test_provider_metrics_decorator(self, env):
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.provision(Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0, "memory": GIB}))
+        assert env.registry.histogram(m.CLOUDPROVIDER_DURATION).count(
+            method="Create", provider="kwok") >= 1
+
+    def test_scheduling_duration_observed(self, env):
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        before = env.registry.histogram(m.SCHEDULING_DURATION).count()
+        env.provision(Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0, "memory": GIB}))
+        assert env.registry.histogram(m.SCHEDULING_DURATION).count() > before
+
+    def test_lifecycle_counters(self, env):
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.provision(Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0, "memory": GIB}))
+        assert env.registry.counter(m.NODECLAIMS_LAUNCHED).value(nodepool="default") == 1
+        assert env.registry.counter(m.NODECLAIMS_INITIALIZED).value(nodepool="default") == 1
+
+    def test_exporters_sweep(self, env):
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.provision(Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0, "memory": GIB}))
+        assert env.registry.gauge(m.NODES_TOTAL).value(nodepool="default") == 1
+        assert env.registry.gauge(m.PODS_STATE).value(
+            phase="Running", bound="true", namespace="default") == 1
+
+    def test_registries_isolated_between_environments(self):
+        a = Environment(instance_types=[make_instance_type("small", 2, 8)])
+        b = Environment(instance_types=[make_instance_type("small", 2, 8)])
+        a.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        a.provision(Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0, "memory": GIB}))
+        b.run_until_idle()  # b's exporter sweeps must not wipe a's gauges
+        assert a.registry.gauge(m.NODES_TOTAL).value(nodepool="default") == 1
+        assert b.registry.gauge(m.NODES_TOTAL).value(nodepool="default") == 0
+
+    def test_failed_scheduling_event(self, env):
+        # no nodepool: pod can't schedule; the provisioner publishes an event
+        env.provision(Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0, "memory": GIB}))
+        assert env.recorder.by_reason("FailedScheduling")
