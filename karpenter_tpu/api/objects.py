@@ -167,6 +167,38 @@ class PersistentVolumeClaimRef:
 
 
 @dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: str = ""
+    volume_name: str = ""  # set once bound to a PV
+    requests: dict = field(default_factory=dict)  # {"storage": bytes}
+    phase: str = "Pending"  # Pending | Bound
+
+
+@dataclass
+class PersistentVolume:
+    """Only the scheduling-relevant shape: required node affinity
+    (zone pinning) and the local/hostPath marker that voids hostname
+    affinity on reschedule (volumetopology.go:128-152)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    node_affinity_required: list = field(default_factory=list)  # [NodeSelectorTerm] (ORed)
+    local: bool = False  # Local or HostPath volume source
+    csi_driver: str = ""
+    capacity: dict = field(default_factory=dict)
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    # [{key: str, values: [str]}] — first term's matchLabelExpressions
+    # (storageclass AllowedTopologies, volumetopology.go:112-125)
+    allowed_topologies: list = field(default_factory=list)
+    volume_binding_mode: str = "WaitForFirstConsumer"
+
+
+@dataclass
 class Pod:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     # scheduling inputs

@@ -55,6 +55,7 @@ KINDS = (
     "deployments",
     "pdbs",
     "pvcs",
+    "pvs",
     "storageclasses",
     "namespaces",
     "leases",
@@ -201,6 +202,9 @@ class KubeStore:
 
     def get_storage_class(self, name: str):
         return self.try_get("storageclasses", name) if name else None
+
+    def get_pv(self, name: str):
+        return self.try_get("pvs", name) if name else None
 
 
 def _resolve_count(value, total: int) -> int:

@@ -93,6 +93,7 @@ class Scheduler:
         daemon_overhead: dict | None = None,  # nodepool name -> ResourceList
         remaining_resources: dict | None = None,  # nodepool name -> ResourceList (limits)
         recorder=None,
+        volume_topology=None,  # VolumeTopology: PV/SC zone pins (volumetopology.go:42)
     ):
         self.templates = sorted(templates, key=lambda t: (-t.weight, t.nodepool_name))
         self.instance_types = instance_types
@@ -102,6 +103,7 @@ class Scheduler:
         self.remaining_resources = dict(remaining_resources or {})
         self.preferences = Preferences()
         self.recorder = recorder
+        self.volume_topology = volume_topology
         self.new_claims: list = []
 
     def solve(self, pods) -> SchedulerResults:
@@ -110,6 +112,11 @@ class Scheduler:
         # caller's own objects back in the results
         originals = {p.uid: p for p in pods}
         pods = [p.clone() for p in pods]
+        if self.volume_topology is not None:
+            # zone pins from bound PVs / storage classes AND into the
+            # clones' node affinity; the caller's objects stay untouched
+            for p in pods:
+                self.volume_topology.inject(p)
         errors: dict = {}
         pod_by_uid = {}
         q = SchedulingQueue(pods)

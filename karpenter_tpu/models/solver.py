@@ -42,6 +42,7 @@ class HostSolver(Solver):
         daemon_overhead=None,
         limits=None,
         initial_claims=(),
+        volume_topology=None,
     ) -> SchedulerResults:
         sched = Scheduler(
             templates,
@@ -50,6 +51,7 @@ class HostSolver(Solver):
             existing_nodes=existing_nodes,
             daemon_overhead=daemon_overhead,
             remaining_resources=limits,
+            volume_topology=volume_topology,
         )
         sched.new_claims = list(initial_claims)
         return sched.solve(pods)
@@ -89,6 +91,7 @@ class TPUSolver(Solver):
         daemon_overhead=None,
         limits=None,
         max_bins: int | None = None,
+        volume_topology=None,
     ) -> SchedulerResults:
         # Existing-node scheduling and topology-group waves join the device
         # path incrementally; those snapshots route through the host loop.
@@ -102,6 +105,7 @@ class TPUSolver(Solver):
                 existing_nodes=existing_nodes,
                 daemon_overhead=daemon_overhead,
                 limits=limits,
+                volume_topology=volume_topology,
             )
 
         # weight order decides which template a new bin opens from
@@ -117,6 +121,7 @@ class TPUSolver(Solver):
                 instance_types,
                 daemon_overhead=daemon_overhead,
                 limits=limits,
+                volume_topology=volume_topology,
             )
 
         snap = tensorize(
@@ -150,6 +155,7 @@ class TPUSolver(Solver):
                 daemon_overhead=daemon_overhead,
                 limits=limits,
                 initial_claims=claims,
+                volume_topology=volume_topology,
             )
         for claim in claims:
             claim.finalize()

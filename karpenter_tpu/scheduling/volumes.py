@@ -27,9 +27,9 @@ class VolumeUsage:
             if kube is not None:
                 pvc = kube.get_pvc(pod.namespace, claim)
                 if pvc is not None:
-                    sc = kube.get_storage_class(pvc.get("storageClassName", ""))
-                    driver = (sc or {}).get("provisioner", "")
-                    vol_id = pvc.get("volumeName") or vol_id
+                    sc = kube.get_storage_class(getattr(pvc, "storage_class_name", ""))
+                    driver = getattr(sc, "provisioner", "") if sc is not None else ""
+                    vol_id = getattr(pvc, "volume_name", "") or vol_id
             out.append((driver, vol_id))
         return out
 

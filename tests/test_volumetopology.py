@@ -1,0 +1,183 @@
+"""Volume topology injection: PV/StorageClass zone pins steer scheduling.
+
+Scenario sources: the reference's volumetopology suite
+(pkg/controllers/provisioning/scheduling/volumetopology.go:42-152 and the
+zonal-PV specs in scheduling suites).
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimRef,
+    Pod,
+    StorageClass,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.scheduling.volumetopology import PVCError, VolumeTopology
+
+GIB = 2**30
+
+
+def pod(name, claims=(), **kw):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        requests={"cpu": 1.0, "memory": GIB},
+        volumes=[PersistentVolumeClaimRef(claim_name=c) for c in claims],
+        **kw,
+    )
+
+
+def zonal_pv(name, zone, local=False):
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name, namespace=""),
+        node_affinity_required=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, "In", [zone]),
+                NodeSelectorRequirement(wk.HOSTNAME_LABEL, "In", ["old-node"]),
+            ] if local else [
+                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, "In", [zone]),
+            ])
+        ],
+        local=local,
+    )
+
+
+@pytest.fixture
+def env():
+    return Environment(instance_types=[make_instance_type("small", 4, 16)])
+
+
+class TestInjection:
+    def test_bound_pv_pins_zone(self, env):
+        env.create("pvs", zonal_pv("pv-1", "zone-2"))
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), volume_name="pv-1"))
+        vt = VolumeTopology(env.store)
+        p = pod("p1", claims=["data"])
+        vt.inject(p)
+        exprs = p.affinity.node_affinity.required[0].match_expressions
+        assert any(e.key == wk.TOPOLOGY_ZONE_LABEL and e.values == ["zone-2"] for e in exprs)
+
+    def test_local_pv_drops_hostname(self, env):
+        env.create("pvs", zonal_pv("pv-1", "zone-2", local=True))
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), volume_name="pv-1"))
+        vt = VolumeTopology(env.store)
+        p = pod("p1", claims=["data"])
+        vt.inject(p)
+        exprs = p.affinity.node_affinity.required[0].match_expressions
+        assert not any(e.key == wk.HOSTNAME_LABEL for e in exprs)
+        assert any(e.key == wk.TOPOLOGY_ZONE_LABEL for e in exprs)
+
+    def test_storage_class_topology(self, env):
+        env.create("storageclasses", StorageClass(
+            metadata=ObjectMeta(name="zonal-ssd", namespace=""),
+            provisioner="csi.test",
+            allowed_topologies=[{"match_label_expressions": [
+                {"key": wk.TOPOLOGY_ZONE_LABEL, "values": ["zone-3"]}]}]))
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), storage_class_name="zonal-ssd"))
+        vt = VolumeTopology(env.store)
+        p = pod("p1", claims=["data"])
+        vt.inject(p)
+        exprs = p.affinity.node_affinity.required[0].match_expressions
+        assert any(e.key == wk.TOPOLOGY_ZONE_LABEL and e.values == ["zone-3"] for e in exprs)
+
+    def test_injected_into_every_term(self, env):
+        from karpenter_tpu.api.objects import Affinity, NodeAffinity
+
+        env.create("pvs", zonal_pv("pv-1", "zone-2"))
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), volume_name="pv-1"))
+        vt = VolumeTopology(env.store)
+        p = pod("p1", claims=["data"], affinity=Affinity(node_affinity=NodeAffinity(
+            required=[
+                NodeSelectorTerm(match_expressions=[
+                    NodeSelectorRequirement(wk.ARCH_LABEL, "In", ["amd64"])]),
+                NodeSelectorTerm(match_expressions=[
+                    NodeSelectorRequirement(wk.ARCH_LABEL, "In", ["arm64"])]),
+            ])))
+        vt.inject(p)
+        for term in p.affinity.node_affinity.required:
+            assert any(e.key == wk.TOPOLOGY_ZONE_LABEL for e in term.match_expressions)
+
+    def test_no_volumes_no_change(self, env):
+        vt = VolumeTopology(env.store)
+        p = pod("p1")
+        vt.inject(p)
+        assert p.affinity is None
+
+
+class TestValidation:
+    def test_missing_pvc(self, env):
+        vt = VolumeTopology(env.store)
+        with pytest.raises(PVCError):
+            vt.validate(pod("p1", claims=["ghost"]))
+
+    def test_missing_storageclass(self, env):
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), storage_class_name="ghost-sc"))
+        vt = VolumeTopology(env.store)
+        with pytest.raises(PVCError):
+            vt.validate(pod("p1", claims=["data"]))
+
+    def test_valid_passes(self, env):
+        env.create("pvs", zonal_pv("pv-1", "zone-1"))
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), volume_name="pv-1"))
+        VolumeTopology(env.store).validate(pod("p1", claims=["data"]))
+
+
+class TestEndToEnd:
+    def test_pod_lands_in_pv_zone(self, env):
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.create("pvs", zonal_pv("pv-1", "zone-2"))
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), volume_name="pv-1"))
+        (p,) = env.provision(pod("p1", claims=["data"]))
+        assert p.node_name
+        node = env.store.get("nodes", p.node_name)
+        assert node.labels[wk.TOPOLOGY_ZONE_LABEL] == "zone-2"
+
+    def test_invalid_pvc_reports_event(self, env):
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        (p,) = env.provision(pod("p1", claims=["ghost"]))
+        assert not p.node_name
+        assert env.store.list("nodes") == []
+        assert any("ghost" in e.message for e in env.recorder.by_reason("FailedScheduling"))
+
+    def test_pvc_pods_never_device_eligible(self):
+        # the device bin-packer has no volume-affinity notion; any pod with
+        # volumes MUST route through the host loop where injection runs
+        from karpenter_tpu.ops.tensorize import device_eligible
+
+        assert not device_eligible(pod("p1", claims=["data"]))
+        assert device_eligible(pod("p2"))
+
+    def test_empty_explicit_pods_returns_results(self, env):
+        # disruption simulation passes explicit pod lists and requires a
+        # results object, never None — even when validation drops everything
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.run_until_idle()
+        res = env.provisioner.schedule(pods=[], state_nodes=[])
+        assert res is not None and res.new_claims == []
+        res2 = env.provisioner.schedule(pods=[pod("bad", claims=["ghost"])], state_nodes=[])
+        assert res2 is not None and res2.new_claims == []
+
+    def test_pod_spec_not_mutated(self, env):
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.create("pvs", zonal_pv("pv-1", "zone-2"))
+        env.create("pvcs", PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data"), volume_name="pv-1"))
+        (p,) = env.provision(pod("p1", claims=["data"]))
+        # injection happens on solver-side clones; the stored pod keeps its
+        # original spec
+        assert p.affinity is None
