@@ -17,6 +17,7 @@ import math
 
 import numpy as np
 
+from karpenter_tpu.api import labels as wk
 from karpenter_tpu.models.inflight import InFlightNodeClaim
 from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
 from karpenter_tpu.ops import tensorize
@@ -112,8 +113,13 @@ class TPUSolver(Solver):
         # (scheduler.go:267 tries templates in weight order)
         templates = sorted(templates, key=lambda t: (-t.weight, t.nodepool_name))
 
-        eligible = [p for p in pods if device_eligible(p)]
-        rest = [p for p in pods if not device_eligible(p)]
+        eligible, rest = [], []
+        for p in pods:
+            ok = p.__dict__.get("_elig_cache")
+            if ok is None:
+                ok = device_eligible(p)
+                p.__dict__["_elig_cache"] = ok
+            (eligible if ok else rest).append(p)
         if not eligible:
             return self.host.solve(
                 pods,
@@ -127,7 +133,17 @@ class TPUSolver(Solver):
         snap = tensorize(
             eligible, templates, instance_types, daemon_overhead=daemon_overhead, limits=limits
         )
-        claims, retry = self._run_and_decode(snap, max_bins)
+        claims, retry, bins, exhausted = self._run_and_decode(snap, max_bins)
+        # estimated bin axis ran dry with pods left over: double and re-run
+        # on device (exact result, one more kernel dispatch) instead of
+        # pushing thousands of leftovers through the host loop. Gates on the
+        # kernel's own bin usage, not post-validation claim count — a
+        # validation-dropped bin must not mask a dry axis, and pure
+        # validation retries must not spin doubled re-runs.
+        total = sum(len(g) for g in snap.groups)
+        while retry and max_bins is None and exhausted and bins < min(total, 4096):
+            claims, retry, bins, exhausted = self._run_and_decode(
+                snap, min(2 * bins, 4096))
         self.last_device_stats = dict(
             groups=snap.G,
             types=snap.T,
@@ -167,7 +183,22 @@ class TPUSolver(Solver):
         R = len(snap.resources)
         M = len(snap.templates)
         total_pods = int(snap.g_count.sum())
-        B = max_bins or min(max(total_pods, 1), 4096)
+        if max_bins:
+            B = max_bins
+        else:
+            # the pack scan is bin-sequential on device, so its latency is
+            # proportional to B: size it from a per-resource lower bound
+            # (total demand / biggest allocatable) with 2x FFD headroom.
+            # If the estimate runs out, the unplaced remainder re-runs with
+            # a doubled axis (exact, just slower) rather than falling to
+            # the host loop.
+            demand_tot = (snap.g_demand * snap.g_count[:, None]).sum(axis=0)
+            max_alloc = snap.t_alloc.max(axis=0) if T else np.ones(R, dtype=np.float32)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                lb = np.where(max_alloc > 0, np.ceil(demand_tot / max_alloc), 0.0)
+            est = int(np.nanmax(lb)) if lb.size else 1
+            # 1.5x FFD headroom: the doubling re-run below catches a miss
+            B = min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
         Gp, Tp, Bp = _bucket(G), _bucket(T), _bucket(B)
 
         def pad(a, shape):
@@ -201,50 +232,107 @@ class TPUSolver(Solver):
         args["off_ct"][:T] = snap.off_ct
         # padded types must be infeasible: zero alloc fails fits (pods>=1)
 
+        import jax
+
         key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], Bp)
         out = self._kernel(key)(args)
-        assign = np.asarray(out["assign"])[:G, :Bp]
-        used = np.asarray(out["used"])
-        types = np.asarray(out["types"])[:, :T]
-        tmpl = np.asarray(out["tmpl"])
+        # one batched device→host fetch: over a tunneled chip each separate
+        # pull pays a full round trip, which dominates these tiny arrays
+        host = jax.device_get(
+            {k: out[k] for k in ("assign", "used", "tmpl", "F")}
+        )
+        assign = host["assign"][:G, :Bp]
+        used = host["used"]
+        tmpl = host["tmpl"]
+        # F (G×T per-group feasibility) replaces the big per-bin `types`
+        # matrix on the host: exact for single-group bins, a sound
+        # prefilter for multi-group joint validation
+        feas = host["F"][:G, :T]
 
-        return self._decode(snap, assign, used, types, tmpl)
+        claims, retry = self._decode(snap, assign, used, feas, tmpl)
+        exhausted = bool(used[:B].all())
+        return claims, retry, B, exhausted
 
-    def _decode(self, snap, assign, used, types, tmpl):
+    def _decode(self, snap, assign, used, feas, tmpl):
         """Bins → InFlightNodeClaims, with host-side validation of each
         claim's joint instance-type set (the kernel approximates joint
         offering feasibility by intersecting per-group feasibility)."""
-        from karpenter_tpu.cloudprovider.types import filter_instance_types, satisfies_min_values
+        from karpenter_tpu.cloudprovider.types import (
+            instance_type_compatible,
+            satisfies_min_values,
+        )
 
         cursors = [0] * snap.G
         claims = []
         retry = []
         topology = NullTopology()
-        for b in range(assign.shape[1]):
-            if not used[b] or assign[:, b].sum() == 0:
-                continue
+        R = len(snap.resources)
+        # per-bin totals in one matmul, in float64 from the source demand
+        # dicts — the f32 kernel tensors are too coarse at memory-byte scale
+        demand64 = np.array(
+            [[d.get(r, 0.0) for r in snap.resources] for d in snap.group_demand],
+            dtype=np.float64,
+        ).reshape(snap.G, R)
+        Bax = assign.shape[1]
+        cols = np.flatnonzero(used[:Bax] & (assign.sum(axis=0) > 0))
+        breq = assign[:, cols].T.astype(np.float64) @ demand64
+        breq += snap.m_overhead.astype(np.float64)[tmpl[cols]]
+        # bins sharing a (template, group-composition) key have identical
+        # requirements, so the expensive requirement∧offering compat filter
+        # runs once per distinct key; per-bin work is only the resource-fit
+        # check (many bins are clones in a deployment burst)
+        compat_cache: dict = {}
+        for ci, b in enumerate(cols):
             m = int(tmpl[b])
             template = snap.templates[m]
             bin_pods = []
-            bin_reqs = template.requirements.copy()
-            # requests accumulate in float64 from the source demand dicts —
-            # the f32 kernel tensors are too coarse at memory-byte scale
+            req_vec = breq[ci]
             requests = {
-                r: float(v)
-                for r, v in zip(snap.resources, snap.m_overhead[m].tolist())
-                if v > 0
+                r: float(v) for r, v in zip(snap.resources, req_vec.tolist()) if v > 0
             }
-            for g in range(snap.G):
+            gset = []
+            for g in np.flatnonzero(assign[:, b]).tolist():
                 c = int(assign[g, b])
-                if c == 0:
-                    continue
+                gset.append(g)
                 bin_pods.extend(snap.groups[g][cursors[g] : cursors[g] + c])
                 cursors[g] += c
-                bin_reqs.add(*snap.group_reqs[g].values())
-                requests = resutil.merge(
-                    requests, {r: v * c for r, v in snap.group_demand[g].items()}
-                )
-            its = [snap.type_refs[t][1] for t in range(snap.T) if types[b, t] and snap.type_refs[t][0] == m]
+            key = (m, tuple(gset))
+            cached = compat_cache.get(key)
+            if cached is None:
+                bin_reqs = template.requirements.copy()
+                for g in gset:
+                    bin_reqs.add(*snap.group_reqs[g].values())
+                # candidate types: AND of the device's per-group feasibility
+                # rows — a sound PREFILTER, not the joint answer: F is
+                # pairwise (group×type), so it misses three-way value
+                # intersections (template ∩ pod ∩ type each pairwise-overlap
+                # but jointly empty) and cross-offering splits. The host
+                # re-checks the merged requirement set on every survivor,
+                # once per distinct (template, group-set) key.
+                joint = feas[gset[0]]
+                for g in gset[1:]:
+                    joint = joint & feas[g]
+                candidates = [
+                    (t, snap.type_refs[t][1])
+                    for t in np.flatnonzero(joint)
+                    if snap.type_refs[t][0] == m
+                    and instance_type_compatible(snap.type_refs[t][1], bin_reqs, None)
+                ]
+                # allocatable matrix over the snapshot resource axis: the
+                # per-bin fit check becomes one vectorized compare
+                alloc = np.array(
+                    [
+                        [it.allocatable().get(r, 0.0) for r in snap.resources]
+                        for _, it in candidates
+                    ],
+                    dtype=np.float64,
+                ).reshape(len(candidates), len(snap.resources))
+                cached = (bin_reqs, candidates, alloc)
+                compat_cache[key] = cached
+            bin_reqs, compat, alloc = cached
+            # mirror resutil.fits' relative tolerance (f32 byte-scale ulp)
+            ok = (req_vec <= alloc + 1e-9 + 1e-6 * np.abs(alloc)).all(axis=1)
+            its = [it for (_, it), good in zip(compat, ok) if good]
             claim = InFlightNodeClaim(
                 template,
                 topology,
@@ -253,9 +341,13 @@ class TPUSolver(Solver):
             )
             claim.pods = bin_pods
             claim.requests = requests
-            claim.requirements.add(*bin_reqs.values())
-            # host-side joint validation
-            remaining = filter_instance_types(claim.instance_types, claim.requirements, claim.requests)
+            # bin_reqs already is template ∪ groups: replace instead of
+            # re-intersecting ~K requirements per bin, keeping only the
+            # hostname row the constructor added
+            hostname_req = claim.requirements.get_req(wk.HOSTNAME_LABEL)
+            claim.requirements = bin_reqs.copy()
+            claim.requirements.add(hostname_req)
+            remaining = claim.instance_types
             if remaining and claim.requirements.has_min_values():
                 _, err = satisfies_min_values(remaining, claim.requirements)
                 if err:

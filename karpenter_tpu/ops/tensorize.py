@@ -95,18 +95,35 @@ class DeviceSnapshot:
 
 
 def pod_signature(pod) -> tuple:
-    """Scheduling-equivalence key for pod deduplication."""
-    reqs = pod_requirements(pod)
-    req_sig = tuple(
-        sorted(
-            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
-            for r in reqs.values()
+    """Scheduling-equivalence key for pod deduplication.
+
+    Derived from the RAW spec fields, not the canonical Requirements — two
+    pods with identical specs always produce identical tensors, so grouping
+    on spec tuples is sound, and it skips building 50k Requirements objects
+    on the burst path (spec-equivalent-but-differently-written pods merely
+    split into separate groups, which costs a few rows, not correctness).
+    """
+    ns = tuple(sorted(pod.node_selector.items()))
+    aff = ()
+    if pod.affinity is not None and pod.affinity.node_affinity is not None:
+        aff = tuple(
+            tuple(
+                (e.key, e.operator, tuple(e.values), e.min_values)
+                for e in term.match_expressions
+            )
+            for term in pod.affinity.node_affinity.required
         )
+    res = tuple(sorted(pod.requests.items()))
+    cont = tuple(
+        tuple(sorted((c.get("requests") or {}).items())) for c in pod.containers or ()
     )
-    res = pod.effective_requests()
-    res_sig = tuple(sorted((k, round(v, 9)) for k, v in res.items()))
+    init = tuple(
+        tuple(sorted((c.get("requests") or {}).items()))
+        for c in pod.init_containers or ()
+    )
+    ovh = tuple(sorted(pod.overhead.items()))
     tol_sig = tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations))
-    return (req_sig, res_sig, tol_sig)
+    return (ns, aff, res, cont, init, ovh, tol_sig)
 
 
 def device_eligible(pod) -> bool:
@@ -135,43 +152,57 @@ def _materialize_mask(req, vocab_k: dict, W: int) -> np.ndarray:
     return mask
 
 
-def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, limits=None):
-    """Compile a scheduling snapshot to tensors.
-
-    pods: eligible pods (caller pre-filters with device_eligible)
-    templates: [ClaimTemplate] in weight order
-    instance_types_by_pool: nodepool name -> [InstanceType]
-    daemon_overhead: nodepool name -> ResourceList
-    limits: nodepool name -> ResourceList (remaining resources; absent = inf)
-    """
-    daemon_overhead = daemon_overhead or {}
-    limits = limits or {}
-
-    # ---- group pods by signature, FFD order ----
-    by_sig: dict = {}
-    for pod in pods:
-        by_sig.setdefault(pod_signature(pod), []).append(pod)
-    groups = sorted(
-        by_sig.values(),
-        key=lambda g: (
-            -g[0].effective_requests().get(resutil.CPU, 0.0),
-            -g[0].effective_requests().get(resutil.MEMORY, 0.0),
-        ),
+def _req_fingerprint(reqs: Requirements) -> tuple:
+    return tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than,
+             r.less_than, r.min_values)
+            for r in reqs.values()
+        )
     )
-    group_reqs = [pod_requirements(g[0]) for g in groups]
-    group_demand = [g[0].effective_requests() for g in groups]
 
-    # ---- resource dimension union ----
-    res_names = {resutil.CPU, resutil.MEMORY, resutil.PODS}
-    for d in group_demand:
-        res_names.update(d.keys())
-    resources = sorted(res_names)
+
+def _template_fingerprint(tpl) -> tuple:
+    return (
+        tpl.nodepool_name,
+        tpl.weight,
+        _req_fingerprint(tpl.requirements),
+        tuple(sorted((t.key, t.value, t.effect) for t in tpl.taints)),
+    )
+
+
+# type-side tensors are a pure function of (templates, catalog, the group
+# requirement universe, the resource axis) — all static between solves in
+# steady state, so they are memoized across calls. Entries hold strong refs
+# to the catalog objects, keeping the id()-based fingerprint stable.
+_TYPE_CACHE: dict = {}
+_TYPE_CACHE_MAX = 8
+
+
+def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
+    key = (
+        tuple(_template_fingerprint(t) for t in templates),
+        tuple(
+            (t.nodepool_name, tuple(id(it) for it in instance_types_by_pool.get(t.nodepool_name, ())))
+            for t in templates
+        ),
+        frozenset(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for reqs in group_reqs
+            for r in reqs.values()
+        ),
+        tuple(resources),
+    )
+    cached = _TYPE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
     r_index = {r: i for i, r in enumerate(resources)}
 
     # ---- key/value vocabularies ----
     # collect from type requirements, template requirements, group concrete values
     def iter_reqs():
-        for m, tpl in enumerate(templates):
+        for tpl in templates:
             for r in tpl.requirements.values():
                 yield r
             for it in instance_types_by_pool.get(tpl.nodepool_name, []):
@@ -198,9 +229,7 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
     key_index = {k: i for i, k in enumerate(keys)}
     K = len(keys)
     W = _bits_for(max((len(v) for v in vocab.values()), default=1))
-
     M = len(templates)
-    G = len(groups)
 
     def build_mask_set(reqs: Requirements):
         mask = np.zeros((K, W), dtype=np.uint32)
@@ -216,16 +245,8 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
     # ---- templates ----
     m_mask = np.zeros((M, K, W), dtype=np.uint32)
     m_has = np.zeros((M, K), dtype=bool)
-    m_overhead = np.zeros((M, len(resources)), dtype=np.float32)
-    m_limits = np.full((M, len(resources)), np.inf, dtype=np.float32)
     for m, tpl in enumerate(templates):
         m_mask[m], m_has[m] = build_mask_set(tpl.requirements)
-        for r, v in daemon_overhead.get(tpl.nodepool_name, {}).items():
-            if r in r_index:
-                m_overhead[m, r_index[r]] = v
-        for r, v in limits.get(tpl.nodepool_name, {}).items():
-            if r in r_index:
-                m_limits[m, r_index[r]] = v
 
     # ---- flattened (template, type) axis; pre-filter type vs template ----
     type_refs = []
@@ -263,12 +284,95 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
             if r in r_index:
                 t_cap[t, r_index[r]] = v
         for o, off in enumerate(it.offerings):
-            z = off.zone
-            c = off.capacity_type
-            off_zone[t, o] = zone_vocab.get(z, -1)
-            off_ct[t, o] = ct_vocab.get(c, -1)
+            off_zone[t, o] = zone_vocab.get(off.zone, -1)
+            off_ct[t, o] = ct_vocab.get(off.capacity_type, -1)
             off_avail[t, o] = off.available
             off_price[t, o] = off.price
+
+    cached = dict(
+        vocab=vocab, keys=keys, key_index=key_index, W=W,
+        build_mask_set=build_mask_set,
+        m_mask=m_mask, m_has=m_has,
+        type_refs=type_refs, t_mask=t_mask, t_has=t_has,
+        t_alloc=t_alloc, t_cap=t_cap, t_tmpl=t_tmpl,
+        off_zone=off_zone, off_ct=off_ct, off_avail=off_avail,
+        off_price=off_price, zone_vocab=zone_vocab, ct_vocab=ct_vocab,
+        # strong refs to EVERY catalog object (template-filtered ones too):
+        # the id()-based cache key is only stable while nothing in the
+        # fingerprinted pool can be garbage-collected and its address reused
+        _refs=[list(instance_types_by_pool.get(t.nodepool_name, ())) for t in templates],
+    )
+    if len(_TYPE_CACHE) >= _TYPE_CACHE_MAX:
+        _TYPE_CACHE.pop(next(iter(_TYPE_CACHE)))
+    _TYPE_CACHE[key] = cached
+    return cached
+
+
+def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, limits=None):
+    """Compile a scheduling snapshot to tensors.
+
+    pods: eligible pods (caller pre-filters with device_eligible)
+    templates: [ClaimTemplate] in weight order
+    instance_types_by_pool: nodepool name -> [InstanceType]
+    daemon_overhead: nodepool name -> ResourceList
+    limits: nodepool name -> ResourceList (remaining resources; absent = inf)
+    """
+    daemon_overhead = daemon_overhead or {}
+    limits = limits or {}
+
+    # ---- group pods by signature, FFD order ----
+    # the signature is cached on the pod object: the provisioner re-solves
+    # the same (immutable-spec) Pod instances round after round, and clones
+    # (which relaxation/injection mutate) are fresh objects without the
+    # cached attribute
+    by_sig: dict = {}
+    for pod in pods:
+        sig = pod.__dict__.get("_sig_cache")
+        if sig is None:
+            sig = pod_signature(pod)
+            pod.__dict__["_sig_cache"] = sig
+        by_sig.setdefault(sig, []).append(pod)
+    groups = sorted(
+        by_sig.values(),
+        key=lambda g: (
+            -g[0].effective_requests().get(resutil.CPU, 0.0),
+            -g[0].effective_requests().get(resutil.MEMORY, 0.0),
+        ),
+    )
+    group_reqs = [pod_requirements(g[0]) for g in groups]
+    group_demand = [g[0].effective_requests() for g in groups]
+
+    # ---- resource dimension union ----
+    res_names = {resutil.CPU, resutil.MEMORY, resutil.PODS}
+    for d in group_demand:
+        res_names.update(d.keys())
+    resources = sorted(res_names)
+    r_index = {r: i for i, r in enumerate(resources)}
+
+    ts = _build_type_side(templates, instance_types_by_pool, group_reqs, resources)
+    vocab, keys, key_index, W = ts["vocab"], ts["keys"], ts["key_index"], ts["W"]
+    build_mask_set = ts["build_mask_set"]
+    type_refs = ts["type_refs"]
+    zone_vocab, ct_vocab = ts["zone_vocab"], ts["ct_vocab"]
+    K = len(keys)
+    M = len(templates)
+    G = len(groups)
+
+    # ---- per-solve template tensors (overhead/limits change per round) ----
+    m_mask, m_has = ts["m_mask"], ts["m_has"]
+    m_overhead = np.zeros((M, len(resources)), dtype=np.float32)
+    m_limits = np.full((M, len(resources)), np.inf, dtype=np.float32)
+    for m, tpl in enumerate(templates):
+        for r, v in daemon_overhead.get(tpl.nodepool_name, {}).items():
+            if r in r_index:
+                m_overhead[m, r_index[r]] = v
+        for r, v in limits.get(tpl.nodepool_name, {}).items():
+            if r in r_index:
+                m_limits[m, r_index[r]] = v
+    t_mask, t_has = ts["t_mask"], ts["t_has"]
+    t_alloc, t_cap, t_tmpl = ts["t_alloc"], ts["t_cap"], ts["t_tmpl"]
+    off_zone, off_ct = ts["off_zone"], ts["off_ct"]
+    off_avail, off_price = ts["off_avail"], ts["off_price"]
 
     # ---- groups ----
     R = len(resources)

@@ -93,6 +93,23 @@ class TestDeviceBasics:
         claim = dev.new_claims[0]
         assert claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL).values == {"zone-2"}
 
+    def test_three_way_zone_intersection(self):
+        # pool requires zone in [z1,z2]; pod requires zone in [z1,z3]; the
+        # only type offers [z2,z3]. Every PAIR overlaps but the joint
+        # template∩pod∩type set is empty — the kernel's pairwise F marks it
+        # feasible, and host-side joint validation must catch it.
+        catalog = [make_instance_type("only", 8, 32, zones=("z2", "z3"))]
+        pools = [nodepool(requirements=[
+            NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, "In", ["z1", "z2"])])]
+        pods = [pod("p1", node_selector={})]
+        pods[0].node_selector = {}
+        pods[0].affinity = Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, "In", ["z1", "z3"])])]))
+        host, dev = run_both(pods, pools, catalog)
+        assert host.node_count() == 0 and host.pod_errors
+        assert dev.node_count() == 0 and dev.pod_errors
+
     def test_taint_gating(self, catalog):
         pool = nodepool(taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")])
         tolerating = pod("tol", tolerations=[Toleration(key="dedicated", value="infra")])
