@@ -188,7 +188,7 @@ class TPUSolver(Solver):
         else:
             # the pack scan is bin-sequential on device, so its latency is
             # proportional to B: size it from a per-resource lower bound
-            # (total demand / biggest allocatable) with 2x FFD headroom.
+            # (total demand / biggest allocatable) with 1.5x FFD headroom.
             # If the estimate runs out, the unplaced remainder re-runs with
             # a doubled axis (exact, just slower) rather than falling to
             # the host loop.
@@ -330,8 +330,10 @@ class TPUSolver(Solver):
                 cached = (bin_reqs, candidates, alloc)
                 compat_cache[key] = cached
             bin_reqs, compat, alloc = cached
-            # mirror resutil.fits' relative tolerance (f32 byte-scale ulp)
-            ok = (req_vec <= alloc + 1e-9 + 1e-6 * np.abs(alloc)).all(axis=1)
+            # the vectorized form of resutil.fits' tolerance, same constants
+            ok = (
+                req_vec <= alloc + resutil._EPS + resutil.FIT_REL_EPS * np.abs(alloc)
+            ).all(axis=1)
             its = [it for (_, it), good in zip(compat, ok) if good]
             claim = InFlightNodeClaim(
                 template,

@@ -183,7 +183,16 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     key = (
         tuple(_template_fingerprint(t) for t in templates),
         tuple(
-            (t.nodepool_name, tuple(id(it) for it in instance_types_by_pool.get(t.nodepool_name, ())))
+            (
+                t.nodepool_name,
+                # identity + mutable offering state: flipping an offering's
+                # available/price in place (the standard ICE-handling
+                # pattern) must miss the cache, not serve stale tensors
+                tuple(
+                    (id(it), tuple((o.available, o.price) for o in it.offerings))
+                    for it in instance_types_by_pool.get(t.nodepool_name, ())
+                ),
+            )
             for t in templates
         ),
         frozenset(

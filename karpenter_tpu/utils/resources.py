@@ -19,6 +19,10 @@ PODS = "pods"
 ResourceList = dict  # dict[str, float]
 
 _EPS = 1e-9
+# relative slack for fit checks: byte-scale resources pass through float32
+# device tensors whose ulp at 128Gi dwarfs any absolute epsilon. Shared by
+# fits() and the solver's vectorized decode so the two paths cannot drift.
+FIT_REL_EPS = 1e-6
 
 
 def parse_resources(spec) -> ResourceList:
@@ -67,7 +71,7 @@ def fits(candidate: ResourceList, total: ResourceList) -> bool:
     """
     for k, v in (candidate or {}).items():
         cap = total.get(k, 0.0)
-        if v > cap + _EPS + 1e-6 * abs(cap):
+        if v > cap + _EPS + FIT_REL_EPS * abs(cap):
             return False
     return True
 

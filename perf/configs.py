@@ -1,0 +1,228 @@
+"""The five BASELINE.json benchmark configurations.
+
+Each builds (pods, nodepools, catalog) — or a populated Environment for the
+consolidation config — shaped after BASELINE.md "Benchmark configs to
+replicate": (1) 1k homogeneous / 10 types; (2) 10k selector+taints / 200
+types; (3) 5k anti-affinity + 3-zone spread; (4) 2k underutilized nodes w/
+spot replacement; (5) 50k burst w/ GPU extended resources, mixed
+on-demand/spot pools.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.catalog import benchmark_catalog, make_instance_type
+
+GIB = 2**30
+
+
+def _pool(name="default", weight=0, taints=(), requirements=()):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    np_.spec.weight = weight
+    np_.spec.template.taints = list(taints)
+    np_.spec.template.requirements = list(requirements)
+    return np_
+
+
+def _pod(name, cpu, mem_gib, **kw):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+        requests={"cpu": cpu, "memory": mem_gib * GIB},
+        **kw,
+    )
+
+
+def config1_homogeneous(n_pods=1000, n_types=10):
+    """kwok-style: homogeneous pods, no constraints."""
+    catalog = benchmark_catalog(n_types)
+    pods = [_pod(f"p{i}", 1.0, 2.0) for i in range(n_pods)]
+    return pods, [_pool()], catalog
+
+
+def config2_selectors_taints(n_pods=10_000, n_types=200):
+    """nodeSelector + taints mix."""
+    catalog = benchmark_catalog(n_types)
+    taint = Taint(key="dedicated", value="batch", effect="NoSchedule")
+    pools = [
+        _pool("general"),
+        _pool("batch", taints=[taint]),
+    ]
+    pods = []
+    for i in range(n_pods):
+        kind = i % 4
+        if kind == 0:
+            pods.append(_pod(f"p{i}", 0.5, 1.0))
+        elif kind == 1:
+            pods.append(_pod(f"p{i}", 1.0, 4.0, node_selector={wk.ARCH_LABEL: "arm64"}))
+        elif kind == 2:
+            pods.append(_pod(f"p{i}", 2.0, 4.0, node_selector={wk.CAPACITY_TYPE_LABEL: "spot"}))
+        else:
+            pods.append(_pod(
+                f"p{i}", 1.0, 2.0,
+                tolerations=[Toleration(key="dedicated", operator="Equal", value="batch",
+                                        effect="NoSchedule")],
+                node_selector={wk.NODEPOOL_LABEL: "batch"},
+            ))
+    return pods, pools, catalog
+
+
+def config3_antiaffinity_spread(n_pods=5000, n_types=100):
+    """anti-affinity + 3-zone topology spread (forces the host topology path)."""
+    catalog = benchmark_catalog(n_types, zones=("zone-1", "zone-2", "zone-3"))
+    pods = []
+    n_services = max(n_pods // 50, 1)
+    for i in range(n_pods):
+        svc = f"svc-{i % n_services}"
+        kind = i % 3
+        labels = {"app": svc}
+        if kind == 0:
+            pods.append(_pod(f"p{i}", 1.0, 2.0, labels=labels,
+                             topology_spread_constraints=[TopologySpreadConstraint(
+                                 max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                                 when_unsatisfiable="DoNotSchedule",
+                                 label_selector=LabelSelector(match_labels=labels))]))
+        elif kind == 1:
+            pods.append(_pod(f"p{i}", 1.0, 2.0, labels=labels))
+        else:
+            pods.append(_pod(
+                f"p{i}", 1.0, 2.0, labels=labels,
+                affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                    PodAffinityTerm(topology_key=wk.HOSTNAME_LABEL,
+                                    label_selector=LabelSelector(match_labels=labels))]))))
+    return pods, [_pool()], catalog
+
+
+def config4_consolidation_env(n_nodes=300):
+    """Underutilized on-demand fleet, spot replacement allowed: deployments
+    fill 16-cpu nodes with 3×5-cpu replicas, then scale to 1/3 so every
+    node runs at ~1/3 utilization — the classic multi-node consolidation
+    shape. Deployment-owned pods survive drains (the workload controller
+    recreates evicted replicas), so consolidation reschedules rather than
+    destroys the workload. Returns the Environment BEFORE disruption has
+    run (disruption enabled, first poll pending).
+
+    BASELINE.json names 2k nodes; the hermetic harness is O(nodes²) per
+    quiescence sweep, so the default exercises the same shape at 300 and
+    the caller can pass n_nodes=2000 for the full config.
+    """
+    from karpenter_tpu.api.objects import Deployment
+    from karpenter_tpu.operator import Environment
+    from karpenter_tpu.operator.options import Options
+
+    catalog = [make_instance_type("xl", 16, 64)]
+    env = Environment(
+        instance_types=catalog,
+        enable_disruption=True,
+        options=Options.from_env(feature_gates={"spot_to_spot_consolidation": True}),
+    )
+    # disruption idles until we start the clock on it: poll() is gated by
+    # cluster sync which needs at least one reconcile sweep first
+    env.disruption.poll_period = float("inf")
+    pool = _pool()
+    pool.spec.disruption.consolidate_after = 0.0
+    pool.spec.disruption.budgets[0].nodes = "100%"
+    env.create("nodepools", pool)
+    deploys = [
+        Deployment(metadata=ObjectMeta(name=f"d{i}"), replicas=3,
+                   template=_pod(f"d{i}-tpl", 5.0, 10.0))
+        for i in range(n_nodes)
+    ]
+    for d in deploys:
+        env.store.create("deployments", d)
+    env.run_until_idle(max_rounds=200)
+    # scale every deployment to a single replica: fleet drops to ~1/3 util
+    for d in deploys:
+        d.replicas = 1
+        env.store.update("deployments", d)
+    env.run_until_idle(max_rounds=200)
+    env.disruption.poll_period = 0.0
+    return env
+
+
+def diverse_pods(count: int):
+    """The reference benchmark's 1/6 constraint mix
+    (scheduling_benchmark_test.go makeDiversePods:234-248): generic, zonal
+    spread, hostname spread, pod-affinity (hostname + zone), hostname
+    anti-affinity, remainder generic."""
+    sixth = count // 6
+    pods = []
+
+    def generic(n, tag):
+        return [_pod(f"g{tag}-{i}", 0.5 + (i % 4) * 0.5, 1.0 + (i % 3)) for i in range(n)]
+
+    def spread(n, key, tag):
+        labels = {"app": f"spread-{tag}"}
+        return [
+            _pod(f"s{tag}-{i}", 1.0, 2.0, labels=dict(labels),
+                 topology_spread_constraints=[TopologySpreadConstraint(
+                     max_skew=1, topology_key=key, when_unsatisfiable="DoNotSchedule",
+                     label_selector=LabelSelector(match_labels=dict(labels)))])
+            for i in range(n)
+        ]
+
+    def affinity(n, key, tag):
+        labels = {"app": f"aff-{tag}"}
+        return [
+            _pod(f"a{tag}-{i}", 1.0, 2.0, labels=dict(labels),
+                 affinity=Affinity(pod_affinity=PodAffinity(required=[
+                     PodAffinityTerm(topology_key=key,
+                                     label_selector=LabelSelector(match_labels=dict(labels)))])))
+            for i in range(n)
+        ]
+
+    def anti(n, key, tag):
+        labels = {"app": f"anti-{tag}"}
+        return [
+            _pod(f"x{tag}-{i}", 1.0, 2.0, labels=dict(labels),
+                 affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                     PodAffinityTerm(topology_key=key,
+                                     label_selector=LabelSelector(match_labels=dict(labels)))])))
+            for i in range(n)
+        ]
+
+    pods += generic(sixth, "0")
+    pods += spread(sixth, wk.TOPOLOGY_ZONE_LABEL, "z")
+    pods += spread(sixth, wk.HOSTNAME_LABEL, "h")
+    pods += affinity(sixth, wk.HOSTNAME_LABEL, "h")
+    pods += affinity(sixth, wk.TOPOLOGY_ZONE_LABEL, "z")
+    pods += anti(sixth, wk.HOSTNAME_LABEL, "h")
+    pods += generic(count - len(pods), "fill")
+    return pods
+
+
+def config5_burst_gpu(n_pods=50_000, n_types=500):
+    """50k burst with GPU extended resources, mixed on-demand/spot pools."""
+    base = benchmark_catalog(n_types - 20)
+    gpu_types = [
+        make_instance_type(
+            f"gpu-{i}", 8 * (1 + i % 4), 64 * (1 + i % 4),
+            extra_capacity={"example.com/gpu": float(1 + i % 8)},
+        )
+        for i in range(20)
+    ]
+    catalog = base + gpu_types
+    spot_pool = _pool("spot", weight=10)
+    od_pool = _pool("on-demand")
+    pods = []
+    for i in range(n_pods):
+        kind = i % 10
+        if kind == 0:  # 10% GPU pods
+            pods.append(_pod(f"p{i}", 2.0, 8.0))
+            pods[-1].requests["example.com/gpu"] = float(1 + i % 2)
+        elif kind < 4:
+            pods.append(_pod(f"p{i}", 0.25, 0.5, node_selector={wk.CAPACITY_TYPE_LABEL: "spot"}))
+        else:
+            pods.append(_pod(f"p{i}", 1.0, 2.0))
+    return pods, [spot_pool, od_pool], catalog

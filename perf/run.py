@@ -1,0 +1,144 @@
+"""Run the 5 BASELINE benchmark configs + the reference benchmark grid.
+
+Usage:
+    python perf/run.py              # all 5 configs
+    python perf/run.py 1 3 5       # a subset
+    python perf/run.py grid        # the reference {1..5000}x400 grid
+                                   # (scheduling_benchmark_test.go:77-97)
+
+One JSON line per result: {config, pods, types, ms, pods_per_sec, nodes,
+ffd_nodes, node_overhead_pct, floor_ok}. `ffd_nodes` is the host FFD
+oracle on identical inputs (BASELINE target: ≤2% node-count overhead);
+`floor_ok` asserts the reference's enforced 100 pods/sec floor.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from perf import configs as C  # noqa: E402
+
+
+def _solve_timed(solver, pods, pools, catalog, **solver_kw):
+    from karpenter_tpu.models import ClaimTemplate
+
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    # fresh clones OUTSIDE the timer: harness isolation cost, not solver
+    # work (the reference benchmark also pre-builds pods, then times Solve)
+    fresh = [p.clone() for p in pods]
+    t0 = time.perf_counter()
+    res = solver.solve(fresh, templates, its, **solver_kw)
+    return res, time.perf_counter() - t0
+
+
+import os
+
+# the Python FFD oracle is O(pods x types); above this it takes minutes,
+# so big configs skip it unless PERF_FULL_ORACLE=1 (node-count parity for
+# the 50k shape is instead covered by the 10k oracle on the same mix)
+ORACLE_POD_CAP = int(os.environ.get("PERF_ORACLE_CAP", "20000"))
+
+
+def run_solve_config(name, pods, pools, catalog, **solver_kw):
+    from karpenter_tpu.models import HostSolver, TPUSolver
+
+    solver = TPUSolver()
+    _solve_timed(solver, pods, pools, catalog, **solver_kw)  # warm compile + caches
+    res, elapsed = _solve_timed(solver, pods, pools, catalog, **solver_kw)
+    nodes = res.node_count()
+    pps = len(pods) / elapsed
+    out = {
+        "config": name,
+        "pods": len(pods),
+        "types": len(catalog),
+        "ms": round(elapsed * 1000, 2),
+        "pods_per_sec": round(pps),
+        "nodes": nodes,
+        "scheduled": res.scheduled_pod_count(),
+        "floor_ok": bool(pps >= 100.0) if len(pods) > 100 else True,
+    }
+    if len(pods) <= ORACLE_POD_CAP or os.environ.get("PERF_FULL_ORACLE"):
+        ffd, ffd_elapsed = _solve_timed(HostSolver(), pods, pools, catalog)
+        ffd_nodes = ffd.node_count()
+        out.update(
+            ffd_nodes=ffd_nodes,
+            ffd_ms=round(ffd_elapsed * 1000, 2),
+            node_overhead_pct=round(100.0 * (nodes - ffd_nodes) / max(ffd_nodes, 1), 2),
+        )
+    print(json.dumps(out))
+
+
+def run_consolidation_config(n_nodes=None):
+    n_nodes = n_nodes or int(os.environ.get("PERF_CONSOLIDATION_NODES", "300"))
+    env = C.config4_consolidation_env(n_nodes)
+    start_nodes = len(env.store.list("nodes"))
+    start_pods = len([p for p in env.store.list("pods") if p.node_name])
+    t0 = time.perf_counter()
+    rounds = 0
+    stable = 0
+    while rounds < 100 and stable < 3:
+        before = len(env.store.list("nodes"))
+        env.clock.step(20.0)  # past validation TTLs and poll periods
+        env.run_until_idle(max_rounds=300)
+        rounds += 1
+        stable = stable + 1 if len(env.store.list("nodes")) == before else 0
+    elapsed = time.perf_counter() - t0
+    end_nodes = len(env.store.list("nodes"))
+    end_pods = len([p for p in env.store.list("pods") if p.node_name])
+    hist = env.registry.histogram("karpenter_disruption_evaluation_duration_seconds")
+    print(json.dumps({
+        "config": f"4-consolidation-{n_nodes}-underutilized",
+        "start_nodes": start_nodes,
+        "end_nodes": end_nodes,
+        "pods_bound": [start_pods, end_pods],  # workload must be preserved
+        "total_ms": round(elapsed * 1000, 2),
+        "rounds": rounds,
+        "multinode_eval_ms_sum": round(1000 * hist.sum(method="MultiNodeConsolidation"), 2),
+        "multinode_evals": hist.count(method="MultiNodeConsolidation"),
+        # reference budget: ≤60s per multi-node search (multinodeconsolidation.go:37)
+        "within_1min_budget": bool(hist.sum(method="MultiNodeConsolidation") <= 60.0),
+    }))
+
+
+def run_grid():
+    """The reference benchmark grid: pods x 400 types, diverse 1/6 mix
+    (scheduling_benchmark_test.go:77-97, :234-248); its enforced floor is
+    100 pods/sec on batches over 100 pods."""
+    from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+    from karpenter_tpu.api.nodepool import NodePool
+    from karpenter_tpu.api.objects import ObjectMeta
+
+    catalog = benchmark_catalog(400)
+    pools = [NodePool(metadata=ObjectMeta(name="default"))]
+    for n in (1, 50, 100, 500, 1000, 2000, 5000):
+        # pin the bin axis so every grid size shares one compiled kernel
+        # (per-size shapes would each pay a fresh XLA compile on the chip)
+        run_solve_config(f"grid-{n}", C.diverse_pods(n), pools, catalog,
+                         max_bins=1024)
+
+
+def main():
+    args = sys.argv[1:]
+    if args == ["grid"]:
+        run_grid()
+        return
+    picks = {int(a) for a in args} if args else {1, 2, 3, 4, 5}
+    if 1 in picks:
+        run_solve_config("1-homogeneous-1k", *C.config1_homogeneous())
+    if 2 in picks:
+        run_solve_config("2-selectors-taints-10k", *C.config2_selectors_taints())
+    if 3 in picks:
+        run_solve_config("3-antiaffinity-spread-5k", *C.config3_antiaffinity_spread())
+    if 4 in picks:
+        run_consolidation_config()
+    if 5 in picks:
+        run_solve_config("5-burst-gpu-50k", *C.config5_burst_gpu())
+
+
+if __name__ == "__main__":
+    main()

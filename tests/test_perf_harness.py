@@ -1,0 +1,68 @@
+"""Perf harness smoke tests: the 5 BASELINE configs build and solve at
+miniature scale, and the consolidation scenario actually consolidates
+while preserving the workload (runs on the CPU mesh via conftest)."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from perf import configs as C  # noqa: E402
+
+
+class TestConfigs:
+    def _solve(self, pods, pools, catalog):
+        from karpenter_tpu.models import ClaimTemplate, HostSolver
+
+        return HostSolver().solve(
+            [p.clone() for p in pods],
+            [ClaimTemplate(p) for p in pools],
+            {p.name: catalog for p in pools},
+        )
+
+    def test_config1_shape(self):
+        pods, pools, catalog = C.config1_homogeneous(n_pods=60, n_types=5)
+        res = self._solve(pods, pools, catalog)
+        assert res.scheduled_pod_count() == 60
+
+    def test_config2_shape(self):
+        # ≥43 types so the alternating-arch catalog includes arm64 entries
+        pods, pools, catalog = C.config2_selectors_taints(n_pods=80, n_types=50)
+        res = self._solve(pods, pools, catalog)
+        assert res.scheduled_pod_count() == 80
+
+    def test_config3_shape(self):
+        pods, pools, catalog = C.config3_antiaffinity_spread(n_pods=60, n_types=10)
+        res = self._solve(pods, pools, catalog)
+        assert res.scheduled_pod_count() == 60
+
+    def test_config5_gpu_pods_schedule(self):
+        pods, pools, catalog = C.config5_burst_gpu(n_pods=100, n_types=30)
+        res = self._solve(pods, pools, catalog)
+        assert res.scheduled_pod_count() == 100
+        gpu_nodes = [
+            c for c in res.new_claims
+            if any("example.com/gpu" in it.capacity for it in c.instance_types)
+        ]
+        assert gpu_nodes, "GPU pods must land on GPU-capable claims"
+
+    def test_diverse_pods_mix(self):
+        pods = C.diverse_pods(60)
+        assert len(pods) == 60
+        kinds = {
+            "spread": sum(1 for p in pods if p.topology_spread_constraints),
+            "affinity": sum(1 for p in pods if p.affinity and p.affinity.pod_affinity),
+            "anti": sum(1 for p in pods if p.affinity and p.affinity.pod_anti_affinity),
+        }
+        assert kinds["spread"] == 20 and kinds["affinity"] == 20 and kinds["anti"] == 10
+
+    def test_config4_consolidates_and_preserves_workload(self):
+        env = C.config4_consolidation_env(6)
+        start = len(env.store.list("nodes"))
+        assert start == 6
+        for _ in range(20):
+            env.clock.step(20.0)
+            env.run_until_idle(max_rounds=200)
+        end = len(env.store.list("nodes"))
+        bound = len([p for p in env.store.list("pods") if p.node_name])
+        assert end < start, f"no consolidation ({start}->{end})"
+        assert bound == 6, f"workload lost: {bound}/6 pods bound"
