@@ -1,4 +1,10 @@
 from karpenter_tpu.models.inflight import ClaimTemplate, InFlightNodeClaim  # noqa: F401
 from karpenter_tpu.models.queue import SchedulingQueue  # noqa: F401
 from karpenter_tpu.models.scheduler import Scheduler, SchedulerResults  # noqa: F401
-from karpenter_tpu.models.solver import HostSolver, Solver, TPUSolver, make_solver  # noqa: F401
+from karpenter_tpu.models.solver import (  # noqa: F401
+    HostSolver,
+    NativeSolver,
+    Solver,
+    TPUSolver,
+    make_solver,
+)

@@ -232,15 +232,8 @@ class TPUSolver(Solver):
         args["off_ct"][:T] = snap.off_ct
         # padded types must be infeasible: zero alloc fails fits (pods>=1)
 
-        import jax
-
         key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], Bp)
-        out = self._kernel(key)(args)
-        # one batched device→host fetch: over a tunneled chip each separate
-        # pull pays a full round trip, which dominates these tiny arrays
-        host = jax.device_get(
-            {k: out[k] for k in ("assign", "used", "tmpl", "F")}
-        )
+        host = self._invoke(args, key, Bp)
         assign = host["assign"][:G, :Bp]
         used = host["used"]
         tmpl = host["tmpl"]
@@ -252,6 +245,16 @@ class TPUSolver(Solver):
         claims, retry = self._decode(snap, assign, used, feas, tmpl)
         exhausted = bool(used[:B].all())
         return claims, retry, B, exhausted
+
+    def _invoke(self, args, key, max_bins):
+        """Run the compiled kernel; returns host numpy dict
+        (assign/used/tmpl/F). Overridden by NativeSolver."""
+        import jax
+
+        out = self._kernel(key)(args)
+        # one batched device→host fetch: over a tunneled chip each separate
+        # pull pays a full round trip, which dominates these tiny arrays
+        return jax.device_get({k: out[k] for k in ("assign", "used", "tmpl", "F")})
 
     def _decode(self, snap, assign, used, feas, tmpl):
         """Bins → InFlightNodeClaims, with host-side validation of each
@@ -366,12 +369,37 @@ class TPUSolver(Solver):
         return claims, retry
 
 
-def make_solver(prefer_device: bool = True) -> Solver:
-    if not prefer_device:
-        return HostSolver()
-    try:
-        import jax  # noqa: F401
+class NativeSolver(TPUSolver):
+    """Same tensorize→kernel→decode pipeline with the C++ host engine
+    (karpenter_tpu/native) in place of the XLA kernel — the fast fallback
+    when no accelerator is reachable (BASELINE.md: in-process heuristic on
+    host CPU). Shapes need no bucketing, but the shared path pads anyway;
+    padded groups/types are inert (count 0 / alloc 0)."""
 
-        return TPUSolver()
-    except Exception:  # pragma: no cover - jax is baked into this image
-        return HostSolver()
+    def _kernel(self, key):  # pragma: no cover - never compiled
+        raise AssertionError("NativeSolver does not compile XLA kernels")
+
+    def _invoke(self, args, key, max_bins):
+        from karpenter_tpu import native
+
+        return native.solve_step(args, max_bins)
+
+
+def make_solver(prefer_device: bool = True) -> Solver:
+    """Device kernel if jax is importable, else the C++ host engine, else
+    the pure-Python FFD loop (the reference algorithm)."""
+    if prefer_device:
+        try:
+            import jax  # noqa: F401
+
+            return TPUSolver()
+        except Exception:
+            pass
+    try:
+        from karpenter_tpu import native
+
+        if native.available():
+            return NativeSolver()
+    except Exception:
+        pass
+    return HostSolver()

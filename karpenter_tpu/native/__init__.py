@@ -1,0 +1,145 @@
+"""Native host kernel: build-on-first-use C++ solve engine via ctypes.
+
+`load()` compiles kernel.cpp with g++ into a cached shared library next to
+the source (or $KARPENTER_NATIVE_CACHE) and returns the bound entry point;
+it returns None when no toolchain is available, and callers fall back to
+the pure-Python host loop. The library is rebuilt whenever kernel.cpp is
+newer than the cached .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "kernel.cpp")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+_u32p = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+
+
+def _so_path() -> str:
+    cache = os.environ.get("KARPENTER_NATIVE_CACHE", _HERE)
+    return os.path.join(cache, "libkarpenter_kernel.so")
+
+
+def _build(so: str) -> bool:
+    try:
+        os.makedirs(os.path.dirname(so), exist_ok=True)
+        tmp = so + ".tmp"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=180,
+        )
+        os.replace(tmp, so)
+        return True
+    except Exception:
+        return False
+
+
+def load():
+    """Bound karpenter_solve(), or None if the native engine is unusable."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib.karpenter_solve
+    if _load_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib.karpenter_solve
+        so = _so_path()
+        stale = not os.path.exists(so) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(so)
+        )
+        if stale and not _build(so):
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _load_failed = True
+            return None
+        fn = lib.karpenter_solve
+        fn.restype = ctypes.c_int
+        fn.argtypes = (
+            [ctypes.c_int] * 10
+            + [_u32p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p]       # group side
+            + [_u32p, _u8p, _f32p, _f32p, _i32p]                  # type side
+            + [_i32p, _i32p, _u8p]                                # offerings
+            + [_u32p, _u8p, _f32p, _f32p]                         # templates
+            + [_i32p, _u8p, _i32p, _u8p]                          # outputs
+        )
+        _lib = lib
+        return fn
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def solve_step(args: dict, max_bins: int) -> dict:
+    """Drop-in for ops.kernels.solve_step on the host: same snapshot arg
+    dict, same output dict (assign/used/tmpl/F), numpy throughout."""
+    fn = load()
+    if fn is None:
+        raise RuntimeError("native kernel unavailable (no g++?)")
+    g_mask = np.ascontiguousarray(args["g_mask"], dtype=np.uint32)
+    G, K, W = g_mask.shape
+    t_mask = np.ascontiguousarray(args["t_mask"], dtype=np.uint32)
+    T = t_mask.shape[0]
+    m_mask = np.ascontiguousarray(args["m_mask"], dtype=np.uint32)
+    M = m_mask.shape[0]
+    off_zone = np.ascontiguousarray(args["off_zone"], dtype=np.int32)
+    O = off_zone.shape[1]
+    g_demand = np.ascontiguousarray(args["g_demand"], dtype=np.float32)
+    R = g_demand.shape[1]
+    gza = np.ascontiguousarray(args["g_zone_allowed"], dtype=np.uint8)
+    gca = np.ascontiguousarray(args["g_ct_allowed"], dtype=np.uint8)
+    B = int(max_bins)
+
+    assign = np.zeros((G, B), dtype=np.int32)
+    used = np.zeros(B, dtype=np.uint8)
+    tmpl = np.zeros(B, dtype=np.int32)
+    F = np.zeros((G, T), dtype=np.uint8)
+
+    rc = fn(
+        G, T, K, W, R, M, O, B, gza.shape[1], gca.shape[1],
+        g_mask,
+        np.ascontiguousarray(args["g_has"], dtype=np.uint8),
+        g_demand,
+        np.ascontiguousarray(args["g_count"], dtype=np.int32),
+        gza, gca,
+        np.ascontiguousarray(args["g_tmpl_ok"], dtype=np.uint8),
+        t_mask,
+        np.ascontiguousarray(args["t_has"], dtype=np.uint8),
+        np.ascontiguousarray(args["t_alloc"], dtype=np.float32),
+        np.ascontiguousarray(args["t_cap"], dtype=np.float32),
+        np.ascontiguousarray(args["t_tmpl"], dtype=np.int32),
+        off_zone,
+        np.ascontiguousarray(args["off_ct"], dtype=np.int32),
+        np.ascontiguousarray(args["off_avail"], dtype=np.uint8),
+        m_mask,
+        np.ascontiguousarray(args["m_has"], dtype=np.uint8),
+        np.ascontiguousarray(args["m_overhead"], dtype=np.float32),
+        np.ascontiguousarray(args["m_limits"], dtype=np.float32),
+        assign, used, tmpl, F,
+    )
+    if rc != 0:
+        raise RuntimeError(f"native kernel failed: rc={rc}")
+    return {
+        "assign": assign,
+        "used": used.astype(bool),
+        "tmpl": tmpl,
+        "F": F.astype(bool),
+    }

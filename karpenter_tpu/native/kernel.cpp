@@ -1,0 +1,267 @@
+// Native (host) implementation of the grouped feasibility + pack kernel.
+//
+// Mirrors karpenter_tpu/ops/kernels.py::solve_step over the exact same
+// tensorized snapshot layout (see ops/tensorize.py): requirement sets as
+// packed uint32 bitmasks over interned vocabularies, groups in FFD order,
+// bins accumulating the intersection of surviving instance types. This is
+// the fallback engine when no accelerator is available — the TPU-native
+// reformulation of the reference's Go scheduling loop (scheduler.go:195-296)
+// compiled for the host instead of for XLA.
+//
+// Differences from the device kernel, none observable in results:
+// - emptiest-first filling is done directly with a priority scan instead of
+//   the batched level-search (same fixpoint as scheduler.go:258's ascending
+//   pod-count ordering);
+// - per-bin candidate types are kept as shrinking index lists instead of a
+//   dense [B,T] mask.
+//
+// C ABI for ctypes; all arrays are C-contiguous, caller-allocated.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <vector>
+#include <algorithm>
+#include <limits>
+
+namespace {
+
+constexpr float EPS = 1e-6f;
+
+struct Bin {
+    int npods = 0;
+    int tmpl = 0;
+    std::vector<float> load;          // [R]
+    std::vector<int> types;           // surviving candidate type ids
+    std::vector<uint32_t> mask;       // [K*W] accumulated requirement mask
+    std::vector<uint8_t> has;         // [K]
+};
+
+inline bool masks_compatible(const uint32_t* a_mask, const uint8_t* a_has,
+                             const uint32_t* b_mask, const uint8_t* b_has,
+                             int K, int W) {
+    for (int k = 0; k < K; ++k) {
+        if (!a_has[k] || !b_has[k]) continue;
+        const uint32_t* aw = a_mask + (size_t)k * W;
+        const uint32_t* bw = b_mask + (size_t)k * W;
+        bool overlap = false;
+        for (int w = 0; w < W; ++w) {
+            if (aw[w] & bw[w]) { overlap = true; break; }
+        }
+        if (!overlap) return false;
+    }
+    return true;
+}
+
+inline void combine_masks(std::vector<uint32_t>& mask, std::vector<uint8_t>& has,
+                          const uint32_t* gm, const uint8_t* gh, int K, int W) {
+    for (int k = 0; k < K; ++k) {
+        uint32_t* mw = mask.data() + (size_t)k * W;
+        const uint32_t* gw = gm + (size_t)k * W;
+        if (has[k] && gh[k]) {
+            for (int w = 0; w < W; ++w) mw[w] &= gw[w];
+        } else if (gh[k]) {
+            for (int w = 0; w < W; ++w) mw[w] = gw[w];
+        }
+        has[k] = has[k] || gh[k];
+    }
+}
+
+// pods of demand d that fit into remaining space (alloc - load)
+inline int cap_for(const float* alloc, const float* load, const float* d, int R) {
+    float cap = std::numeric_limits<float>::infinity();
+    for (int r = 0; r < R; ++r) {
+        if (d[r] <= 0.0f) continue;
+        float avail = alloc[r] - (load ? load[r] : 0.0f);
+        float c = avail / std::max(d[r], EPS);
+        cap = std::min(cap, c);
+    }
+    if (std::isinf(cap)) return 1 << 30;
+    float f = std::floor(cap + EPS);
+    return f <= 0.0f ? 0 : (int)f;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. Output arrays: assign [G*B] i32 (zeroed by callee),
+// used [B] u8, tmpl_out [B] i32, F_out [G*T] u8.
+int karpenter_solve(
+    int G, int T, int K, int W, int R, int M, int O, int B, int Vz, int Vc,
+    const uint32_t* g_mask, const uint8_t* g_has, const float* g_demand,
+    const int32_t* g_count, const uint8_t* g_zone_allowed,
+    const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
+    const uint32_t* t_mask, const uint8_t* t_has, const float* t_alloc,
+    const float* t_cap, const int32_t* t_tmpl,
+    const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
+    const uint32_t* m_mask, const uint8_t* m_has,
+    const float* m_overhead, const float* m_limits,
+    int32_t* assign, uint8_t* used, int32_t* tmpl_out, uint8_t* F_out) {
+
+    // ---- feasibility: F[g,t] = requirement ∧ fit-one ∧ offering ----
+    std::vector<uint8_t> F((size_t)G * T, 0);
+    for (int g = 0; g < G; ++g) {
+        const uint32_t* gm = g_mask + (size_t)g * K * W;
+        const uint8_t* gh = g_has + (size_t)g * K;
+        const float* d = g_demand + (size_t)g * R;
+        for (int t = 0; t < T; ++t) {
+            if (!masks_compatible(gm, gh, t_mask + (size_t)t * K * W,
+                                  t_has + (size_t)t * K, K, W))
+                continue;
+            if (cap_for(t_alloc + (size_t)t * R, nullptr, d, R) < 1) continue;
+            bool off_ok = false;
+            for (int o = 0; o < O; ++o) {
+                size_t i = (size_t)t * O + o;
+                if (!off_avail[i]) continue;
+                int z = off_zone[i], c = off_ct[i];
+                if (z >= 0 && !g_zone_allowed[(size_t)g * Vz + z]) continue;
+                if (c >= 0 && !g_ct_allowed[(size_t)g * Vc + c]) continue;
+                off_ok = true;
+                break;
+            }
+            if (off_ok) F[(size_t)g * T + t] = 1;
+        }
+    }
+    std::memcpy(F_out, F.data(), (size_t)G * T);
+
+    // ---- template-level overlap for new-bin placement ----
+    std::vector<uint8_t> tmpl_full((size_t)G * M, 0);
+    for (int g = 0; g < G; ++g) {
+        const uint32_t* gm = g_mask + (size_t)g * K * W;
+        const uint8_t* gh = g_has + (size_t)g * K;
+        for (int m = 0; m < M; ++m) {
+            if (!g_tmpl_ok[(size_t)g * M + m]) continue;
+            if (masks_compatible(gm, gh, m_mask + (size_t)m * K * W,
+                                 m_has + (size_t)m * K, K, W))
+                tmpl_full[(size_t)g * M + m] = 1;
+        }
+    }
+
+    // ---- grouped greedy pack ----
+    std::vector<Bin> bins;
+    bins.reserve(256);
+    std::vector<float> rem((size_t)M * R);
+    std::memcpy(rem.data(), m_limits, sizeof(float) * M * R);
+    std::memset(assign, 0, sizeof(int32_t) * (size_t)G * B);
+    std::memset(used, 0, (size_t)B);
+    std::memset(tmpl_out, 0, sizeof(int32_t) * (size_t)B);
+
+    std::vector<int> order;  // bin indices sorted by npods (emptiest first)
+    for (int g = 0; g < G; ++g) {
+        int n = g_count[g];
+        if (n <= 0) continue;
+        const uint32_t* gm = g_mask + (size_t)g * K * W;
+        const uint8_t* gh = g_has + (size_t)g * K;
+        const float* d = g_demand + (size_t)g * R;
+        const uint8_t* Fg = F.data() + (size_t)g * T;
+
+        // existing bins, emptiest first (scheduler.go:258)
+        order.resize(bins.size());
+        for (size_t i = 0; i < bins.size(); ++i) order[i] = (int)i;
+        std::stable_sort(order.begin(), order.end(), [&](int a, int b) {
+            return bins[a].npods < bins[b].npods;
+        });
+        for (int bi : order) {
+            if (n <= 0) break;
+            Bin& bin = bins[bi];
+            if (!tmpl_full[(size_t)g * M + bin.tmpl]) continue;
+            if (!masks_compatible(bin.mask.data(), bin.has.data(), gm, gh, K, W))
+                continue;
+            // capacity = max over surviving types still feasible for g
+            int q = 0;
+            for (int t : bin.types) {
+                if (!Fg[t]) continue;
+                q = std::max(q, cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R));
+            }
+            if (q <= 0) continue;
+            int take = std::min(q, n);
+            n -= take;
+            assign[(size_t)g * B + bi] += take;
+            bin.npods += take;
+            for (int r = 0; r < R; ++r) bin.load[r] += take * d[r];
+            // shrink surviving types: still feasible for g AND still fits load
+            std::vector<int> kept;
+            kept.reserve(bin.types.size());
+            for (int t : bin.types) {
+                if (!Fg[t]) continue;
+                bool fits = true;
+                const float* alloc = t_alloc + (size_t)t * R;
+                for (int r = 0; r < R; ++r)
+                    if (bin.load[r] > alloc[r] + EPS) { fits = false; break; }
+                if (fits) kept.push_back(t);
+            }
+            bin.types.swap(kept);
+            combine_masks(bin.mask, bin.has, gm, gh, K, W);
+        }
+
+        // new bins from the first (weight-ordered) feasible template
+        while (n > 0 && (int)bins.size() < B) {
+            int m_star = -1, per_node = 0;
+            for (int m = 0; m < M && m_star < 0; ++m) {
+                if (!tmpl_full[(size_t)g * M + m]) continue;
+                int best = 0;
+                for (int t = 0; t < T; ++t) {
+                    if (t_tmpl[t] != m || !Fg[t]) continue;
+                    // nodepool limits: worst-case capacity must fit rem
+                    bool lim_ok = true;
+                    for (int r = 0; r < R; ++r)
+                        if (t_cap[(size_t)t * R + r] > rem[(size_t)m * R + r] + EPS) {
+                            lim_ok = false; break;
+                        }
+                    if (!lim_ok) continue;
+                    std::vector<float> ovh(m_overhead + (size_t)m * R,
+                                           m_overhead + (size_t)m * R + R);
+                    int c = cap_for(t_alloc + (size_t)t * R, ovh.data(), d, R);
+                    best = std::max(best, c);
+                }
+                if (best > 0) { m_star = m; per_node = best; }
+            }
+            if (m_star < 0) break;  // nothing can host this group
+
+            Bin bin;
+            bin.tmpl = m_star;
+            bin.load.assign(m_overhead + (size_t)m_star * R,
+                            m_overhead + (size_t)m_star * R + R);
+            bin.mask.assign(m_mask + (size_t)m_star * K * W,
+                            m_mask + (size_t)m_star * K * W + (size_t)K * W);
+            bin.has.assign(m_has + (size_t)m_star * K, m_has + (size_t)m_star * K + K);
+            int take = std::min(per_node, n);
+            bin.npods = take;
+            for (int r = 0; r < R; ++r) bin.load[r] += take * d[r];
+            // candidate types: template's, feasible for g, limit-ok, fits load
+            std::vector<float> worst(R, 0.0f);
+            for (int t = 0; t < T; ++t) {
+                if (t_tmpl[t] != m_star || !Fg[t]) continue;
+                bool lim_ok = true, fits = true;
+                const float* cap = t_cap + (size_t)t * R;
+                const float* alloc = t_alloc + (size_t)t * R;
+                for (int r = 0; r < R; ++r) {
+                    if (cap[r] > rem[(size_t)m_star * R + r] + EPS) lim_ok = false;
+                    if (bin.load[r] > alloc[r] + EPS) fits = false;
+                }
+                if (!lim_ok || !fits) continue;
+                bin.types.push_back(t);
+                for (int r = 0; r < R; ++r) worst[r] = std::max(worst[r], cap[r]);
+            }
+            if (bin.types.empty()) break;
+            combine_masks(bin.mask, bin.has, gm, gh, K, W);
+            // limit accounting: subtract worst-case capacity (subtractMax)
+            for (int r = 0; r < R; ++r) rem[(size_t)m_star * R + r] -= worst[r];
+            int bi = (int)bins.size();
+            bins.push_back(std::move(bin));
+            assign[(size_t)g * B + bi] = take;
+            n -= take;
+        }
+        // pods still unplaced are implied by count - sum(assign[g]) and
+        // re-routed by the decoder, matching the device kernel's contract
+    }
+
+    for (size_t i = 0; i < bins.size(); ++i) {
+        used[i] = 1;
+        tmpl_out[i] = bins[i].tmpl;
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,151 @@
+"""Native (C++) host kernel: availability, correctness, and parity with the
+Python FFD oracle on the device-solver scenarios."""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.catalog import benchmark_catalog, make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver, NativeSolver
+
+GIB = 2**30
+
+
+def nodepool(name="default", weight=0, taints=(), requirements=()):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    np_.spec.weight = weight
+    np_.spec.template.taints = list(taints)
+    np_.spec.template.requirements = list(requirements)
+    return np_
+
+
+def pod(name, cpu=1.0, mem_gib=1.0, **kw):
+    return Pod(metadata=ObjectMeta(name=name), requests={"cpu": cpu, "memory": mem_gib * GIB}, **kw)
+
+
+def run_both(pods, pools, catalog):
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    host = HostSolver().solve([p.clone() for p in pods], templates, its)
+    templates2 = [ClaimTemplate(p) for p in pools]
+    native = NativeSolver().solve([p.clone() for p in pods], templates2, its)
+    return host, native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    from karpenter_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+
+
+@pytest.fixture
+def catalog():
+    return [
+        make_instance_type("small", 2, 8),
+        make_instance_type("medium", 8, 32),
+        make_instance_type("large", 32, 128),
+    ]
+
+
+class TestNativeBasics:
+    def test_single_pod(self, catalog):
+        host, nat = run_both([pod("p1")], [nodepool()], catalog)
+        assert nat.node_count() == host.node_count() == 1
+        assert nat.scheduled_pod_count() == 1
+
+    def test_pack_parity(self, catalog):
+        pods = [pod(f"p{i}", cpu=0.5, mem_gib=1.0) for i in range(40)]
+        host, nat = run_both(pods, [nodepool()], catalog)
+        assert nat.scheduled_pod_count() == 40
+        assert nat.node_count() == host.node_count()
+
+    def test_selector_groups(self, catalog):
+        pods = [pod(f"a{i}", node_selector={wk.ARCH_LABEL: "amd64"}) for i in range(6)]
+        pods += [pod(f"b{i}", node_selector={wk.ARCH_LABEL: "arm64"}) for i in range(6)]
+        host, nat = run_both(pods, [nodepool()], catalog)
+        assert nat.scheduled_pod_count() == len(pods)
+        assert nat.node_count() == host.node_count()
+
+    def test_zone_constraint(self, catalog):
+        pods = [pod("p1", node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"})]
+        _, nat = run_both(pods, [nodepool()], catalog)
+        assert nat.scheduled_pod_count() == 1
+        claim = nat.new_claims[0]
+        assert claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL).has("zone-2")
+
+    def test_taint_gating(self, catalog):
+        taint = Taint(key="team", value="a", effect="NoSchedule")
+        pools = [nodepool("tainted", taints=[taint])]
+        _, nat = run_both([pod("p1")], pools, catalog)
+        assert nat.pod_errors
+        _, nat2 = run_both(
+            [pod("p2", tolerations=[Toleration(key="team", operator="Equal", value="a",
+                                               effect="NoSchedule")])],
+            pools, catalog)
+        assert nat2.scheduled_pod_count() == 1
+
+    def test_unschedulable_reported(self, catalog):
+        _, nat = run_both([pod("huge", cpu=512.0)], [nodepool()], catalog)
+        assert nat.node_count() == 0 and nat.pod_errors
+
+    def test_template_weight_order(self, catalog):
+        pools = [nodepool("low", weight=1), nodepool("high", weight=50)]
+        _, nat = run_both([pod("p1")], pools, catalog)
+        assert nat.new_claims[0].template.nodepool_name == "high"
+
+    def test_three_way_zone_intersection(self):
+        catalog = [make_instance_type("only", 8, 32, zones=("z2", "z3"))]
+        pools = [nodepool(requirements=[
+            NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, "In", ["z1", "z2"])])]
+        p = pod("p1")
+        p.affinity = Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(wk.TOPOLOGY_ZONE_LABEL, "In", ["z1", "z3"])])]))
+        host, nat = run_both([p], pools, catalog)
+        assert host.node_count() == 0 and nat.node_count() == 0
+
+    def test_limits_respected(self, catalog):
+        np_ = nodepool()
+        np_.spec.limits = {"cpu": 4.0}
+        templates = [ClaimTemplate(np_)]
+        its = {"default": catalog}
+        pods = [pod(f"p{i}", cpu=1.5) for i in range(10)]
+        res = NativeSolver().solve(
+            [p.clone() for p in pods], templates, its,
+            limits={"default": {"cpu": 4.0}})
+        total_cap = sum(
+            max(it.capacity["cpu"] for it in c.instance_types) for c in res.new_claims
+        )
+        assert total_cap <= 4.0 + 1e-6
+
+
+class TestNativeParityRandom:
+    def test_random_mix_node_parity(self):
+        import random
+
+        rng = random.Random(7)
+        catalog = benchmark_catalog(60)
+        pods = []
+        for i in range(300):
+            cpu = rng.choice([0.25, 0.5, 1.0, 2.0, 4.0])
+            sel = rng.choice([
+                {}, {wk.ARCH_LABEL: "amd64"}, {wk.ARCH_LABEL: "arm64"},
+                {wk.CAPACITY_TYPE_LABEL: "spot"},
+            ])
+            pods.append(pod(f"p{i}", cpu=cpu, mem_gib=cpu * 2, node_selector=dict(sel)))
+        host, nat = run_both(pods, [nodepool()], catalog)
+        assert nat.scheduled_pod_count() == 300
+        # BASELINE parity gate: ≤2% node-count overhead vs the FFD oracle
+        assert nat.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
