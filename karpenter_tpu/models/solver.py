@@ -270,6 +270,14 @@ class TPUSolver(Solver):
         retry = []
         topology = NullTopology()
         R = len(snap.resources)
+        # nodepool-limit accounting mirroring the kernel's (and the
+        # reference's, scheduler.go:270-292): a bin's candidate types are
+        # filtered to those whose worst-case capacity fits the remaining
+        # limits at open time, and the surviving worst case is debited.
+        # Without this the F-based candidates resurrect over-limit types
+        # the kernel never would have opened, and the host pass then grows
+        # the claim past the nodepool limit.
+        rem_limits = snap.m_limits.astype(np.float64).copy()
         # per-bin totals in one matmul, in float64 from the source demand
         # dicts — the f32 kernel tensors are too coarse at memory-byte scale
         demand64 = np.array(
@@ -330,12 +338,24 @@ class TPUSolver(Solver):
                     ],
                     dtype=np.float64,
                 ).reshape(len(candidates), len(snap.resources))
-                cached = (bin_reqs, candidates, alloc)
+                # float64 from the source capacity dicts, like alloc above:
+                # the f32 kernel tensors are too coarse at memory-byte scale
+                tcap = np.array(
+                    [
+                        [it.capacity.get(r, 0.0) for r in snap.resources]
+                        for _, it in candidates
+                    ],
+                    dtype=np.float64,
+                ).reshape(len(candidates), len(snap.resources))
+                cached = (bin_reqs, candidates, alloc, tcap)
                 compat_cache[key] = cached
-            bin_reqs, compat, alloc = cached
+            bin_reqs, compat, alloc, tcap = cached
             # the vectorized form of resutil.fits' tolerance, same constants
             ok = (
                 req_vec <= alloc + resutil._EPS + resutil.FIT_REL_EPS * np.abs(alloc)
+            ).all(axis=1)
+            ok &= (
+                tcap <= rem_limits[m] + resutil._EPS + resutil.FIT_REL_EPS * np.abs(rem_limits[m])
             ).all(axis=1)
             its = [it for (_, it), good in zip(compat, ok) if good]
             claim = InFlightNodeClaim(
@@ -361,6 +381,9 @@ class TPUSolver(Solver):
                 retry.extend(bin_pods)
                 continue
             claim.instance_types = remaining
+            # debit only once the claim survives validation — a bin dropped
+            # to retry must not consume limit budget for later bins
+            rem_limits[m] -= tcap[ok].max(axis=0)
             claims.append(claim)
         # pods the kernel couldn't place (unsched counts are implied by the
         # unconsumed remainder of each group)

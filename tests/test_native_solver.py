@@ -71,12 +71,25 @@ class TestNativeBasics:
         assert nat.scheduled_pod_count() == 40
         assert nat.node_count() == host.node_count()
 
-    def test_selector_groups(self, catalog):
+    def test_selector_groups(self):
+        catalog = [
+            make_instance_type("small-amd", 2, 8, arch="amd64"),
+            make_instance_type("small-arm", 2, 8, arch="arm64"),
+            make_instance_type("medium-amd", 8, 32, arch="amd64"),
+            make_instance_type("medium-arm", 8, 32, arch="arm64"),
+        ]
         pods = [pod(f"a{i}", node_selector={wk.ARCH_LABEL: "amd64"}) for i in range(6)]
         pods += [pod(f"b{i}", node_selector={wk.ARCH_LABEL: "arm64"}) for i in range(6)]
         host, nat = run_both(pods, [nodepool()], catalog)
         assert nat.scheduled_pod_count() == len(pods)
         assert nat.node_count() == host.node_count()
+
+    def test_arch_mismatch_unschedulable(self, catalog):
+        # amd64-only catalog: arm64-selector pods must error on BOTH engines
+        pods = [pod(f"b{i}", node_selector={wk.ARCH_LABEL: "arm64"}) for i in range(3)]
+        host, nat = run_both(pods, [nodepool()], catalog)
+        assert host.scheduled_pod_count() == nat.scheduled_pod_count() == 0
+        assert len(nat.pod_errors) == 3
 
     def test_zone_constraint(self, catalog):
         pods = [pod("p1", node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"})]
