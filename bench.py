@@ -1,18 +1,30 @@
 """Headline benchmark: solve a 50k-pod burst against a 500-type catalog.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
 The reference's enforced floor is 100 pods/sec for the Go FFD loop
 (scheduling_benchmark_test.go:55); `vs_baseline` reports our throughput as a
 multiple of that floor. The BASELINE.md target is <200 ms wall clock for the
 full solve (snapshot compile + device kernel + decode) on one TPU chip.
+
+Resilience: the TPU backend on this image is reached through a tunnel that
+can be contended or down, and a blocked PJRT init sleeps FOREVER (round 1
+died exactly this way, BENCH_r01.json rc=1). Every engine attempt therefore
+runs in a watchdog subprocess with a hard timeout, retries with backoff,
+and falls down a ladder — axon (TPU) → jax CPU → native C++ — so this
+script always prints a benchmark record and exits 0. Diagnostics for every
+failed attempt ride along in detail.attempts.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
+
+GIB = 2**30
 
 
 def build_workload(n_pods=50_000, n_types=500):
@@ -22,7 +34,6 @@ def build_workload(n_pods=50_000, n_types=500):
     from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
     from karpenter_tpu.models.inflight import ClaimTemplate
 
-    GIB = 2**30
     catalog = benchmark_catalog(n_types)
     pools = [NodePool(metadata=ObjectMeta(name="general"))]
     spot = NodePool(metadata=ObjectMeta(name="spot"))
@@ -55,16 +66,40 @@ def build_workload(n_pods=50_000, n_types=500):
     return pods, templates, its
 
 
-def main():
-    n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
-    n_types = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+def _force_cpu_jax():
+    """The image's sitecustomize latches jax_platforms=axon into live config
+    (env var alone is ignored); force it back and drop the device-plugin
+    factories so no op can touch the tunneled chip."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
 
-    from karpenter_tpu.models import TPUSolver
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    for plat in ("axon", "tpu"):
+        getattr(xla_bridge, "_backend_factories", {}).pop(plat, None)
+
+
+def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
+    """Child-process body: build the workload, warm up, time one solve."""
+    if engine == "probe":
+        # tiny device op: proves the tunneled TPU backend can initialize
+        # and execute at all, without paying a full workload timeout
+        import jax.numpy as jnp
+
+        assert float(jnp.ones(8).sum()) == 8.0
+        return {"metric": "probe", "value": 1, "unit": "ok", "vs_baseline": None}
+    if engine == "cpu":
+        _force_cpu_jax()
+    if engine == "native":
+        from karpenter_tpu.models import NativeSolver as Solver
+    else:
+        from karpenter_tpu.models import TPUSolver as Solver
 
     pods, templates, its = build_workload(n_pods, n_types)
-    solver = TPUSolver()
+    solver = Solver()
 
-    # warmup: compile the shape bucket
+    # warmup: compile the shape bucket (first TPU compile can take 20-40s)
     solver.solve(pods, templates, its)
 
     t0 = time.perf_counter()
@@ -73,20 +108,109 @@ def main():
 
     assert res.scheduled_pod_count() + len(res.pod_errors) == n_pods
     pods_per_sec = n_pods / elapsed
+    return {
+        "metric": f"solve_wall_clock_{n_pods}pods_x_{n_types}types",
+        "value": round(elapsed * 1000, 2),
+        "unit": "ms",
+        # reference floor: 100 pods/sec (scheduling_benchmark_test.go:55)
+        "vs_baseline": round(pods_per_sec / 100.0, 1),
+        "detail": {
+            "engine": engine,
+            "pods_per_sec": round(pods_per_sec),
+            "nodes": res.node_count(),
+            "scheduled": res.scheduled_pod_count(),
+            "device_stats": solver.last_device_stats,
+        },
+    }
+
+
+# (engine, attempts, per-attempt timeout seconds, backoff between attempts).
+# native (C++ host kernel) outranks jax-on-CPU as the fallback: same
+# tensorize→kernel→decode pipeline and identical results, ~5x faster than
+# the XLA CPU backend on the 50k workload.
+LADDER = (
+    ("axon", 2, 420, 20),
+    ("native", 1, 600, 0),
+    ("cpu", 1, 420, 5),
+)
+
+
+def _attempt(engine: str, n_pods: int, n_types: int, timeout: float):
+    """One watchdog-guarded child run. Returns (record|None, diagnostic)."""
+    env = dict(os.environ)
+    if engine not in ("axon", "probe"):
+        env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", engine,
+           str(n_pods), str(n_types)]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, {"engine": engine, "outcome": "timeout", "seconds": round(timeout)}
+    dt = round(time.perf_counter() - t0, 1)
+    if proc.returncode == 0:
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                if isinstance(rec, dict) and "metric" in rec:
+                    return rec, {"engine": engine, "outcome": "ok", "seconds": dt}
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    return None, {
+        "engine": engine,
+        "outcome": f"rc={proc.returncode}",
+        "seconds": dt,
+        "tail": tail,
+    }
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if "--child" in sys.argv:
+        engine = sys.argv[sys.argv.index("--child") + 1]
+        n_pods = int(args[1]) if len(args) > 1 else 50_000
+        n_types = int(args[2]) if len(args) > 2 else 500
+        print(json.dumps(run_bench(engine, n_pods, n_types)))
+        return
+
+    n_pods = int(args[0]) if args else 50_000
+    n_types = int(args[1]) if len(args) > 1 else 500
+
+    attempts = []
+    for engine, tries, timeout, backoff in LADDER:
+        for i in range(tries):
+            if i:
+                time.sleep(backoff)
+            if engine == "axon":
+                # cheap liveness probe first: a wedged tunnel blocks PJRT
+                # init forever, so don't pay the full workload timeout on it
+                _, pdiag = _attempt("probe", 0, 0, 90)
+                pdiag["probe_for"] = "axon"
+                attempts.append(pdiag)
+                if pdiag["outcome"] != "ok":
+                    print(f"bench: axon probe {i + 1}: {pdiag['outcome']}", file=sys.stderr)
+                    continue
+            rec, diag = _attempt(engine, n_pods, n_types, timeout)
+            attempts.append(diag)
+            print(f"bench: {engine} attempt {i + 1}: {diag['outcome']}", file=sys.stderr)
+            if rec is not None:
+                rec.setdefault("detail", {})["attempts"] = attempts
+                print(json.dumps(rec))
+                return
+    # every engine failed: still emit a parseable record (value null) with
+    # the full diagnostic trail — never exit silent/nonzero without one
     print(
         json.dumps(
             {
                 "metric": f"solve_wall_clock_{n_pods}pods_x_{n_types}types",
-                "value": round(elapsed * 1000, 2),
+                "value": None,
                 "unit": "ms",
-                # reference floor: 100 pods/sec (scheduling_benchmark_test.go:55)
-                "vs_baseline": round(pods_per_sec / 100.0, 1),
-                "detail": {
-                    "pods_per_sec": round(pods_per_sec),
-                    "nodes": res.node_count(),
-                    "scheduled": res.scheduled_pod_count(),
-                    "device_stats": solver.last_device_stats,
-                },
+                "vs_baseline": None,
+                "detail": {"engine": "none", "attempts": attempts},
             }
         )
     )

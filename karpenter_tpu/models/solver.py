@@ -260,10 +260,7 @@ class TPUSolver(Solver):
         """Bins → InFlightNodeClaims, with host-side validation of each
         claim's joint instance-type set (the kernel approximates joint
         offering feasibility by intersecting per-group feasibility)."""
-        from karpenter_tpu.cloudprovider.types import (
-            instance_type_compatible,
-            satisfies_min_values,
-        )
+        from karpenter_tpu.cloudprovider.types import satisfies_min_values
 
         cursors = [0] * snap.G
         claims = []
@@ -293,8 +290,16 @@ class TPUSolver(Solver):
         # runs once per distinct key; per-bin work is only the resource-fit
         # check (many bins are clones in a deployment burst)
         compat_cache: dict = {}
-        for ci, b in enumerate(cols):
-            m = int(tmpl[b])
+        # all (group, bin) memberships in one pass instead of a per-column
+        # flatnonzero inside the loop
+        sub = assign[:, cols]
+        nz_ci, nz_gi = np.nonzero(sub.T)  # (bin-column, group) pairs, ci-major
+        counts_flat = sub.T[nz_ci, nz_gi]
+        row_starts = np.searchsorted(nz_ci, np.arange(len(cols)))
+        row_ends = np.append(row_starts[1:], len(nz_ci))
+        tmpl_cols = tmpl[cols]
+        for ci in range(len(cols)):
+            m = int(tmpl_cols[ci])
             template = snap.templates[m]
             bin_pods = []
             req_vec = breq[ci]
@@ -302,8 +307,9 @@ class TPUSolver(Solver):
                 r: float(v) for r, v in zip(snap.resources, req_vec.tolist()) if v > 0
             }
             gset = []
-            for g in np.flatnonzero(assign[:, b]).tolist():
-                c = int(assign[g, b])
+            for j in range(row_starts[ci], row_ends[ci]):
+                g = int(nz_gi[j])
+                c = int(counts_flat[j])
                 gset.append(g)
                 bin_pods.extend(snap.groups[g][cursors[g] : cursors[g] + c])
                 cursors[g] += c
@@ -318,35 +324,51 @@ class TPUSolver(Solver):
                 # pairwise (group×type), so it misses three-way value
                 # intersections (template ∩ pod ∩ type each pairwise-overlap
                 # but jointly empty) and cross-offering splits. The host
-                # re-checks the merged requirement set on every survivor,
-                # once per distinct (template, group-set) key.
+                # re-checks the MERGED requirement set on every survivor —
+                # exact because the bitmask of the merged set IS the value
+                # intersection over the interned vocabulary (bench profile:
+                # the python per-type instance_type_compatible loop this
+                # replaces was the single largest decode cost).
                 joint = feas[gset[0]]
                 for g in gset[1:]:
                     joint = joint & feas[g]
-                candidates = [
-                    (t, snap.type_refs[t][1])
-                    for t in np.flatnonzero(joint)
-                    if snap.type_refs[t][0] == m
-                    and instance_type_compatible(snap.type_refs[t][1], bin_reqs, None)
-                ]
-                # allocatable matrix over the snapshot resource axis: the
-                # per-bin fit check becomes one vectorized compare
-                alloc = np.array(
-                    [
-                        [it.allocatable().get(r, 0.0) for r in snap.resources]
-                        for _, it in candidates
-                    ],
-                    dtype=np.float64,
-                ).reshape(len(candidates), len(snap.resources))
-                # float64 from the source capacity dicts, like alloc above:
-                # the f32 kernel tensors are too coarse at memory-byte scale
-                tcap = np.array(
-                    [
-                        [it.capacity.get(r, 0.0) for r in snap.resources]
-                        for _, it in candidates
-                    ],
-                    dtype=np.float64,
-                ).reshape(len(candidates), len(snap.resources))
+                tsel = np.flatnonzero(joint & (snap.t_tmpl == m))
+                if tsel.size:
+                    mask_bin, has_bin, tol_bin = snap.mask_set(bin_reqs)
+                    tm, th, tt = snap.t_mask[tsel], snap.t_has[tsel], snap.t_tol[tsel]
+                    shared = th & has_bin[None, :]
+                    overlap = ((tm & mask_bin[None, :, :]) != 0).any(axis=2)
+                    # Intersects tolerates an empty meet iff BOTH operators
+                    # are NotIn/DoesNotExist (requirements.py:249)
+                    both_tol = tt & tol_bin[None, :]
+                    req_ok = (~shared | overlap | both_tol).all(axis=1)
+                    # offerings: available ∧ zone/capacity-type bit of the
+                    # offering inside the bin's merged allowed sets (the
+                    # per-offering joint check F cannot express)
+                    off_ok = snap.off_avail[tsel].copy()
+                    for label, off_idx in (
+                        (wk.TOPOLOGY_ZONE_LABEL, snap.off_zone[tsel]),
+                        (wk.CAPACITY_TYPE_LABEL, snap.off_ct[tsel]),
+                    ):
+                        k = snap.key_index.get(label)
+                        if k is None or not has_bin[k]:
+                            continue
+                        nv = len(snap.vocab[label])
+                        if nv == 0:
+                            # key interned with zero values (e.g. a bare
+                            # Exists): offerings that define it cannot exist,
+                            # ones that don't (-1) are unconstrained
+                            continue
+                        bits = np.arange(nv)
+                        allowed = ((mask_bin[k, bits // 32] >> (bits % 32)) & 1).astype(bool)
+                        off_ok &= np.where(off_idx >= 0, allowed[np.maximum(off_idx, 0)], True)
+                    ok_rows = req_ok & off_ok.any(axis=1)
+                    tsel = tsel[ok_rows]
+                candidates = [(int(t), snap.type_refs[int(t)][1]) for t in tsel]
+                # allocatable/capacity rows over the snapshot resource axis:
+                # the per-bin fit and limit checks become vectorized compares
+                alloc = snap.alloc64()[tsel]
+                tcap = snap.cap64()[tsel]
                 cached = (bin_reqs, candidates, alloc, tcap)
                 compat_cache[key] = cached
             bin_reqs, compat, alloc, tcap = cached

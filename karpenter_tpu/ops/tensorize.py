@@ -64,6 +64,8 @@ class DeviceSnapshot:
     type_refs: list  # [(template_idx, InstanceType)]
     t_mask: np.ndarray  # [T,K,W] u32
     t_has: np.ndarray  # [T,K] bool
+    t_tol: np.ndarray  # [T,K] bool (operator NotIn/DoesNotExist: an empty
+    # meet with another such requirement is tolerated, requirements.py:249)
     t_alloc: np.ndarray  # [T,R] f32
     t_cap: np.ndarray  # [T,R] f32
     t_tmpl: np.ndarray  # [T] i32
@@ -92,6 +94,57 @@ class DeviceSnapshot:
     @property
     def T(self):
         return len(self.type_refs)
+
+    def mask_set(self, reqs) -> tuple:
+        """(mask [K,W], has [K], tol [K]) for an arbitrary merged
+        Requirements over this snapshot's interned vocabulary — the host-side
+        analog of the group/type mask build, used by the decoder's vectorized
+        joint-compatibility check. `tol` mirrors Intersects' tolerance rule
+        (requirements.py:249): an empty meet is allowed iff BOTH operators
+        are NotIn/DoesNotExist — NOT the complement flag (Gt/Lt/Exists are
+        complements but operator Exists, and DoesNotExist is not)."""
+        K = len(self.keys)
+        mask = np.zeros((K, self.W), dtype=np.uint32)
+        has = np.zeros(K, dtype=bool)
+        tol = np.zeros(K, dtype=bool)
+        for r in reqs.values():
+            if r.key == wk.HOSTNAME_LABEL or r.key not in self.key_index:
+                continue
+            k = self.key_index[r.key]
+            has[k] = True
+            tol[k] = r.operator in (NOT_IN, DOES_NOT_EXIST)
+            mask[k] = _materialize_mask(r, self.vocab[r.key], self.W)
+        return mask, has, tol
+
+    def alloc64(self) -> np.ndarray:
+        """[T,R] float64 allocatable from the source dicts (memoized) — the
+        f32 device tensors are too coarse at memory-byte scale for the
+        decoder's exact host-side checks."""
+        a = getattr(self, "_alloc64", None)
+        if a is None:
+            a = np.array(
+                [
+                    [it.allocatable().get(r, 0.0) for r in self.resources]
+                    for _, it in self.type_refs
+                ],
+                dtype=np.float64,
+            ).reshape(len(self.type_refs), len(self.resources))
+            self._alloc64 = a
+        return a
+
+    def cap64(self) -> np.ndarray:
+        """[T,R] float64 capacity from the source dicts (memoized)."""
+        c = getattr(self, "_cap64", None)
+        if c is None:
+            c = np.array(
+                [
+                    [it.capacity.get(r, 0.0) for r in self.resources]
+                    for _, it in self.type_refs
+                ],
+                dtype=np.float64,
+            ).reshape(len(self.type_refs), len(self.resources))
+            self._cap64 = c
+        return c
 
 
 def pod_signature(pod) -> tuple:
@@ -271,6 +324,7 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
 
     t_mask = np.zeros((T, K, W), dtype=np.uint32)
     t_has = np.zeros((T, K), dtype=bool)
+    t_tol = np.zeros((T, K), dtype=bool)
     t_alloc = np.zeros((T, len(resources)), dtype=np.float32)
     t_cap = np.zeros((T, len(resources)), dtype=np.float32)
     t_tmpl = np.zeros(T, dtype=np.int32)
@@ -285,6 +339,9 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     for t, (m, it) in enumerate(type_refs):
         t_tmpl[t] = m
         t_mask[t], t_has[t] = build_mask_set(it.requirements)
+        for r in it.requirements.values():
+            if r.key in key_index:
+                t_tol[t, key_index[r.key]] = r.operator in (NOT_IN, DOES_NOT_EXIST)
         alloc = it.allocatable()
         for r, v in alloc.items():
             if r in r_index:
@@ -302,7 +359,7 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
         vocab=vocab, keys=keys, key_index=key_index, W=W,
         build_mask_set=build_mask_set,
         m_mask=m_mask, m_has=m_has,
-        type_refs=type_refs, t_mask=t_mask, t_has=t_has,
+        type_refs=type_refs, t_mask=t_mask, t_has=t_has, t_tol=t_tol,
         t_alloc=t_alloc, t_cap=t_cap, t_tmpl=t_tmpl,
         off_zone=off_zone, off_ct=off_ct, off_avail=off_avail,
         off_price=off_price, zone_vocab=zone_vocab, ct_vocab=ct_vocab,
@@ -335,12 +392,18 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
     # (which relaxation/injection mutate) are fresh objects without the
     # cached attribute
     by_sig: dict = {}
+    # localized hot loop: one dict probe per pod
+    get_group = by_sig.get
     for pod in pods:
-        sig = pod.__dict__.get("_sig_cache")
+        d = pod.__dict__
+        sig = d.get("_sig_cache")
         if sig is None:
-            sig = pod_signature(pod)
-            pod.__dict__["_sig_cache"] = sig
-        by_sig.setdefault(sig, []).append(pod)
+            sig = d["_sig_cache"] = pod_signature(pod)
+        grp = get_group(sig)
+        if grp is None:
+            by_sig[sig] = [pod]
+        else:
+            grp.append(pod)
     groups = sorted(
         by_sig.values(),
         key=lambda g: (
@@ -378,7 +441,7 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
         for r, v in limits.get(tpl.nodepool_name, {}).items():
             if r in r_index:
                 m_limits[m, r_index[r]] = v
-    t_mask, t_has = ts["t_mask"], ts["t_has"]
+    t_mask, t_has, t_tol = ts["t_mask"], ts["t_has"], ts["t_tol"]
     t_alloc, t_cap, t_tmpl = ts["t_alloc"], ts["t_cap"], ts["t_tmpl"]
     off_zone, off_ct = ts["off_zone"], ts["off_ct"]
     off_avail, off_price = ts["off_avail"], ts["off_price"]
@@ -440,6 +503,7 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
         type_refs=type_refs,
         t_mask=t_mask,
         t_has=t_has,
+        t_tol=t_tol,
         t_alloc=t_alloc,
         t_cap=t_cap,
         t_tmpl=t_tmpl,

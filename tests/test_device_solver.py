@@ -188,3 +188,55 @@ class TestDeviceParity:
         assert dev.all_pods_scheduled()
         assert dev.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
         assert all(c.template.nodepool_name == "spot-pool" for c in dev.new_claims)
+
+
+class TestDecodeJointCompat:
+    def test_merged_notin_tolerated_against_type_notin(self):
+        """Two NotIn groups merge into a NotIn whose meet with a type-side
+        NotIn is empty over the interned vocab — Intersects tolerates empty
+        meets when BOTH operators are NotIn/DoesNotExist (requirements.py:249),
+        so the decoder must keep the type, like instance_type_compatible did."""
+        from karpenter_tpu.scheduling import NOT_IN, Requirement
+
+        catalog = [
+            make_instance_type(
+                "only", 8, 32,
+                extra_requirements=[Requirement("team", NOT_IN, ["c"])],
+            )
+        ]
+        pool = nodepool(requirements=[NodeSelectorRequirement("team", "Exists", [])])
+        p1 = pod("p1", affinity=Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("team", "NotIn", ["a"])])])))
+        p2 = pod("p2", affinity=Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("team", "NotIn", ["b"])])])))
+        host, dev = run_both([p1, p2], [pool], catalog)
+        assert host.scheduled_pod_count() == 2
+        assert dev.scheduled_pod_count() == 2
+        assert dev.node_count() == host.node_count()
+        # and the device path itself must keep the claim (no retry fallback)
+        assert dev.new_claims and all(
+            it.name == "only" for c in dev.new_claims for it in c.instance_types
+        )
+
+    def test_gt_lt_disjoint_bounds_rejected(self):
+        """Type 'gen Gt 5' vs pod 'gen Lt 3': complement flags on both sides,
+        but the operators are Exists-with-bounds, so the empty meet must NOT
+        be tolerated (the round-2 review caught a complement-flag version of
+        the tolerance check accepting this)."""
+        from karpenter_tpu.scheduling import GT, Requirement
+
+        catalog = [
+            make_instance_type(
+                "gen6", 8, 32,
+                extra_requirements=[Requirement("gen", GT, ["5"])],
+            )
+        ]
+        pool = nodepool(requirements=[NodeSelectorRequirement("gen", "Exists", [])])
+        p = pod("p1", affinity=Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("gen", "Lt", ["3"])])])))
+        host, dev = run_both([p], [pool], catalog)
+        assert host.scheduled_pod_count() == 0
+        assert dev.scheduled_pod_count() == 0
