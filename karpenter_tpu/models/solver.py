@@ -94,10 +94,10 @@ class TPUSolver(Solver):
         max_bins: int | None = None,
         volume_topology=None,
     ) -> SchedulerResults:
-        # Existing-node scheduling and topology-group waves join the device
-        # path incrementally; those snapshots route through the host loop.
+        # Existing-node scheduling joins the device path with M5; those
+        # snapshots route through the host loop.
         has_topology = bool(getattr(topology, "has_groups", topology is not None and not isinstance(topology, NullTopology)))
-        if existing_nodes or has_topology or not templates:
+        if existing_nodes or not templates:
             return self.host.solve(
                 pods,
                 templates,
@@ -113,26 +113,65 @@ class TPUSolver(Solver):
         # (scheduler.go:267 tries templates in weight order)
         templates = sorted(templates, key=lambda t: (-t.weight, t.nodepool_name))
 
-        eligible, rest = [], []
-        for p in pods:
-            ok = p.__dict__.get("_elig_cache")
-            if ok is None:
-                ok = device_eligible(p)
-                p.__dict__["_elig_cache"] = ok
-            (eligible if ok else rest).append(p)
-        if not eligible:
-            return self.host.solve(
-                pods,
+        if has_topology:
+            # topology-constrained batch: the waves compiler turns the
+            # self-selecting constraint shapes into zone-pinned subgroups /
+            # per-bin caps; everything it can't express routes to the host
+            from karpenter_tpu.ops import waves
+            from karpenter_tpu.ops.tensorize import (
+                device_basic_eligible,
+                group_by_signature,
+            )
+
+            basic, rest = [], []
+            for p in pods:
+                ok = p.__dict__.get("_basic_elig_cache")
+                if ok is None:
+                    ok = device_basic_eligible(p)
+                    p.__dict__["_basic_elig_cache"] = ok
+                (basic if ok else rest).append(p)
+            plan = waves.compile_topology(group_by_signature(basic), topology)
+            rest.extend(plan.host_pods)
+            device_groups = plan.device_groups
+            if not device_groups:
+                return self.host.solve(
+                    pods,
+                    templates,
+                    instance_types,
+                    topology=topology,
+                    daemon_overhead=daemon_overhead,
+                    limits=limits,
+                    volume_topology=volume_topology,
+                )
+            eligible = [p for dg in device_groups for p in dg.pods]
+            snap = tensorize(
+                None,
                 templates,
                 instance_types,
                 daemon_overhead=daemon_overhead,
                 limits=limits,
-                volume_topology=volume_topology,
+                device_plan=plan,
             )
-
-        snap = tensorize(
-            eligible, templates, instance_types, daemon_overhead=daemon_overhead, limits=limits
-        )
+        else:
+            eligible, rest = [], []
+            for p in pods:
+                ok = p.__dict__.get("_elig_cache")
+                if ok is None:
+                    ok = device_eligible(p)
+                    p.__dict__["_elig_cache"] = ok
+                (eligible if ok else rest).append(p)
+            if not eligible:
+                return self.host.solve(
+                    pods,
+                    templates,
+                    instance_types,
+                    daemon_overhead=daemon_overhead,
+                    limits=limits,
+                    volume_topology=volume_topology,
+                )
+            snap = tensorize(
+                eligible, templates, instance_types, daemon_overhead=daemon_overhead, limits=limits
+            )
         claims, retry, bins, exhausted = self._run_and_decode(snap, max_bins)
         # estimated bin axis ran dry with pods left over: double and re-run
         # on device (exact result, one more kernel dispatch) instead of
@@ -151,6 +190,18 @@ class TPUSolver(Solver):
             retry_pods=len(retry),
             host_pods=len(rest),
         )
+        if has_topology:
+            # commit the FINAL claim set into the host topology engine once
+            # (a doubled re-run discards its predecessor's claims, so decode
+            # itself must not record): register each claim hostname domain
+            # (nodeclaim.go:49) and record every landed group with
+            # multiplicity (topology.go Record:141), so the host pass and
+            # later rounds see the device placements
+            for claim in claims:
+                claim.topology = topology
+                topology.register(wk.HOSTNAME_LABEL, claim.hostname)
+                for g, c in getattr(claim, "_gcounts", ()):
+                    topology.record_many(snap.groups[g][0], claim.requirements, c)
         # debit nodepool limits for the device-built claims so the host pass
         # can't double-spend them (scheduler.go:292 subtractMax)
         if limits:
@@ -168,6 +219,7 @@ class TPUSolver(Solver):
                 rest + retry,
                 templates,
                 instance_types,
+                topology=topology if has_topology else None,
                 daemon_overhead=daemon_overhead,
                 limits=limits,
                 initial_claims=claims,
@@ -197,6 +249,12 @@ class TPUSolver(Solver):
             with np.errstate(divide="ignore", invalid="ignore"):
                 lb = np.where(max_alloc > 0, np.ceil(demand_tot / max_alloc), 0.0)
             est = int(np.nanmax(lb)) if lb.size else 1
+            # bin-cap topology groups force distinct bins: a cap-c group of
+            # n pods needs >= ceil(n/c) bins regardless of resource demand
+            # (different capped groups may share bins, so max not sum)
+            caps = np.maximum(snap.g_bin_cap.astype(np.int64), 1)
+            cap_lb = int(np.ceil(snap.g_count / caps).max()) if G else 0
+            est = max(est, min(cap_lb, total_pods))
             # 1.5x FFD headroom: the doubling re-run below catches a miss
             B = min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
         Gp, Tp, Bp = _bucket(G), _bucket(T), _bucket(B)
@@ -214,6 +272,10 @@ class TPUSolver(Solver):
             g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
             g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
             g_tmpl_ok=pad(snap.g_tmpl_ok, (Gp, M)),
+            g_bin_cap=pad(snap.g_bin_cap, (Gp,)),
+            g_single=pad(snap.g_single, (Gp,)),
+            g_decl=pad(snap.g_decl, (Gp, snap.g_decl.shape[1])),
+            g_match=pad(snap.g_match, (Gp, snap.g_match.shape[1])),
             t_mask=pad(snap.t_mask, (Tp, K, W)),
             t_has=pad(snap.t_has, (Tp, K)),
             t_alloc=pad(snap.t_alloc, (Tp, R)),
@@ -232,7 +294,7 @@ class TPUSolver(Solver):
         args["off_ct"][:T] = snap.off_ct
         # padded types must be infeasible: zero alloc fails fits (pods>=1)
 
-        key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], Bp)
+        key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], snap.g_decl.shape[1], Bp)
         host = self._invoke(args, key, Bp)
         assign = host["assign"][:G, :Bp]
         used = host["used"]
@@ -307,10 +369,12 @@ class TPUSolver(Solver):
                 r: float(v) for r, v in zip(snap.resources, req_vec.tolist()) if v > 0
             }
             gset = []
+            gcounts = []
             for j in range(row_starts[ci], row_ends[ci]):
                 g = int(nz_gi[j])
                 c = int(counts_flat[j])
                 gset.append(g)
+                gcounts.append((g, c))
                 bin_pods.extend(snap.groups[g][cursors[g] : cursors[g] + c])
                 cursors[g] += c
             key = (m, tuple(gset))
@@ -406,6 +470,7 @@ class TPUSolver(Solver):
             # debit only once the claim survives validation — a bin dropped
             # to retry must not consume limit budget for later bins
             rem_limits[m] -= tcap[ok].max(axis=0)
+            claim._gcounts = gcounts  # for the solver's topology commit
             claims.append(claim)
         # pods the kernel couldn't place (unsched counts are implied by the
         # unconsumed remainder of each group)

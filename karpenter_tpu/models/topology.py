@@ -143,6 +143,12 @@ class TopologyGroup:
             self.domains[d] = self.domains.get(d, 0) + 1
             self.empty_domains.discard(d)
 
+    def record_n(self, domain, n: int):
+        """record() with multiplicity — the device decoder commits a whole
+        group of identical pods at once."""
+        self.domains[domain] = self.domains.get(domain, 0) + n
+        self.empty_domains.discard(domain)
+
     def register(self, *domains):
         for d in domains:
             if d not in self.domains:
@@ -289,16 +295,23 @@ class Topology:
 
     def record(self, pod, requirements: Requirements, allow_undefined=None):
         """Commit domain usage after a pod lands (topology.go Record:141)."""
+        self.record_many(pod, requirements, 1)
+
+    def record_many(self, pod, requirements: Requirements, n: int):
+        """record() with multiplicity: the device decoder lands a group of
+        n identical pods in one commit; `pod` is the group representative."""
         for tg in self.topologies.values():
             if tg.counts(pod, requirements):
                 domains = requirements.get_req(tg.key)
                 if tg.type == TYPE_ANTI_AFFINITY:
-                    tg.record(*domains.values)
+                    for v in domains.values:
+                        tg.record_n(v, n)
                 elif len(domains) == 1:
-                    tg.record(next(iter(domains.values)))
+                    tg.record_n(next(iter(domains.values)), n)
         for tg in self.inverse_topologies.values():
             if pod.uid in tg.owners:
-                tg.record(*requirements.get_req(tg.key).values)
+                for v in requirements.get_req(tg.key).values:
+                    tg.record_n(v, n)
 
     # -- construction helpers -------------------------------------------
     def _new_for_topologies(self, pod):

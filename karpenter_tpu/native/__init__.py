@@ -74,7 +74,7 @@ def load():
         fn.restype = ctypes.c_int
         fn.argtypes = (
             [ctypes.c_int] * 10
-            + [_u32p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p]       # group side
+            + [_u32p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p, _u8p]  # group side
             + [_u32p, _u8p, _f32p, _f32p, _i32p]                  # type side
             + [_i32p, _i32p, _u8p]                                # offerings
             + [_u32p, _u8p, _f32p, _f32p]                         # templates
@@ -121,6 +121,12 @@ def solve_step(args: dict, max_bins: int) -> dict:
         np.ascontiguousarray(args["g_count"], dtype=np.int32),
         gza, gca,
         np.ascontiguousarray(args["g_tmpl_ok"], dtype=np.uint8),
+        np.ascontiguousarray(
+            args.get("g_bin_cap", np.full(G, 1 << 30, dtype=np.int32)), dtype=np.int32
+        ),
+        np.ascontiguousarray(
+            args.get("g_single", np.zeros(G, dtype=np.uint8)), dtype=np.uint8
+        ),
         t_mask,
         np.ascontiguousarray(args["t_has"], dtype=np.uint8),
         np.ascontiguousarray(args["t_alloc"], dtype=np.float32),

@@ -92,6 +92,7 @@ int karpenter_solve(
     const uint32_t* g_mask, const uint8_t* g_has, const float* g_demand,
     const int32_t* g_count, const uint8_t* g_zone_allowed,
     const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
+    const int32_t* g_bin_cap, const uint8_t* g_single,
     const uint32_t* t_mask, const uint8_t* t_has, const float* t_alloc,
     const float* t_cap, const int32_t* t_tmpl,
     const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
@@ -155,6 +156,8 @@ int karpenter_solve(
         const uint8_t* gh = g_has + (size_t)g * K;
         const float* d = g_demand + (size_t)g * R;
         const uint8_t* Fg = F.data() + (size_t)g * T;
+        const int cap_g = g_bin_cap[g] > 0 ? g_bin_cap[g] : (1 << 30);
+        const bool single = g_single[g] != 0;
 
         // existing bins, emptiest first (scheduler.go:258)
         order.resize(bins.size());
@@ -162,6 +165,25 @@ int karpenter_solve(
         std::stable_sort(order.begin(), order.end(), [&](int a, int b) {
             return bins[a].npods < bins[b].npods;
         });
+        if (single) {
+            // whole group confined to one bin (hostname pod affinity,
+            // topologygroup.go:219): pick the single highest-capacity bin
+            int best_bi = -1, best_q = 0;
+            for (int bi : order) {
+                Bin& bin = bins[bi];
+                if (!tmpl_full[(size_t)g * M + bin.tmpl]) continue;
+                if (!masks_compatible(bin.mask.data(), bin.has.data(), gm, gh, K, W))
+                    continue;
+                int q = 0;
+                for (int t : bin.types) {
+                    if (!Fg[t]) continue;
+                    q = std::max(q, cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R));
+                }
+                if (q > best_q) { best_q = q; best_bi = bi; }
+            }
+            order.clear();
+            if (best_bi >= 0) order.push_back(best_bi);
+        }
         for (int bi : order) {
             if (n <= 0) break;
             Bin& bin = bins[bi];
@@ -174,6 +196,7 @@ int karpenter_solve(
                 if (!Fg[t]) continue;
                 q = std::max(q, cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R));
             }
+            q = std::min(q, cap_g);  // per-bin topology cap (waves)
             if (q <= 0) continue;
             int take = std::min(q, n);
             n -= take;
@@ -195,8 +218,13 @@ int karpenter_solve(
             combine_masks(bin.mask, bin.has, gm, gh, K, W);
         }
 
-        // new bins from the first (weight-ordered) feasible template
+        // new bins from the first (weight-ordered) feasible template.
+        // single-bin groups open at most ONE bin, and only when nothing
+        // landed on an existing bin (followers join the first pod's claim
+        // or fail, topology.py:207 bootstrap)
+        bool opened_for_single = false;
         while (n > 0 && (int)bins.size() < B) {
+            if (single && (n < g_count[g] || opened_for_single)) break;
             int m_star = -1, per_node = 0;
             for (int m = 0; m < M && m_star < 0; ++m) {
                 if (!tmpl_full[(size_t)g * M + m]) continue;
@@ -226,6 +254,7 @@ int karpenter_solve(
             bin.mask.assign(m_mask + (size_t)m_star * K * W,
                             m_mask + (size_t)m_star * K * W + (size_t)K * W);
             bin.has.assign(m_has + (size_t)m_star * K, m_has + (size_t)m_star * K + K);
+            per_node = std::min(per_node, cap_g);
             int take = std::min(per_node, n);
             bin.npods = take;
             for (int r = 0; r < R; ++r) bin.load[r] += take * d[r];
@@ -252,6 +281,7 @@ int karpenter_solve(
             bins.push_back(std::move(bin));
             assign[(size_t)g * B + bi] = take;
             n -= take;
+            opened_for_single = true;
         }
         // pods still unplaced are implied by count - sum(assign[g]) and
         // re-routed by the decoder, matching the device kernel's contract

@@ -135,6 +135,10 @@ def pack(
     g_has,  # [G,K]
     F,  # [G,T] feasibility
     tmpl_full,  # [G,M]
+    g_bin_cap,  # [G] i32: max pods of the group per bin (waves topology)
+    g_single,  # [G] bool: whole group confined to one bin (hostname affinity)
+    g_decl,  # [G,CW] u32: hostname-anti classes the group declares
+    g_match,  # [G,CW] u32: hostname-anti classes whose selector matches it
     # static catalog
     t_alloc,  # [T,R]
     t_cap,  # [T,R]
@@ -150,6 +154,17 @@ def pack(
     assign [G,B] i32, used [B] bool, npods [B] i32, types [B,T] bool,
     tmpl [B] i32. Pods a group couldn't place are implied by
     count - sum(assign[g]) and re-routed by the decoder.
+
+    Topology structure compiled by ops/waves.py arrives as per-group
+    scalars: `g_bin_cap` bounds a bin's share of the group (hostname
+    spread maxSkew / anti-affinity cap 1, topologygroup.go:167,252) and
+    `g_single` confines the whole group to one bin (hostname pod
+    affinity, topologygroup.go:219). Hostname anti-affinity across groups
+    is conflict classes: a bin hosting pods MATCHED by class c excludes
+    groups DECLARING c and vice versa (the direct/inverse TopologyGroup
+    pair, topology.go:225); bins carry declared/matched class bitmask
+    state. Zone constraints ride the ordinary requirement masks as
+    zone-pinned subgroups and need nothing here.
     """
     G, R = g_demand.shape
     T = t_alloc.shape[0]
@@ -157,6 +172,7 @@ def pack(
     B = max_bins
     t_is_m = t_tmpl[:, None] == jnp.arange(M)[None, :]  # [T,M]
 
+    CW = g_decl.shape[1]
     state = dict(
         used=jnp.zeros(B, dtype=bool),
         npods=jnp.zeros(B, dtype=jnp.int32),
@@ -166,10 +182,12 @@ def pack(
         bhas=jnp.zeros((B,) + g_has.shape[1:], dtype=bool),
         btmpl=jnp.zeros(B, dtype=jnp.int32),
         rem=m_limits.astype(jnp.float32),
+        bdecl=jnp.zeros((B, CW), dtype=jnp.uint32),
+        bmatch=jnp.zeros((B, CW), dtype=jnp.uint32),
     )
 
     def step(state, xs):
-        d, n, gm, gh, Fg, tfull = xs
+        d, n, gm, gh, Fg, tfull, cap_g, single, decl_g, match_g = xs
         has_pods = n > 0
 
         # ---- existing bins: compatibility ----
@@ -177,6 +195,12 @@ def pack(
         ov = jnp.any((state["bmask"] & gm[None, :, :]) != 0, axis=-1)
         compat_b = jnp.all(~both | ov, axis=-1)
         compat_b = compat_b & state["used"] & jnp.take(tfull, state["btmpl"])
+        # hostname anti-affinity conflict classes: a declarer avoids bins
+        # hosting matched pods; a matched group avoids bins with declarers
+        anti_ok = jnp.all(
+            (state["bmatch"] & decl_g[None, :]) == 0, axis=-1
+        ) & jnp.all((state["bdecl"] & match_g[None, :]) == 0, axis=-1)
+        compat_b = compat_b & anti_ok
 
         # ---- per-bin capacity for this group (max over remaining types) ----
         avail = t_alloc[None, :, :] - state["load"][:, None, :]  # [B,T,R]
@@ -185,8 +209,16 @@ def pack(
         cap_bt = jnp.where(state["types"] & Fg[None, :], jnp.maximum(cap_bt, 0), 0)
         q = jnp.max(cap_bt, axis=-1)  # [B]
         q = jnp.where(compat_b, q, 0)
+        q = jnp.minimum(q, cap_g)  # per-bin topology cap (waves)
 
         take = _level_fill(q, state["npods"], n)
+        # single-bin group: everything lands on the single highest-capacity
+        # bin (any bin with matches works — the whole group commits at once)
+        b_star = jnp.argmax(q)
+        take_single = (
+            jnp.zeros_like(take).at[b_star].set(jnp.minimum(jnp.max(q), n))
+        )
+        take = jnp.where(single, take_single, take)
         take = jnp.where(has_pods, take, 0)
         assigned = jnp.sum(take)
         spill = n - assigned
@@ -204,7 +236,7 @@ def pack(
         # templates are pre-sorted by weight: first feasible wins
         m_star = jnp.argmax(feasible_m)
         any_m = jnp.any(feasible_m)
-        per_node = jnp.maximum(jnp.take(per_node_m, m_star), 1)
+        per_node = jnp.maximum(jnp.minimum(jnp.take(per_node_m, m_star), cap_g), 1)
 
         # worst-case capacity of a new bin (for limit accounting, below)
         worst = jnp.max(
@@ -219,6 +251,12 @@ def pack(
         ).astype(jnp.int32)
 
         want_new = jnp.where(any_m & (spill > 0), (spill + per_node - 1) // per_node, 0)
+        # single-bin group: one new bin, and only if nothing placed on an
+        # existing bin (followers join the first pod's claim or fail —
+        # topology.py:207 bootstrap)
+        want_new = jnp.where(
+            single, jnp.where((assigned == 0) & any_m & (spill > 0), 1, 0), want_new
+        )
         want_new = jnp.minimum(want_new, max_new_by_limit)
         free = ~state["used"]
         rank = jnp.cumsum(free.astype(jnp.int32)) - 1
@@ -256,6 +294,12 @@ def pack(
         n_opened = jnp.sum(sel.astype(jnp.float32))
         rem3 = state["rem"].at[m_star].add(-worst * n_opened)
 
+        # ---- conflict-class commit: any bin that received pods of this
+        # group now carries its declared/matched classes ----
+        landed = (upd | (sel & (pods_new > 0)))[:, None]
+        bdecl3 = jnp.where(landed, state["bdecl"] | decl_g[None, :], state["bdecl"])
+        bmatch3 = jnp.where(landed, state["bmatch"] | match_g[None, :], state["bmatch"])
+
         new_state = dict(
             used=used3,
             npods=npods3,
@@ -265,10 +309,13 @@ def pack(
             bhas=bhas3,
             btmpl=btmpl3,
             rem=rem3,
+            bdecl=bdecl3,
+            bmatch=bmatch3,
         )
         return new_state, take + pods_new
 
-    xs = (g_demand, g_count, g_mask, g_has, F, tmpl_full)
+    xs = (g_demand, g_count, g_mask, g_has, F, tmpl_full, g_bin_cap, g_single,
+          g_decl, g_match)
     state, assign = jax.lax.scan(step, state, xs)
     return dict(
         assign=assign,  # [G,B] (scan stacks per-step [B] outputs)
@@ -286,6 +333,15 @@ def solve_step(args: dict, max_bins: int) -> dict:
     # device arrays throughout: the scan body indexes these with traced
     # values, which numpy inputs cannot satisfy when called outside jit
     args = {k: jnp.asarray(v) for k, v in args.items()}
+    G = args["g_count"].shape[0]
+    if "g_bin_cap" not in args:
+        args["g_bin_cap"] = jnp.full(G, 1 << 30, dtype=jnp.int32)
+    if "g_single" not in args:
+        args["g_single"] = jnp.zeros(G, dtype=bool)
+    if "g_decl" not in args:
+        args["g_decl"] = jnp.zeros((G, 1), dtype=jnp.uint32)
+    if "g_match" not in args:
+        args["g_match"] = jnp.zeros((G, 1), dtype=jnp.uint32)
     F, price, tmpl_full = feasibility(
         args["g_mask"], args["g_has"], args["g_demand"],
         args["t_mask"], args["t_has"], args["t_alloc"],
@@ -295,6 +351,7 @@ def solve_step(args: dict, max_bins: int) -> dict:
     )
     out = pack(
         args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,
+        args["g_bin_cap"], args["g_single"], args["g_decl"], args["g_match"],
         args["t_alloc"], args["t_cap"], args["t_tmpl"], args["m_mask"], args["m_has"],
         args["m_overhead"], args["m_limits"], max_bins=max_bins,
     )

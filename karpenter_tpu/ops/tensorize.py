@@ -59,6 +59,10 @@ class DeviceSnapshot:
     g_mask: np.ndarray  # [G,K,W] u32
     g_has: np.ndarray  # [G,K] bool
     g_tmpl_ok: np.ndarray  # [G,M] bool
+    g_bin_cap: np.ndarray  # [G] i32 max pods of the group per bin (waves)
+    g_single: np.ndarray  # [G] bool whole group confined to one bin (waves)
+    g_decl: np.ndarray  # [G,CW] u32 hostname-anti classes the group declares
+    g_match: np.ndarray  # [G,CW] u32 hostname-anti classes matching the group
 
     # flattened (template, type) axis (T)
     type_refs: list  # [(template_idx, InstanceType)]
@@ -176,25 +180,96 @@ def pod_signature(pod) -> tuple:
     )
     ovh = tuple(sorted(pod.overhead.items()))
     tol_sig = tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations))
-    return (ns, aff, res, cont, init, ovh, tol_sig)
+    # labels: topology selectors match on them, so the waves compiler needs
+    # label-homogeneous groups to reason per-representative
+    lbl = tuple(sorted(pod.metadata.labels.items()))
+    # topology fields: pods with distinct spread/affinity constraints must
+    # not share a group — the waves compiler plans topology PER GROUP
+    spread = tuple(
+        (
+            c.topology_key,
+            c.max_skew,
+            c.when_unsatisfiable,
+            c.min_domains,
+            _selector_sig(c.label_selector),
+        )
+        for c in pod.topology_spread_constraints or ()
+    )
+    pa = ()
+    if pod.affinity is not None:
+        for kind, block in (
+            ("aff", pod.affinity.pod_affinity),
+            ("anti", pod.affinity.pod_anti_affinity),
+        ):
+            if block is None:
+                continue
+            pa += tuple(
+                (kind, t.topology_key, _selector_sig(t.label_selector),
+                 tuple(sorted(t.namespaces)), req)
+                for req, terms in (("req", block.required),)
+                for t in terms
+            )
+            pa += tuple(
+                (kind, w.pod_affinity_term.topology_key,
+                 _selector_sig(w.pod_affinity_term.label_selector),
+                 tuple(sorted(w.pod_affinity_term.namespaces)), "pref")
+                for w in block.preferred
+            )
+    return (ns, aff, res, cont, init, ovh, tol_sig, lbl, spread, pa)
 
 
-def device_eligible(pod) -> bool:
-    """Pods the M1 device path handles; the rest go to the host solver.
-    (M2 extends this to topology constraints.)"""
-    if pod.affinity and (pod.affinity.pod_affinity or pod.affinity.pod_anti_affinity):
-        return False
-    if pod.affinity and pod.affinity.node_affinity:
-        na = pod.affinity.node_affinity
-        if na.preferred or len(na.required) > 1:
-            return False  # relaxation ladder is host-side
-    if pod.topology_spread_constraints:
-        return False
+def _selector_sig(sel):
+    if sel is None:
+        return None
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple((e.key, e.operator, tuple(sorted(e.values))) for e in sel.match_expressions),
+    )
+
+
+def group_by_signature(pods) -> list:
+    """list[list[Pod]] grouped by scheduling signature (unsorted)."""
+    by_sig: dict = {}
+    get_group = by_sig.get
+    for pod in pods:
+        d = pod.__dict__
+        sig = d.get("_sig_cache")
+        if sig is None:
+            sig = d["_sig_cache"] = pod_signature(pod)
+        grp = get_group(sig)
+        if grp is None:
+            by_sig[sig] = [pod]
+        else:
+            grp.append(pod)
+    return list(by_sig.values())
+
+
+def device_basic_eligible(pod) -> bool:
+    """Spec features the device path can express at all; topology-constraint
+    support is decided per GROUP by the waves compiler (ops/waves.py).
+    Preferred terms need the relaxation ladder, which is host-side."""
+    if pod.affinity is not None:
+        a = pod.affinity
+        if a.pod_affinity and a.pod_affinity.preferred:
+            return False
+        if a.pod_anti_affinity and a.pod_anti_affinity.preferred:
+            return False
+        if a.node_affinity and (a.node_affinity.preferred or len(a.node_affinity.required) > 1):
+            return False
     if getattr(pod, "host_ports", None) or getattr(pod, "volumes", None):
         return False
     if any(c.get("ports") for c in pod.containers or []):
         return False
     return True
+
+
+def device_eligible(pod) -> bool:
+    """Pods the topology-free device path handles without a waves plan."""
+    if pod.affinity and (pod.affinity.pod_affinity or pod.affinity.pod_anti_affinity):
+        return False
+    if pod.topology_spread_constraints:
+        return False
+    return device_basic_eligible(pod)
 
 
 def _materialize_mask(req, vocab_k: dict, W: int) -> np.ndarray:
@@ -374,44 +449,60 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     return cached
 
 
-def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, limits=None):
+def tensorize(
+    pods,
+    templates,
+    instance_types_by_pool,
+    daemon_overhead=None,
+    limits=None,
+    device_plan=None,
+):
     """Compile a scheduling snapshot to tensors.
 
-    pods: eligible pods (caller pre-filters with device_eligible)
+    pods: eligible pods (caller pre-filters with device_eligible); ignored
+        when device_plan is given
     templates: [ClaimTemplate] in weight order
     instance_types_by_pool: nodepool name -> [InstanceType]
     daemon_overhead: nodepool name -> ResourceList
     limits: nodepool name -> ResourceList (remaining resources; absent = inf)
+    device_plan: pre-compiled waves.WavesPlan (topology-compiled subgroups
+        with extra requirements / bin caps / conflict classes), groups
+        already in the order the scan should process them
     """
     daemon_overhead = daemon_overhead or {}
     limits = limits or {}
 
-    # ---- group pods by signature, FFD order ----
-    # the signature is cached on the pod object: the provisioner re-solves
-    # the same (immutable-spec) Pod instances round after round, and clones
-    # (which relaxation/injection mutate) are fresh objects without the
-    # cached attribute
-    by_sig: dict = {}
-    # localized hot loop: one dict probe per pod
-    get_group = by_sig.get
-    for pod in pods:
-        d = pod.__dict__
-        sig = d.get("_sig_cache")
-        if sig is None:
-            sig = d["_sig_cache"] = pod_signature(pod)
-        grp = get_group(sig)
-        if grp is None:
-            by_sig[sig] = [pod]
-        else:
-            grp.append(pod)
-    groups = sorted(
-        by_sig.values(),
-        key=lambda g: (
-            -g[0].effective_requests().get(resutil.CPU, 0.0),
-            -g[0].effective_requests().get(resutil.MEMORY, 0.0),
-        ),
-    )
-    group_reqs = [pod_requirements(g[0]) for g in groups]
+    if device_plan is not None:
+        device_groups = device_plan.device_groups
+        groups = [dg.pods for dg in device_groups]
+        group_reqs = []
+        for dg in device_groups:
+            reqs = pod_requirements(dg.pods[0])
+            if dg.extra_reqs:
+                reqs = reqs.copy()
+                reqs.add(*dg.extra_reqs)
+            group_reqs.append(reqs)
+        g_bin_cap_list = [dg.bin_cap for dg in device_groups]
+        g_single_list = [dg.single_bin for dg in device_groups]
+        g_decl, g_match = device_plan.class_masks()
+    else:
+        # ---- group pods by signature, FFD order ----
+        # the signature is cached on the pod object: the provisioner
+        # re-solves the same (immutable-spec) Pod instances round after
+        # round; clones (which relaxation/injection mutate) are fresh
+        # objects without the cached attribute
+        groups = sorted(
+            group_by_signature(pods),
+            key=lambda g: (
+                -g[0].effective_requests().get(resutil.CPU, 0.0),
+                -g[0].effective_requests().get(resutil.MEMORY, 0.0),
+            ),
+        )
+        group_reqs = [pod_requirements(g[0]) for g in groups]
+        g_bin_cap_list = [1 << 30] * len(groups)
+        g_single_list = [False] * len(groups)
+        g_decl = np.zeros((len(groups), 1), dtype=np.uint32)
+        g_match = np.zeros((len(groups), 1), dtype=np.uint32)
     group_demand = [g[0].effective_requests() for g in groups]
 
     # ---- resource dimension union ----
@@ -455,6 +546,8 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
     g_tmpl_ok = np.zeros((G, M), dtype=bool)
     g_zone_allowed = np.ones((G, max(len(zone_vocab), 1)), dtype=bool)
     g_ct_allowed = np.ones((G, max(len(ct_vocab), 1)), dtype=bool)
+    g_bin_cap = np.asarray(g_bin_cap_list, dtype=np.int32).reshape(G)
+    g_single = np.asarray(g_single_list, dtype=bool).reshape(G)
 
     for g, (pods_g, reqs) in enumerate(zip(groups, group_reqs)):
         for r, v in group_demand[g].items():
@@ -513,6 +606,10 @@ def tensorize(pods, templates, instance_types_by_pool, daemon_overhead=None, lim
         off_price=off_price,
         g_zone_allowed=g_zone_allowed,
         g_ct_allowed=g_ct_allowed,
+        g_bin_cap=g_bin_cap,
+        g_single=g_single,
+        g_decl=g_decl,
+        g_match=g_match,
         templates=list(templates),
         m_mask=m_mask,
         m_has=m_has,

@@ -1,0 +1,306 @@
+"""Topology compiler: constraint groups → device group plan.
+
+TPU-native reformulation of the reference's TopologyGroup machinery
+(topologygroup.go:167-265). The host engine resolves topology domain-by-
+domain while pods stream through the FFD loop; the device path instead
+compiles each constraint into static group structure the pack kernel
+understands, so the whole batch stays one device call:
+
+- zone topology spread (topologygroup.go nextDomainTopologySpread:167):
+  placing identical pods one-at-a-time into the least-loaded allowed domain
+  is exactly water-filling, so the per-zone pod counts are computed in
+  closed form here and the group splits into zone-pinned SUBGROUPS. The
+  zone pin rides the ordinary requirement mask — bins narrow to one zone
+  exactly like host claims do. Counts from OTHER matching groups are only
+  visible to the host engine when a matched pod lands on an
+  already-pinned claim (Record commits singleton domains only,
+  topology.py:290); the static plan ignores that narrow window.
+- hostname topology spread (maxSkew s): every bin is its own hostname
+  domain and an empty node is always mintable, so the domain-min is 0 and
+  each bin may hold at most s pods of the group -> per-group BIN CAP.
+- hostname pod anti-affinity (nextDomainAntiAffinity:252) as CONFLICT
+  CLASSES: each distinct required hostname anti-affinity term is a class;
+  a group DECLARING class c cannot share a bin with pods MATCHED by c
+  (the direct TopologyGroup), and a group matched by c cannot share a bin
+  with declarers (the inverse group, topology.go:225). Bins carry
+  declared/matched class bitmasks in kernel state. Cluster-pod domain
+  counts only name EXISTING nodes, which the device never packs onto, so
+  they don't gate the new-bin path.
+- zone pod affinity (nextDomainAffinity:219): pods need a domain with
+  matches. With existing matches the allowed set is the non-empty domains;
+  bootstrap pins the sorted-first allowed domain (the host engine uses the
+  same deterministic tie-break).
+- hostname pod affinity: all matching pods co-locate on one claim ->
+  SINGLE-BIN group flag for the kernel.
+
+Anything else — zone anti-affinity (the Schrödinger case records every
+candidate domain, topology_test semantics), cross-group zone affinity,
+preferred terms, minDomains, same-selector spreads with different
+parameters — routes to the host engine, which remains the semantic oracle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.models.topology import (
+    TYPE_AFFINITY,
+    TYPE_ANTI_AFFINITY,
+    TYPE_SPREAD,
+    Topology,
+)
+from karpenter_tpu.scheduling import IN, Requirement, pod_requirements
+from karpenter_tpu.utils import resources as resutil
+
+UNCAPPED = 1 << 30
+WORD = 32
+
+
+@dataclass
+class DeviceGroup:
+    """One kernel scan row: identical pods + compiled topology structure."""
+
+    pods: list
+    extra_reqs: list = field(default_factory=list)  # e.g. zone pin
+    bin_cap: int = UNCAPPED  # max pods of this group per bin
+    single_bin: bool = False  # hostname affinity: whole group in one bin
+    decl_classes: frozenset = frozenset()  # hostname-anti classes declared
+    match_classes: frozenset = frozenset()  # hostname-anti classes matched
+
+
+@dataclass
+class WavesPlan:
+    device_groups: list
+    host_pods: list
+    n_classes: int = 0
+
+    @property
+    def device_pod_count(self):
+        return sum(len(g.pods) for g in self.device_groups)
+
+    def class_masks(self):
+        """(g_decl [G,CW] u32, g_match [G,CW] u32) for the kernel."""
+        G = len(self.device_groups)
+        CW = max(1, (self.n_classes + WORD - 1) // WORD)
+        decl = np.zeros((G, CW), dtype=np.uint32)
+        match = np.zeros((G, CW), dtype=np.uint32)
+        for g, dg in enumerate(self.device_groups):
+            for c in dg.decl_classes:
+                decl[g, c // WORD] |= np.uint32(1 << (c % WORD))
+            for c in dg.match_classes:
+                match[g, c // WORD] |= np.uint32(1 << (c % WORD))
+        return decl, match
+
+
+def _group_key(g0):
+    return (
+        -g0.effective_requests().get(resutil.CPU, 0.0),
+        -g0.effective_requests().get(resutil.MEMORY, 0.0),
+    )
+
+
+def _water_fill(counts: dict, n: int) -> dict:
+    """Distribute n additions over domains, always raising the lowest —
+    the closed form of the host's least-loaded-domain placement loop.
+    Returns domain -> additions. Deterministic (sorted domain tie-break)."""
+    out = {d: 0 for d in counts}
+    cur = dict(counts)
+    remaining = n
+    while remaining > 0:
+        lo = min(cur.values())
+        lows = sorted(d for d in cur if cur[d] == lo)
+        higher = [v for v in cur.values() if v > lo]
+        gap = (min(higher) - lo) if higher else None
+        if gap is not None and gap * len(lows) <= remaining:
+            for d in lows:
+                cur[d] += gap
+                out[d] += gap
+            remaining -= gap * len(lows)
+        else:
+            per, extra = divmod(remaining, len(lows))
+            for j, d in enumerate(lows):
+                add = per + (1 if j < extra else 0)
+                cur[d] += add
+                out[d] += add
+            remaining = 0
+    return out
+
+
+def _spread_conflicts(topology) -> set:
+    """Hash keys of spread groups sharing (key, selector, namespaces) with
+    another spread group but different parameters — their counts interact
+    in ways the static plan cannot express."""
+    seen: dict = {}
+    conflicted: set = set()
+    for hk, tg in topology.topologies.items():
+        if tg.type != TYPE_SPREAD:
+            continue
+        sel = hk[3]  # selector component of hash_key
+        ident = (tg.key, sel, tg.namespaces)
+        other = seen.get(ident)
+        if other is not None and other != hk:
+            conflicted.add(hk)
+            conflicted.add(other)
+        seen[ident] = hk
+    return conflicted
+
+
+def compile_topology(groups: list, topology) -> WavesPlan:
+    """groups: list[list[Pod]] (identical pods per list, any order).
+    Returns the device plan; pods whose constraints the device cannot
+    express are returned in host_pods."""
+    groups = sorted(groups, key=lambda g: _group_key(g[0]))  # FFD order
+
+    if topology is None or not getattr(topology, "has_groups", False):
+        return WavesPlan([DeviceGroup(list(g)) for g in groups], [])
+
+    reps = [g[0] for g in groups]
+    own_by_gid = [
+        [tg for tg in topology.topologies.values() if rep.uid in tg.owners]
+        for rep in reps
+    ]
+    spread_conflicted = _spread_conflicts(topology)
+
+    # ---- hostname anti-affinity conflict classes ----
+    # one class per distinct required hostname anti term owned in the batch
+    anti_classes: dict = {}  # tg hash_key -> class index
+    for gid, own in enumerate(own_by_gid):
+        for tg in own:
+            if tg.type == TYPE_ANTI_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
+                anti_classes.setdefault(tg.hash_key(), len(anti_classes))
+    anti_tgs = {
+        hk: tg for hk, tg in topology.topologies.items() if hk in anti_classes
+    }
+
+    # inverse groups whose declarers are NOT in this batch and whose key is
+    # not hostname constrain allowed domains in ways the plan can't see
+    zone_inverse = [
+        tg for tg in topology.inverse_topologies.values()
+        if tg.key != wk.HOSTNAME_LABEL
+    ]
+
+    device_groups: list = []
+    host_pods: list = []
+    overlay: dict = {}  # id(tg) -> compile-local domain counts
+
+    for gid, pods in enumerate(groups):
+        rep = reps[gid]
+        own = own_by_gid[gid]
+
+        if any(tg.selects(rep) for tg in zone_inverse):
+            host_pods.extend(pods)
+            continue
+
+        extra_reqs: list = []
+        bin_cap = UNCAPPED
+        single_bin = False
+        zone_split = None  # domain -> count
+        decl: set = set()
+        ok = True
+
+        for tg in own:
+            # compile-time domain counts live in an overlay so later
+            # co-owner groups see this group's planned placements without
+            # mutating the Topology object — ACTUAL placements are recorded
+            # by the decoder, so a capacity spill cannot inflate the counts
+            # the host fallback pass reads
+            counts = overlay.setdefault(id(tg), dict(tg.domains))
+            if tg.type == TYPE_SPREAD and tg.key == wk.TOPOLOGY_ZONE_LABEL:
+                if (
+                    tg.min_domains is not None
+                    or zone_split is not None
+                    or tg.hash_key() in spread_conflicted
+                ):
+                    ok = False
+                    break
+                pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+                allowed = {d: c for d, c in counts.items() if pod_zone.has(d)}
+                if not allowed:
+                    ok = False
+                    break
+                zone_split = _water_fill(allowed, len(pods))
+                for d, add in zone_split.items():
+                    counts[d] = counts.get(d, 0) + add
+                zone_split = {d: c for d, c in zone_split.items() if c > 0}
+            elif tg.type == TYPE_SPREAD and tg.key == wk.HOSTNAME_LABEL:
+                bin_cap = min(bin_cap, max(int(tg.max_skew), 1))
+            elif tg.type == TYPE_ANTI_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
+                decl.add(anti_classes[tg.hash_key()])
+            elif tg.type == TYPE_AFFINITY and tg.key == wk.TOPOLOGY_ZONE_LABEL:
+                # cross-group zone affinity (followers of an unpinned
+                # in-batch target) stays on the host engine
+                if any(tg.selects(r) for i, r in enumerate(reps) if i != gid):
+                    ok = False
+                    break
+                nonzero = sorted(d for d, c in counts.items() if c > 0)
+                pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+                if nonzero:
+                    allowed_d = [d for d in nonzero if pod_zone.has(d)]
+                    if not allowed_d:
+                        ok = False
+                        break
+                    extra_reqs.append(Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, allowed_d))
+                else:
+                    # bootstrap: deterministic sorted-first allowed domain
+                    # (the host engine's tie-break, topology.py:207)
+                    first = next(
+                        (d for d in sorted(counts) if pod_zone.has(d)), None
+                    )
+                    if first is None:
+                        ok = False
+                        break
+                    extra_reqs.append(Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [first]))
+                    counts[first] = counts.get(first, 0) + len(pods)
+            elif tg.type == TYPE_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
+                if any(tg.selects(r) for i, r in enumerate(reps) if i != gid) or any(
+                    counts.values()
+                ):
+                    ok = False  # cross-group or existing matches: host
+                    break
+                single_bin = True
+            else:
+                ok = False
+                break
+
+        if not ok:
+            host_pods.extend(pods)
+            continue
+
+        # classes whose selector matches this group (the inverse direction)
+        match = {
+            c for hk, c in anti_classes.items() if anti_tgs[hk].selects(rep)
+        }
+        if decl & match:
+            # self-matching anti-affinity: at most one pod of the group per
+            # bin, the classic one-replica-per-node shape
+            bin_cap = 1
+
+        if zone_split:
+            # zone-pinned subgroups; pods partitioned in order
+            cursor = 0
+            for d in sorted(zone_split):
+                cnt = zone_split[d]
+                sub = pods[cursor : cursor + cnt]
+                cursor += cnt
+                device_groups.append(
+                    DeviceGroup(
+                        sub,
+                        extra_reqs + [Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [d])],
+                        bin_cap,
+                        single_bin,
+                        frozenset(decl),
+                        frozenset(match),
+                    )
+                )
+        else:
+            device_groups.append(
+                DeviceGroup(
+                    list(pods), extra_reqs, bin_cap, single_bin,
+                    frozenset(decl), frozenset(match),
+                )
+            )
+
+    return WavesPlan(device_groups, host_pods, n_classes=len(anti_classes))

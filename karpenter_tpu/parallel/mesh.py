@@ -69,13 +69,16 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
 
     # pad shardable axes to multiples of their mesh axis
     args = dict(args)
-    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok"):
+    G = np.asarray(args["g_count"]).shape[0]
+    args.setdefault("g_bin_cap", np.full(G, 1 << 30, dtype=np.int32))
+    args.setdefault("g_single", np.zeros(G, dtype=bool))
+    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single"):
         args[name] = _pad_to(np.asarray(args[name]), 0, n_data)
     for name in ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail", "off_price"):
         args[name] = _pad_to(np.asarray(args[name]), 0, n_model)
 
     placed = dict(args)
-    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok"):
+    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single"):
         placed[name] = shard(args[name], P(DATA_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
     for name in ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail", "off_price"):
         placed[name] = shard(args[name], P(MODEL_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))

@@ -1,0 +1,269 @@
+"""Device topology path (ops/waves.py): the test_topology.py scenarios
+driven through TPUSolver, asserting parity with the host engine AND that
+the supported shapes actually run on the device (not the host fallback).
+
+Reference semantics: topologygroup.go:167-265 (spread/affinity/anti-
+affinity next-domain math), topology_test.go scenarios.
+"""
+
+import collections
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver, TPUSolver
+from karpenter_tpu.models.topology import Topology
+
+GIB = 2**30
+ZONES = ("zone-1", "zone-2", "zone-3")
+
+
+def nodepool(name="default"):
+    return NodePool(metadata=ObjectMeta(name=name))
+
+
+def catalog():
+    return [
+        make_instance_type("small", 4, 16, zones=ZONES),
+        make_instance_type("large", 32, 128, zones=ZONES),
+    ]
+
+
+def make_pods(n, labels, cpu=1.0, name_prefix="p", **kw):
+    return [
+        Pod(
+            metadata=ObjectMeta(name=f"{name_prefix}{i}", labels=dict(labels)),
+            requests={"cpu": cpu, "memory": 1 * GIB},
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+def zone_spread(max_skew=1, labels=None, **kw):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=wk.TOPOLOGY_ZONE_LABEL,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+        **kw,
+    )
+
+
+def hostname_spread(max_skew=1, labels=None):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=wk.HOSTNAME_LABEL,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+    )
+
+
+def affinity(labels=None, key=wk.TOPOLOGY_ZONE_LABEL):
+    return Affinity(
+        pod_affinity=PodAffinity(
+            required=[
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+                )
+            ]
+        )
+    )
+
+
+def anti(labels=None, key=wk.HOSTNAME_LABEL):
+    return Affinity(
+        pod_anti_affinity=PodAffinity(
+            required=[
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+                )
+            ]
+        )
+    )
+
+
+def solve_both(pods, domains=None):
+    pool = nodepool()
+    its = {pool.name: catalog()}
+    doms = domains or {wk.TOPOLOGY_ZONE_LABEL: set(ZONES)}
+    host = HostSolver().solve(
+        [p.clone() for p in pods],
+        [ClaimTemplate(pool)],
+        its,
+        topology=Topology(domains={k: set(v) for k, v in doms.items()}, pods=pods),
+    )
+    dev_solver = TPUSolver()
+    dev = dev_solver.solve(
+        [p.clone() for p in pods],
+        [ClaimTemplate(pool)],
+        its,
+        topology=Topology(domains={k: set(v) for k, v in doms.items()}, pods=pods),
+    )
+    return host, dev, dev_solver
+
+
+def zone_skew(res):
+    counts = collections.Counter()
+    for claim in res.new_claims:
+        zone_req = claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL)
+        assert len(zone_req.values) == 1, "claim not pinned to one zone"
+        counts[next(iter(zone_req.values))] += len(claim.pods)
+    return counts
+
+
+class TestDeviceZonalSpread:
+    def test_even_spread_on_device(self):
+        pods = make_pods(9, {"app": "web"}, topology_spread_constraints=[zone_spread()])
+        host, dev, s = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        assert s.last_device_stats["device_pods"] == 9
+        assert sorted(zone_skew(dev).values()) == sorted(zone_skew(host).values()) == [3, 3, 3]
+
+    def test_uneven_count_within_skew(self):
+        pods = make_pods(7, {"app": "web"}, topology_spread_constraints=[zone_spread()])
+        host, dev, s = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        counts = zone_skew(dev)
+        assert sum(counts.values()) == 7
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_spread_two_deployments_share_selector_counts(self):
+        # two groups (different cpu) sharing one spread selector: the
+        # compiled counts must evolve sequentially across groups
+        a = make_pods(4, {"app": "web"}, cpu=2.0, name_prefix="a",
+                      topology_spread_constraints=[zone_spread()])
+        b = make_pods(5, {"app": "web"}, cpu=1.0, name_prefix="b",
+                      topology_spread_constraints=[zone_spread()])
+        host, dev, s = solve_both(a + b)
+        assert dev.all_pods_scheduled()
+        counts = zone_skew(dev)
+        assert sum(counts.values()) == 9
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_node_count_parity(self):
+        pods = make_pods(30, {"app": "web"}, topology_spread_constraints=[zone_spread()])
+        host, dev, _ = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        assert dev.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
+
+
+class TestDeviceHostnameSpread:
+    def test_one_pod_per_node(self):
+        pods = make_pods(5, {"app": "web"},
+                         topology_spread_constraints=[hostname_spread(max_skew=1)])
+        host, dev, s = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        assert s.last_device_stats["device_pods"] == 5
+        assert dev.node_count() == host.node_count() == 5
+        assert all(len(c.pods) == 1 for c in dev.new_claims)
+
+    def test_skew_two(self):
+        pods = make_pods(6, {"app": "web"},
+                         topology_spread_constraints=[hostname_spread(max_skew=2)])
+        _, dev, _ = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        assert all(len(c.pods) <= 2 for c in dev.new_claims)
+
+
+class TestDeviceAntiAffinity:
+    def test_hostname_one_per_node(self):
+        pods = make_pods(5, {"app": "web"}, affinity=anti())
+        host, dev, s = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        assert s.last_device_stats["device_pods"] == 5
+        assert dev.node_count() == host.node_count() == 5
+
+    def test_anti_group_shares_nodes_with_others(self):
+        # bins capped for the anti group can still host other pods
+        anti_pods = make_pods(3, {"app": "web"}, name_prefix="x", affinity=anti())
+        generic = make_pods(6, {"app": "other"}, name_prefix="g")
+        host, dev, _ = solve_both(anti_pods + generic)
+        assert dev.all_pods_scheduled()
+        assert dev.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
+
+    def test_zone_anti_affinity_routes_to_host(self):
+        # Schrödinger semantics (topology_test.go:1914) stay on the host
+        pods = make_pods(5, {"app": "web"}, affinity=anti(key=wk.TOPOLOGY_ZONE_LABEL))
+        host, dev, s = solve_both(pods)
+        assert s.last_device_stats.get("device_pods", 0) == 0
+        assert dev.scheduled_pod_count() == host.scheduled_pod_count() == 1
+        assert len(dev.pod_errors) == len(host.pod_errors) == 4
+
+    def test_cross_group_anti_routes_to_host(self):
+        guard = make_pods(1, {"app": "guard"}, name_prefix="gd",
+                          affinity=anti({"app": "web"}, key=wk.TOPOLOGY_ZONE_LABEL))
+        web = make_pods(3, {"app": "web"}, name_prefix="w")
+        host, dev, _ = solve_both(guard + web)
+        assert dev.scheduled_pod_count() == host.scheduled_pod_count()
+        assert len(dev.pod_errors) == len(host.pod_errors)
+
+
+class TestDevicePodAffinity:
+    def test_zone_affinity_single_zone(self):
+        pods = make_pods(6, {"app": "web"}, affinity=affinity())
+        host, dev, s = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        assert s.last_device_stats["device_pods"] == 6
+        assert len(zone_skew(dev)) == 1
+
+    def test_hostname_affinity_one_claim(self):
+        pods = make_pods(3, {"app": "web"}, affinity=affinity(key=wk.HOSTNAME_LABEL))
+        host, dev, s = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        assert dev.node_count() == host.node_count() == 1
+
+    def test_affinity_to_other_group_routes_to_host(self):
+        target = make_pods(1, {"app": "db"}, name_prefix="t")[0]
+        target.node_selector = {wk.TOPOLOGY_ZONE_LABEL: "zone-2"}
+        followers = make_pods(3, {"app": "web"}, name_prefix="f",
+                              affinity=affinity({"app": "db"}))
+        host, dev, _ = solve_both([target] + followers)
+        assert dev.all_pods_scheduled() == host.all_pods_scheduled()
+        assert dev.scheduled_pod_count() == host.scheduled_pod_count() == 4
+
+
+class TestDeviceCombined:
+    def test_config3_mix_mostly_on_device(self):
+        """The BASELINE config-3 shape: zone spread + hostname anti +
+        generic, one service per 50 pods — every constrained pod must run
+        on the device path."""
+        from perf import configs as C
+
+        pods, pools, cat = C.config3_antiaffinity_spread(n_pods=300, n_types=10)
+        its = {p.name: cat for p in pools}
+        topo = Topology(domains={wk.TOPOLOGY_ZONE_LABEL: {"zone-1", "zone-2", "zone-3"}},
+                        pods=pods)
+        s = TPUSolver()
+        res = s.solve([p.clone() for p in pods], [ClaimTemplate(p) for p in pools], its,
+                      topology=topo)
+        assert res.all_pods_scheduled()
+        assert s.last_device_stats["device_pods"] == 300
+        assert s.last_device_stats["host_pods"] == 0
+
+        host = HostSolver().solve(
+            [p.clone() for p in pods], [ClaimTemplate(p) for p in pools], its,
+            topology=Topology(domains={wk.TOPOLOGY_ZONE_LABEL: {"zone-1", "zone-2", "zone-3"}},
+                              pods=pods))
+        assert res.node_count() <= max(host.node_count() * 1.05, host.node_count() + 2)
+
+    def test_spread_skew_respected_on_device(self):
+        pods = make_pods(12, {"app": "web"},
+                         topology_spread_constraints=[zone_spread(max_skew=2)])
+        _, dev, _ = solve_both(pods)
+        assert dev.all_pods_scheduled()
+        counts = zone_skew(dev)
+        assert max(counts.values()) - min(counts.values()) <= 2
