@@ -21,7 +21,7 @@ from karpenter_tpu.api import labels as wk
 from karpenter_tpu.models.inflight import InFlightNodeClaim
 from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
 from karpenter_tpu.ops import tensorize
-from karpenter_tpu.ops.tensorize import device_eligible
+from karpenter_tpu.ops.tensorize import SPREAD_OWNED_MIN, UNCAPPED, device_eligible
 from karpenter_tpu.utils import resources as resutil
 
 
@@ -254,6 +254,14 @@ class TPUSolver(Solver):
             # (different capped groups may share bins, so max not sum)
             caps = np.maximum(snap.g_bin_cap.astype(np.int64), 1)
             cap_lb = int(np.ceil(snap.g_count / caps).max()) if G else 0
+            # spread classes share the per-bin cap ACROSS groups: class c
+            # needs >= ceil(sum of owner counts / cap) distinct bins
+            owned = snap.g_sown < SPREAD_OWNED_MIN
+            if owned.any():
+                cnt = snap.g_count[:, None] * owned  # [G,C]
+                cap_c = np.where(owned, snap.g_sown, 1).max(axis=0)  # [C]
+                cls_lb = np.ceil(cnt.sum(axis=0) / np.maximum(cap_c, 1)).max()
+                cap_lb = max(cap_lb, int(cls_lb))
             est = max(est, min(cap_lb, total_pods))
             # 1.5x FFD headroom: the doubling re-run below catches a miss
             B = min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
@@ -276,6 +284,10 @@ class TPUSolver(Solver):
             g_single=pad(snap.g_single, (Gp,)),
             g_decl=pad(snap.g_decl, (Gp, snap.g_decl.shape[1])),
             g_match=pad(snap.g_match, (Gp, snap.g_match.shape[1])),
+            # padded group rows get sown=0 (cap 0), which is inert: their
+            # count is 0 so they never take
+            g_sown=pad(snap.g_sown, (Gp, snap.g_sown.shape[1])),
+            g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
             t_mask=pad(snap.t_mask, (Tp, K, W)),
             t_has=pad(snap.t_has, (Tp, K)),
             t_alloc=pad(snap.t_alloc, (Tp, R)),
@@ -294,7 +306,8 @@ class TPUSolver(Solver):
         args["off_ct"][:T] = snap.off_ct
         # padded types must be infeasible: zero alloc fails fits (pods>=1)
 
-        key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], snap.g_decl.shape[1], Bp)
+        key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], snap.g_decl.shape[1],
+               snap.g_sown.shape[1], Bp)
         host = self._invoke(args, key, Bp)
         assign = host["assign"][:G, :Bp]
         used = host["used"]

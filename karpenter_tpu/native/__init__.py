@@ -16,6 +16,8 @@ import threading
 
 import numpy as np
 
+from karpenter_tpu.ops.tensorize import UNCAPPED
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "kernel.cpp")
 
@@ -73,8 +75,10 @@ def load():
         fn = lib.karpenter_solve
         fn.restype = ctypes.c_int
         fn.argtypes = (
-            [ctypes.c_int] * 10
-            + [_u32p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p, _u8p]  # group side
+            [ctypes.c_int] * 11
+            + [_u32p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p, _u8p,
+               _u32p, _u32p]                                      # group side
+            + [ctypes.c_int, _i32p, _u8p]                         # spread classes
             + [_u32p, _u8p, _f32p, _f32p, _i32p]                  # type side
             + [_i32p, _i32p, _u8p]                                # offerings
             + [_u32p, _u8p, _f32p, _f32p]                         # templates
@@ -106,6 +110,28 @@ def solve_step(args: dict, max_bins: int) -> dict:
     R = g_demand.shape[1]
     gza = np.ascontiguousarray(args["g_zone_allowed"], dtype=np.uint8)
     gca = np.ascontiguousarray(args["g_ct_allowed"], dtype=np.uint8)
+    # width-paired arrays default from their partner so a caller supplying
+    # only one cannot feed the kernel mismatched class axes
+    CW = np.asarray(
+        args.get("g_decl", args.get("g_match", np.zeros((G, 1))))
+    ).shape[1]
+    g_decl = np.ascontiguousarray(
+        args.get("g_decl", np.zeros((G, CW), dtype=np.uint32)), dtype=np.uint32
+    )
+    g_match = np.ascontiguousarray(
+        args.get("g_match", np.zeros((G, CW), dtype=np.uint32)), dtype=np.uint32
+    )
+    if g_match.shape != g_decl.shape:
+        raise ValueError(f"g_decl/g_match shape mismatch: {g_decl.shape} vs {g_match.shape}")
+    C = np.asarray(args.get("g_sown", args.get("g_smatch", np.zeros((G, 1))))).shape[1]
+    g_sown = np.ascontiguousarray(
+        args.get("g_sown", np.full((G, C), UNCAPPED, dtype=np.int32)), dtype=np.int32
+    )
+    g_smatch = np.ascontiguousarray(
+        args.get("g_smatch", np.zeros((G, C), dtype=np.uint8)), dtype=np.uint8
+    )
+    if g_smatch.shape != g_sown.shape:
+        raise ValueError(f"g_sown/g_smatch shape mismatch: {g_sown.shape} vs {g_smatch.shape}")
     B = int(max_bins)
 
     assign = np.zeros((G, B), dtype=np.int32)
@@ -114,7 +140,7 @@ def solve_step(args: dict, max_bins: int) -> dict:
     F = np.zeros((G, T), dtype=np.uint8)
 
     rc = fn(
-        G, T, K, W, R, M, O, B, gza.shape[1], gca.shape[1],
+        G, T, K, W, R, M, O, B, gza.shape[1], gca.shape[1], CW,
         g_mask,
         np.ascontiguousarray(args["g_has"], dtype=np.uint8),
         g_demand,
@@ -127,6 +153,8 @@ def solve_step(args: dict, max_bins: int) -> dict:
         np.ascontiguousarray(
             args.get("g_single", np.zeros(G, dtype=np.uint8)), dtype=np.uint8
         ),
+        g_decl, g_match,
+        C, g_sown, g_smatch,
         t_mask,
         np.ascontiguousarray(args["t_has"], dtype=np.uint8),
         np.ascontiguousarray(args["t_alloc"], dtype=np.float32),

@@ -35,7 +35,42 @@ struct Bin {
     std::vector<int> types;           // surviving candidate type ids
     std::vector<uint32_t> mask;       // [K*W] accumulated requirement mask
     std::vector<uint8_t> has;         // [K]
+    std::vector<uint32_t> decl;       // [CW] hostname-anti classes declared
+    std::vector<uint32_t> match;      // [CW] hostname-anti classes matched
+    std::vector<int32_t> scnt;        // [C] spread-class matched-pod counts
 };
+
+// hostname anti-affinity conflict classes (mirrors ops/kernels.py:199-203):
+// a bin hosting pods MATCHED by class c excludes groups DECLARING c and
+// vice versa (the direct/inverse TopologyGroup pair, topology.go:225)
+inline bool anti_ok(const Bin& bin, const uint32_t* decl_g,
+                    const uint32_t* match_g, int CW) {
+    for (int w = 0; w < CW; ++w) {
+        if ((bin.match[w] & decl_g[w]) || (bin.decl[w] & match_g[w]))
+            return false;
+    }
+    return true;
+}
+
+// keep in sync with ops/tensorize.py SPREAD_OWNED_MIN / UNCAPPED
+constexpr int32_t SPREAD_UNCAPPED = 1 << 29;
+
+// hostname spread classes (mirrors ops/kernels.py bscnt): counts by
+// selector match, cap enforced for owner groups (topologygroup.go:167).
+// A self-selecting owner debits its own take; a non-self-selecting owner
+// never raises the count it is checked against, so the cap gates the bin
+// all-or-nothing (topology.py:200 'if self_selecting').
+inline int spread_cap(const Bin& bin, const int32_t* sown_g,
+                      const uint8_t* smatch_g, int C) {
+    int cap = 1 << 30;
+    for (int c = 0; c < C; ++c) {
+        if (sown_g[c] >= SPREAD_UNCAPPED) continue;
+        int rem = sown_g[c] - bin.scnt[c];
+        if (!smatch_g[c]) rem = rem > 0 ? (1 << 30) : 0;
+        cap = std::min(cap, rem > 0 ? rem : 0);
+    }
+    return cap;
+}
 
 inline bool masks_compatible(const uint32_t* a_mask, const uint8_t* a_has,
                              const uint32_t* b_mask, const uint8_t* b_has,
@@ -89,10 +124,13 @@ extern "C" {
 // used [B] u8, tmpl_out [B] i32, F_out [G*T] u8.
 int karpenter_solve(
     int G, int T, int K, int W, int R, int M, int O, int B, int Vz, int Vc,
+    int CW,
     const uint32_t* g_mask, const uint8_t* g_has, const float* g_demand,
     const int32_t* g_count, const uint8_t* g_zone_allowed,
     const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
     const int32_t* g_bin_cap, const uint8_t* g_single,
+    const uint32_t* g_decl, const uint32_t* g_match,
+    int C, const int32_t* g_sown, const uint8_t* g_smatch,
     const uint32_t* t_mask, const uint8_t* t_has, const float* t_alloc,
     const float* t_cap, const int32_t* t_tmpl,
     const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
@@ -158,6 +196,14 @@ int karpenter_solve(
         const uint8_t* Fg = F.data() + (size_t)g * T;
         const int cap_g = g_bin_cap[g] > 0 ? g_bin_cap[g] : (1 << 30);
         const bool single = g_single[g] != 0;
+        const uint32_t* decl_g = g_decl + (size_t)g * CW;
+        const uint32_t* match_g = g_match + (size_t)g * CW;
+        const int32_t* sown_g = g_sown + (size_t)g * C;
+        const uint8_t* smatch_g = g_smatch + (size_t)g * C;
+        int cap_own = 1 << 30;  // fresh-bin cap from owned spread classes
+        for (int c = 0; c < C; ++c)
+            if (sown_g[c] < SPREAD_UNCAPPED && smatch_g[c])
+                cap_own = std::min(cap_own, (int)sown_g[c]);
 
         // existing bins, emptiest first (scheduler.go:258)
         order.resize(bins.size());
@@ -172,6 +218,7 @@ int karpenter_solve(
             for (int bi : order) {
                 Bin& bin = bins[bi];
                 if (!tmpl_full[(size_t)g * M + bin.tmpl]) continue;
+                if (!anti_ok(bin, decl_g, match_g, CW)) continue;
                 if (!masks_compatible(bin.mask.data(), bin.has.data(), gm, gh, K, W))
                     continue;
                 int q = 0;
@@ -179,6 +226,7 @@ int karpenter_solve(
                     if (!Fg[t]) continue;
                     q = std::max(q, cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R));
                 }
+                q = std::min(q, spread_cap(bin, sown_g, smatch_g, C));
                 if (q > best_q) { best_q = q; best_bi = bi; }
             }
             order.clear();
@@ -188,6 +236,7 @@ int karpenter_solve(
             if (n <= 0) break;
             Bin& bin = bins[bi];
             if (!tmpl_full[(size_t)g * M + bin.tmpl]) continue;
+            if (!anti_ok(bin, decl_g, match_g, CW)) continue;
             if (!masks_compatible(bin.mask.data(), bin.has.data(), gm, gh, K, W))
                 continue;
             // capacity = max over surviving types still feasible for g
@@ -197,6 +246,7 @@ int karpenter_solve(
                 q = std::max(q, cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R));
             }
             q = std::min(q, cap_g);  // per-bin topology cap (waves)
+            q = std::min(q, spread_cap(bin, sown_g, smatch_g, C));
             if (q <= 0) continue;
             int take = std::min(q, n);
             n -= take;
@@ -216,6 +266,13 @@ int karpenter_solve(
             }
             bin.types.swap(kept);
             combine_masks(bin.mask, bin.has, gm, gh, K, W);
+            // conflict-class commit: the bin now hosts this group's pods
+            for (int w = 0; w < CW; ++w) {
+                bin.decl[w] |= decl_g[w];
+                bin.match[w] |= match_g[w];
+            }
+            for (int c = 0; c < C; ++c)
+                if (smatch_g[c]) bin.scnt[c] += take;
         }
 
         // new bins from the first (weight-ordered) feasible template.
@@ -254,9 +311,14 @@ int karpenter_solve(
             bin.mask.assign(m_mask + (size_t)m_star * K * W,
                             m_mask + (size_t)m_star * K * W + (size_t)K * W);
             bin.has.assign(m_has + (size_t)m_star * K, m_has + (size_t)m_star * K + K);
-            per_node = std::min(per_node, cap_g);
+            bin.decl.assign(decl_g, decl_g + CW);
+            bin.match.assign(match_g, match_g + CW);
+            per_node = std::min(per_node, std::min(cap_g, cap_own));
             int take = std::min(per_node, n);
             bin.npods = take;
+            bin.scnt.assign(C, 0);
+            for (int c = 0; c < C; ++c)
+                if (smatch_g[c]) bin.scnt[c] = take;
             for (int r = 0; r < R; ++r) bin.load[r] += take * d[r];
             // candidate types: template's, feasible for g, limit-ok, fits load
             std::vector<float> worst(R, 0.0f);

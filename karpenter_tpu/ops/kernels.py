@@ -25,6 +25,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from karpenter_tpu.ops.tensorize import SPREAD_OWNED_MIN, UNCAPPED
+
 _EPS = 1e-6
 _LEVEL_SEARCH_ITERS = 24  # supports levels up to ~16M pods per bin
 
@@ -139,6 +141,8 @@ def pack(
     g_single,  # [G] bool: whole group confined to one bin (hostname affinity)
     g_decl,  # [G,CW] u32: hostname-anti classes the group declares
     g_match,  # [G,CW] u32: hostname-anti classes whose selector matches it
+    g_sown,  # [G,C] i32: per-bin cap where the group owns the spread class
+    g_smatch,  # [G,C] bool: the spread class counts this group's pods
     # static catalog
     t_alloc,  # [T,R]
     t_cap,  # [T,R]
@@ -163,8 +167,12 @@ def pack(
     is conflict classes: a bin hosting pods MATCHED by class c excludes
     groups DECLARING c and vice versa (the direct/inverse TopologyGroup
     pair, topology.go:225); bins carry declared/matched class bitmask
-    state. Zone constraints ride the ordinary requirement masks as
-    zone-pinned subgroups and need nothing here.
+    state. Hostname SPREAD is per-bin class COUNTS (topologygroup.go:167
+    counts by selector match): every matched group's take increments
+    `bscnt[b,c]`, and a group OWNING class c only lands where
+    bscnt + take <= maxSkew — exact across co-owner groups and
+    unconstrained same-label groups. Zone constraints ride the ordinary
+    requirement masks as zone-pinned subgroups and need nothing here.
     """
     G, R = g_demand.shape
     T = t_alloc.shape[0]
@@ -173,6 +181,7 @@ def pack(
     t_is_m = t_tmpl[:, None] == jnp.arange(M)[None, :]  # [T,M]
 
     CW = g_decl.shape[1]
+    C = g_sown.shape[1]
     state = dict(
         used=jnp.zeros(B, dtype=bool),
         npods=jnp.zeros(B, dtype=jnp.int32),
@@ -184,10 +193,12 @@ def pack(
         rem=m_limits.astype(jnp.float32),
         bdecl=jnp.zeros((B, CW), dtype=jnp.uint32),
         bmatch=jnp.zeros((B, CW), dtype=jnp.uint32),
+        bscnt=jnp.zeros((B, C), dtype=jnp.int32),
     )
 
     def step(state, xs):
-        d, n, gm, gh, Fg, tfull, cap_g, single, decl_g, match_g = xs
+        (d, n, gm, gh, Fg, tfull, cap_g, single, decl_g, match_g,
+         sown_g, smatch_g) = xs
         has_pods = n > 0
 
         # ---- existing bins: compatibility ----
@@ -210,6 +221,22 @@ def pack(
         q = jnp.max(cap_bt, axis=-1)  # [B]
         q = jnp.where(compat_b, q, 0)
         q = jnp.minimum(q, cap_g)  # per-bin topology cap (waves)
+        # spread classes: an owner of class c lands only while the bin's
+        # matched count stays within the cap (topologygroup.go:167). A
+        # self-selecting owner debits its own take (each pod raises the
+        # count the next one sees); an owner whose selector does NOT match
+        # its own labels never moves the count, so the cap gates the bin
+        # as a whole (all-or-nothing) rather than the take
+        # (topology.py:200 'if self_selecting')
+        owned = sown_g < SPREAD_OWNED_MIN  # [C]
+        rem_cls = sown_g[None, :] - state["bscnt"]  # [B,C]
+        rem_eff = jnp.where(
+            smatch_g[None, :], rem_cls, jnp.where(rem_cls > 0, UNCAPPED, 0)
+        )
+        q_cls = jnp.min(
+            jnp.where(owned[None, :], rem_eff, UNCAPPED), axis=-1
+        )  # [B]
+        q = jnp.minimum(q, jnp.maximum(q_cls, 0))
 
         take = _level_fill(q, state["npods"], n)
         # single-bin group: everything lands on the single highest-capacity
@@ -236,7 +263,13 @@ def pack(
         # templates are pre-sorted by weight: first feasible wins
         m_star = jnp.argmax(feasible_m)
         any_m = jnp.any(feasible_m)
-        per_node = jnp.maximum(jnp.minimum(jnp.take(per_node_m, m_star), cap_g), 1)
+        # fresh bins start at class count 0, so the owned cap bounds
+        # per_node — only for self-selecting owners (non-self-selecting
+        # pods never raise the count they are checked against)
+        cap_own = jnp.min(jnp.where(owned & smatch_g, sown_g, UNCAPPED))
+        per_node = jnp.maximum(
+            jnp.minimum(jnp.take(per_node_m, m_star), jnp.minimum(cap_g, cap_own)), 1
+        )
 
         # worst-case capacity of a new bin (for limit accounting, below)
         worst = jnp.max(
@@ -299,6 +332,12 @@ def pack(
         landed = (upd | (sel & (pods_new > 0)))[:, None]
         bdecl3 = jnp.where(landed, state["bdecl"] | decl_g[None, :], state["bdecl"])
         bmatch3 = jnp.where(landed, state["bmatch"] | match_g[None, :], state["bmatch"])
+        # spread-class counts grow by the bin's total take for every class
+        # whose selector matches this group
+        total_take = take + pods_new  # [B] (pods_new already masked by sel)
+        bscnt3 = state["bscnt"] + total_take[:, None] * smatch_g[None, :].astype(
+            jnp.int32
+        )
 
         new_state = dict(
             used=used3,
@@ -311,11 +350,12 @@ def pack(
             rem=rem3,
             bdecl=bdecl3,
             bmatch=bmatch3,
+            bscnt=bscnt3,
         )
         return new_state, take + pods_new
 
     xs = (g_demand, g_count, g_mask, g_has, F, tmpl_full, g_bin_cap, g_single,
-          g_decl, g_match)
+          g_decl, g_match, g_sown, g_smatch)
     state, assign = jax.lax.scan(step, state, xs)
     return dict(
         assign=assign,  # [G,B] (scan stacks per-step [B] outputs)
@@ -339,9 +379,18 @@ def solve_step(args: dict, max_bins: int) -> dict:
     if "g_single" not in args:
         args["g_single"] = jnp.zeros(G, dtype=bool)
     if "g_decl" not in args:
-        args["g_decl"] = jnp.zeros((G, 1), dtype=jnp.uint32)
+        CW = args["g_match"].shape[1] if "g_match" in args else 1
+        args["g_decl"] = jnp.zeros((G, CW), dtype=jnp.uint32)
     if "g_match" not in args:
-        args["g_match"] = jnp.zeros((G, 1), dtype=jnp.uint32)
+        args["g_match"] = jnp.zeros((G, args["g_decl"].shape[1]), dtype=jnp.uint32)
+    # g_sown/g_smatch (and g_decl/g_match) are width-paired: default each
+    # from its partner's shape so a caller supplying only one cannot
+    # produce mismatched class axes
+    if "g_sown" not in args:
+        C = args["g_smatch"].shape[1] if "g_smatch" in args else 1
+        args["g_sown"] = jnp.full((G, C), UNCAPPED, dtype=jnp.int32)
+    if "g_smatch" not in args:
+        args["g_smatch"] = jnp.zeros((G, args["g_sown"].shape[1]), dtype=bool)
     F, price, tmpl_full = feasibility(
         args["g_mask"], args["g_has"], args["g_demand"],
         args["t_mask"], args["t_has"], args["t_alloc"],
@@ -352,6 +401,7 @@ def solve_step(args: dict, max_bins: int) -> dict:
     out = pack(
         args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,
         args["g_bin_cap"], args["g_single"], args["g_decl"], args["g_match"],
+        args["g_sown"], args["g_smatch"],
         args["t_alloc"], args["t_cap"], args["t_tmpl"], args["m_mask"], args["m_has"],
         args["m_overhead"], args["m_limits"], max_bins=max_bins,
     )

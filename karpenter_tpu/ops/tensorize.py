@@ -36,6 +36,13 @@ from karpenter_tpu.utils import resources as resutil
 
 WORD = 32
 
+# spread-class cap sentinel: caps at or above OWNED_MIN mean "this group does
+# not own the class" (waves writes UNCAPPED; the kernels test >= OWNED_MIN so
+# padding/rounding can never turn an uncapped row into a cap).
+# native/kernel.cpp mirrors these values — keep them in sync.
+UNCAPPED = 1 << 30
+SPREAD_OWNED_MIN = 1 << 29
+
 
 def _bits_for(n_values: int) -> int:
     return max(1, (n_values + WORD - 1) // WORD)
@@ -63,6 +70,9 @@ class DeviceSnapshot:
     g_single: np.ndarray  # [G] bool whole group confined to one bin (waves)
     g_decl: np.ndarray  # [G,CW] u32 hostname-anti classes the group declares
     g_match: np.ndarray  # [G,CW] u32 hostname-anti classes matching the group
+    g_sown: np.ndarray  # [G,C] i32 per-bin cap where the group owns the
+    # hostname-spread class, else UNCAPPED (waves spread classes)
+    g_smatch: np.ndarray  # [G,C] bool the class counts this group's pods
 
     # flattened (template, type) axis (T)
     type_refs: list  # [(template_idx, InstanceType)]
@@ -149,6 +159,105 @@ class DeviceSnapshot:
             ).reshape(len(self.type_refs), len(self.resources))
             self._cap64 = c
         return c
+
+
+@dataclass
+class ExistingSnapshot:
+    """Existing/in-flight nodes as pre-loaded kernel bins
+    (existingnode.go:40-120 compiled to tensors): fixed available capacity,
+    per-group admission (taints + STRICT label compatibility — a node's
+    labels are concrete, so a pod key the node doesn't define fails, unlike
+    the claim-side well-known allowance), and topology class state seeded
+    from the nodes' current pods."""
+
+    nodes: list  # ExistingNode, index-aligned with the E axis
+    e_avail: np.ndarray  # [E,R] f32 available minus remaining daemon reserve
+    ge_ok: np.ndarray  # [G,E] bool group may land on node
+    e_npods: np.ndarray  # [E] i32 current pod count (fill priority)
+    e_scnt: np.ndarray  # [E,C] i32 spread-class counts from current pods
+    e_decl: np.ndarray  # [E,CW] u32 anti classes declared by current pods
+    e_match: np.ndarray  # [E,CW] u32 anti classes matching current pods
+
+    @property
+    def E(self):
+        return len(self.nodes)
+
+
+def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
+    """Compile ExistingNode capacity into the kernel's pre-loaded-bin
+    tensors. `snap` supplies the interned vocabulary/resource axes;
+    `device_plan` (waves) supplies the conflict/spread class indices whose
+    per-node counts come from each TopologyGroup's hostname domain map."""
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.scheduling import Taints as TaintSet
+
+    E = len(existing_nodes)
+    G = snap.G
+    R = len(snap.resources)
+    K = len(snap.keys)
+    CW = snap.g_decl.shape[1]
+    C = snap.g_sown.shape[1]
+
+    e_avail = np.zeros((E, R), dtype=np.float32)
+    ge_ok = np.zeros((G, E), dtype=bool)
+    e_npods = np.zeros(E, dtype=np.int32)
+    e_scnt = np.zeros((E, C), dtype=np.int32)
+    e_decl = np.zeros((E, CW), dtype=np.uint32)
+    e_match = np.zeros((E, CW), dtype=np.uint32)
+
+    e_mask = np.zeros((E, K, snap.W), dtype=np.uint32)
+    e_has = np.zeros((E, K), dtype=bool)
+    for e, node in enumerate(existing_nodes):
+        avail = resutil.subtract(node.cached_available, node.requests)
+        for r, v in avail.items():
+            if r in snap.resources:
+                e_avail[e, snap.resources.index(r)] = max(v, 0.0)
+        e_mask[e], e_has[e], _ = snap.mask_set(node.requirements)
+        e_npods[e] = len(node.state_node.pods())
+        hostname = node.state_node.hostname
+        if device_plan is not None:
+            for c, pair in enumerate(device_plan.anti_tgs_by_class):
+                direct, inverse = pair
+                if direct.domains.get(hostname, 0) > 0:
+                    e_match[e, c // WORD] |= np.uint32(1 << (c % WORD))
+                if inverse is not None and inverse.domains.get(hostname, 0) > 0:
+                    e_decl[e, c // WORD] |= np.uint32(1 << (c % WORD))
+            for c, tg in enumerate(device_plan.spread_tgs_by_class):
+                e_scnt[e, c] = tg.domains.get(hostname, 0)
+
+    # strict requirement compatibility over the interned masks: every key
+    # the group requires must be defined on the node AND overlap. Values a
+    # node carries outside the vocabulary mask to zero, which is exact for
+    # IN (the pod's interned values genuinely differ) and conservative for
+    # complement operators (routes to the host loop).
+    for g in range(G):
+        gm, gh = snap.g_mask[g], snap.g_has[g]
+        # a key overlaps if ANY word overlaps; required keys must be defined
+        ov = ((e_mask & gm[None]) != 0).any(axis=2)  # [E,K]
+        ge_ok[g] = (~gh[None, :] | (e_has & ov)).all(axis=1)
+
+    for e, node in enumerate(existing_nodes):
+        taints = TaintSet(node.state_node.taints())
+        for g in range(G):
+            if not ge_ok[g, e]:
+                continue
+            rep = snap.groups[g][0]
+            if taints.tolerates(rep) is not None:
+                ge_ok[g, e] = False
+                continue
+            hreq = snap.group_reqs[g].get_req(wk.HOSTNAME_LABEL)
+            if hreq is not None and not hreq.has(node.state_node.hostname):
+                ge_ok[g, e] = False
+
+    return ExistingSnapshot(
+        nodes=list(existing_nodes),
+        e_avail=e_avail,
+        ge_ok=ge_ok,
+        e_npods=e_npods,
+        e_scnt=e_scnt,
+        e_decl=e_decl,
+        e_match=e_match,
+    )
 
 
 def pod_signature(pod) -> tuple:
@@ -485,6 +594,7 @@ def tensorize(
         g_bin_cap_list = [dg.bin_cap for dg in device_groups]
         g_single_list = [dg.single_bin for dg in device_groups]
         g_decl, g_match = device_plan.class_masks()
+        g_sown, g_smatch = device_plan.spread_tensors()
     else:
         # ---- group pods by signature, FFD order ----
         # the signature is cached on the pod object: the provisioner
@@ -503,6 +613,8 @@ def tensorize(
         g_single_list = [False] * len(groups)
         g_decl = np.zeros((len(groups), 1), dtype=np.uint32)
         g_match = np.zeros((len(groups), 1), dtype=np.uint32)
+        g_sown = np.full((len(groups), 1), UNCAPPED, dtype=np.int32)
+        g_smatch = np.zeros((len(groups), 1), dtype=bool)
     group_demand = [g[0].effective_requests() for g in groups]
 
     # ---- resource dimension union ----
@@ -610,6 +722,8 @@ def tensorize(
         g_single=g_single,
         g_decl=g_decl,
         g_match=g_match,
+        g_sown=g_sown,
+        g_smatch=g_smatch,
         templates=list(templates),
         m_mask=m_mask,
         m_has=m_has,

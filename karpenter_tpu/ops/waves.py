@@ -52,10 +52,10 @@ from karpenter_tpu.models.topology import (
     TYPE_SPREAD,
     Topology,
 )
+from karpenter_tpu.ops.tensorize import UNCAPPED
 from karpenter_tpu.scheduling import IN, Requirement, pod_requirements
 from karpenter_tpu.utils import resources as resutil
 
-UNCAPPED = 1 << 30
 WORD = 32
 
 
@@ -69,6 +69,9 @@ class DeviceGroup:
     single_bin: bool = False  # hostname affinity: whole group in one bin
     decl_classes: frozenset = frozenset()  # hostname-anti classes declared
     match_classes: frozenset = frozenset()  # hostname-anti classes matched
+    spread_caps: dict = field(default_factory=dict)  # owned spread class -> maxSkew
+    spread_matches: frozenset = frozenset()  # spread classes counting this group
+    zone_tail: bool = False  # scans after zone-spread owners
 
 
 @dataclass
@@ -76,6 +79,11 @@ class WavesPlan:
     device_groups: list
     host_pods: list
     n_classes: int = 0
+    n_spread_classes: int = 0
+    # per-class TopologyGroup refs so the existing-node tensorizer can seed
+    # per-node counts from the groups' domain maps (hostname-keyed)
+    anti_tgs_by_class: list = field(default_factory=list)  # (direct, inverse|None)
+    spread_tgs_by_class: list = field(default_factory=list)
 
     @property
     def device_pod_count(self):
@@ -93,6 +101,20 @@ class WavesPlan:
             for c in dg.match_classes:
                 match[g, c // WORD] |= np.uint32(1 << (c % WORD))
         return decl, match
+
+    def spread_tensors(self):
+        """(g_sown [G,C] i32 cap where owned else UNCAPPED,
+        g_smatch [G,C] bool) for the kernel's per-bin spread-class counts."""
+        G = len(self.device_groups)
+        C = max(1, self.n_spread_classes)
+        sown = np.full((G, C), UNCAPPED, dtype=np.int32)
+        smatch = np.zeros((G, C), dtype=bool)
+        for g, dg in enumerate(self.device_groups):
+            for c, cap in dg.spread_caps.items():
+                sown[g, c] = cap
+            for c in dg.spread_matches:
+                smatch[g, c] = True
+        return sown, smatch
 
 
 def _group_key(g0):
@@ -182,6 +204,30 @@ def compile_topology(groups: list, topology) -> WavesPlan:
         if tg.key != wk.HOSTNAME_LABEL
     ]
 
+    # spread groups count by SELECTOR MATCH, not ownership
+    # (topologygroup.go:167-217). Hostname spreads become SPREAD CLASSES:
+    # bins carry a per-class pod COUNT contributed by every matched group
+    # (owner or not), and a group OWNING class c may only land on a bin
+    # while count + take <= maxSkew — the exact per-domain accounting of
+    # the host engine, shared across co-owner groups and unconstrained
+    # same-label groups alike. Zone spreads keep the compile-time
+    # water-fill; matched non-owner groups are scanned AFTER the owners
+    # (zone_tail) so every owner placement is legal with the counts it saw.
+    spread_classes: dict = {}  # hostname-spread tg hash_key -> class index
+    for own in own_by_gid:
+        for tg in own:
+            if tg.type == TYPE_SPREAD and tg.key == wk.HOSTNAME_LABEL:
+                spread_classes.setdefault(tg.hash_key(), len(spread_classes))
+    spread_tgs = {
+        hk: tg for hk, tg in topology.topologies.items() if hk in spread_classes
+    }
+    zone_spread_tgs = [
+        tg
+        for tg in topology.topologies.values()
+        if tg.type == TYPE_SPREAD and tg.key == wk.TOPOLOGY_ZONE_LABEL
+        and any(tg in own for own in own_by_gid)
+    ]
+
     device_groups: list = []
     host_pods: list = []
     overlay: dict = {}  # id(tg) -> compile-local domain counts
@@ -193,12 +239,28 @@ def compile_topology(groups: list, topology) -> WavesPlan:
         if any(tg.selects(rep) for tg in zone_inverse):
             host_pods.extend(pods)
             continue
+        own_ids = {id(tg) for tg in own}
+        # matched by an in-batch zone spread it doesn't own: its landings
+        # shift the owner's domain counts, so it scans after the owners
+        # (its own zone choice is unconstrained, so the deferral is legal)
+        zone_tail = any(
+            id(tg) not in own_ids and tg.selects(rep) for tg in zone_spread_tgs
+        )
+        if zone_tail and any(
+            tg.type == TYPE_SPREAD and tg.key == wk.TOPOLOGY_ZONE_LABEL
+            for tg in own
+        ):
+            # owns one zone spread while matched by another: the compile-time
+            # water-fills would need each other's answers — host engine
+            host_pods.extend(pods)
+            continue
 
         extra_reqs: list = []
         bin_cap = UNCAPPED
         single_bin = False
         zone_split = None  # domain -> count
         decl: set = set()
+        spread_caps: dict = {}
         ok = True
 
         for tg in own:
@@ -226,7 +288,9 @@ def compile_topology(groups: list, topology) -> WavesPlan:
                     counts[d] = counts.get(d, 0) + add
                 zone_split = {d: c for d, c in zone_split.items() if c > 0}
             elif tg.type == TYPE_SPREAD and tg.key == wk.HOSTNAME_LABEL:
-                bin_cap = min(bin_cap, max(int(tg.max_skew), 1))
+                cls = spread_classes[tg.hash_key()]
+                cap = max(int(tg.max_skew), 1)
+                spread_caps[cls] = min(spread_caps.get(cls, cap), cap)
             elif tg.type == TYPE_ANTI_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
                 decl.add(anti_classes[tg.hash_key()])
             elif tg.type == TYPE_AFFINITY and tg.key == wk.TOPOLOGY_ZONE_LABEL:
@@ -277,6 +341,13 @@ def compile_topology(groups: list, topology) -> WavesPlan:
             # self-matching anti-affinity: at most one pod of the group per
             # bin, the classic one-replica-per-node shape
             bin_cap = 1
+        # spread classes counting this group's pods (selector match,
+        # topologygroup.go:167 — ownership not required; an owner whose own
+        # labels don't match its selector contributes nothing, exactly like
+        # the host count)
+        smatch = {
+            c for hk, c in spread_classes.items() if spread_tgs[hk].selects(rep)
+        }
 
         if zone_split:
             # zone-pinned subgroups; pods partitioned in order
@@ -293,6 +364,9 @@ def compile_topology(groups: list, topology) -> WavesPlan:
                         single_bin,
                         frozenset(decl),
                         frozenset(match),
+                        dict(spread_caps),
+                        frozenset(smatch),
+                        zone_tail,
                     )
                 )
         else:
@@ -300,7 +374,25 @@ def compile_topology(groups: list, topology) -> WavesPlan:
                 DeviceGroup(
                     list(pods), extra_reqs, bin_cap, single_bin,
                     frozenset(decl), frozenset(match),
+                    dict(spread_caps), frozenset(smatch), zone_tail,
                 )
             )
 
-    return WavesPlan(device_groups, host_pods, n_classes=len(anti_classes))
+    # zone-spread matched non-owners scan after the owners so each owner
+    # placement is legal with the counts it saw at compile time (the tail's
+    # own zone choice is unconstrained); FFD order preserved within parts
+    device_groups.sort(key=lambda dg: dg.zone_tail)
+    anti_by_class = [None] * len(anti_classes)
+    for hk, c in anti_classes.items():
+        anti_by_class[c] = (anti_tgs[hk], topology.inverse_topologies.get(hk))
+    spread_by_class = [None] * len(spread_classes)
+    for hk, c in spread_classes.items():
+        spread_by_class[c] = spread_tgs[hk]
+    return WavesPlan(
+        device_groups,
+        host_pods,
+        n_classes=len(anti_classes),
+        n_spread_classes=len(spread_classes),
+        anti_tgs_by_class=anti_by_class,
+        spread_tgs_by_class=spread_by_class,
+    )

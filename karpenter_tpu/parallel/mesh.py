@@ -72,15 +72,26 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
     G = np.asarray(args["g_count"]).shape[0]
     args.setdefault("g_bin_cap", np.full(G, 1 << 30, dtype=np.int32))
     args.setdefault("g_single", np.zeros(G, dtype=bool))
-    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single"):
+    args.setdefault("g_decl", np.zeros((G, 1), dtype=np.uint32))
+    args.setdefault("g_match", np.zeros((G, 1), dtype=np.uint32))
+    args.setdefault("g_sown", np.full((G, 1), 1 << 30, dtype=np.int32))
+    args.setdefault("g_smatch", np.zeros((G, 1), dtype=bool))
+    # padded group rows are inert everywhere: count 0 means they never take
+    # (a zero-filled g_sown row reads as cap 0, which only gates that row)
+    G_NAMES = ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed",
+               "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single",
+               "g_decl", "g_match", "g_sown", "g_smatch")
+    T_NAMES = ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl",
+               "off_zone", "off_ct", "off_avail", "off_price")
+    for name in G_NAMES:
         args[name] = _pad_to(np.asarray(args[name]), 0, n_data)
-    for name in ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail", "off_price"):
+    for name in T_NAMES:
         args[name] = _pad_to(np.asarray(args[name]), 0, n_model)
 
     placed = dict(args)
-    for name in ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single"):
+    for name in G_NAMES:
         placed[name] = shard(args[name], P(DATA_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
-    for name in ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail", "off_price"):
+    for name in T_NAMES:
         placed[name] = shard(args[name], P(MODEL_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
     for name in ("m_mask", "m_has", "m_overhead", "m_limits"):
         placed[name] = shard(args[name], P())

@@ -22,7 +22,7 @@ from karpenter_tpu.api.objects import (
     TopologySpreadConstraint,
 )
 from karpenter_tpu.cloudprovider.catalog import make_instance_type
-from karpenter_tpu.models import ClaimTemplate, HostSolver, TPUSolver
+from karpenter_tpu.models import ClaimTemplate, HostSolver, NativeSolver, TPUSolver
 from karpenter_tpu.models.topology import Topology
 
 GIB = 2**30
@@ -96,7 +96,21 @@ def anti(labels=None, key=wk.HOSTNAME_LABEL):
     )
 
 
-def solve_both(pods, domains=None):
+@pytest.fixture(params=["tpu", "native"])
+def solver_cls(request):
+    """Both device engines must enforce identical topology semantics:
+    the XLA kernel (ops/kernels.py) and the C++ fallback (native/kernel.cpp)
+    share the tensorize->kernel->decode pipeline."""
+    if request.param == "native":
+        from karpenter_tpu import native
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        return NativeSolver
+    return TPUSolver
+
+
+def solve_both(pods, domains=None, solver_cls=TPUSolver):
     pool = nodepool()
     its = {pool.name: catalog()}
     doms = domains or {wk.TOPOLOGY_ZONE_LABEL: set(ZONES)}
@@ -106,7 +120,7 @@ def solve_both(pods, domains=None):
         its,
         topology=Topology(domains={k: set(v) for k, v in doms.items()}, pods=pods),
     )
-    dev_solver = TPUSolver()
+    dev_solver = solver_cls()
     dev = dev_solver.solve(
         [p.clone() for p in pods],
         [ClaimTemplate(pool)],
@@ -126,118 +140,118 @@ def zone_skew(res):
 
 
 class TestDeviceZonalSpread:
-    def test_even_spread_on_device(self):
+    def test_even_spread_on_device(self, solver_cls):
         pods = make_pods(9, {"app": "web"}, topology_spread_constraints=[zone_spread()])
-        host, dev, s = solve_both(pods)
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert s.last_device_stats["device_pods"] == 9
         assert sorted(zone_skew(dev).values()) == sorted(zone_skew(host).values()) == [3, 3, 3]
 
-    def test_uneven_count_within_skew(self):
+    def test_uneven_count_within_skew(self, solver_cls):
         pods = make_pods(7, {"app": "web"}, topology_spread_constraints=[zone_spread()])
-        host, dev, s = solve_both(pods)
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         counts = zone_skew(dev)
         assert sum(counts.values()) == 7
         assert max(counts.values()) - min(counts.values()) <= 1
 
-    def test_spread_two_deployments_share_selector_counts(self):
+    def test_spread_two_deployments_share_selector_counts(self, solver_cls):
         # two groups (different cpu) sharing one spread selector: the
         # compiled counts must evolve sequentially across groups
         a = make_pods(4, {"app": "web"}, cpu=2.0, name_prefix="a",
                       topology_spread_constraints=[zone_spread()])
         b = make_pods(5, {"app": "web"}, cpu=1.0, name_prefix="b",
                       topology_spread_constraints=[zone_spread()])
-        host, dev, s = solve_both(a + b)
+        host, dev, s = solve_both(a + b, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         counts = zone_skew(dev)
         assert sum(counts.values()) == 9
         assert max(counts.values()) - min(counts.values()) <= 1
 
-    def test_node_count_parity(self):
+    def test_node_count_parity(self, solver_cls):
         pods = make_pods(30, {"app": "web"}, topology_spread_constraints=[zone_spread()])
-        host, dev, _ = solve_both(pods)
+        host, dev, _ = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert dev.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
 
 
 class TestDeviceHostnameSpread:
-    def test_one_pod_per_node(self):
+    def test_one_pod_per_node(self, solver_cls):
         pods = make_pods(5, {"app": "web"},
                          topology_spread_constraints=[hostname_spread(max_skew=1)])
-        host, dev, s = solve_both(pods)
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert s.last_device_stats["device_pods"] == 5
         assert dev.node_count() == host.node_count() == 5
         assert all(len(c.pods) == 1 for c in dev.new_claims)
 
-    def test_skew_two(self):
+    def test_skew_two(self, solver_cls):
         pods = make_pods(6, {"app": "web"},
                          topology_spread_constraints=[hostname_spread(max_skew=2)])
-        _, dev, _ = solve_both(pods)
+        _, dev, _ = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert all(len(c.pods) <= 2 for c in dev.new_claims)
 
 
 class TestDeviceAntiAffinity:
-    def test_hostname_one_per_node(self):
+    def test_hostname_one_per_node(self, solver_cls):
         pods = make_pods(5, {"app": "web"}, affinity=anti())
-        host, dev, s = solve_both(pods)
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert s.last_device_stats["device_pods"] == 5
         assert dev.node_count() == host.node_count() == 5
 
-    def test_anti_group_shares_nodes_with_others(self):
+    def test_anti_group_shares_nodes_with_others(self, solver_cls):
         # bins capped for the anti group can still host other pods
         anti_pods = make_pods(3, {"app": "web"}, name_prefix="x", affinity=anti())
         generic = make_pods(6, {"app": "other"}, name_prefix="g")
-        host, dev, _ = solve_both(anti_pods + generic)
+        host, dev, _ = solve_both(anti_pods + generic, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert dev.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
 
-    def test_zone_anti_affinity_routes_to_host(self):
+    def test_zone_anti_affinity_routes_to_host(self, solver_cls):
         # Schrödinger semantics (topology_test.go:1914) stay on the host
         pods = make_pods(5, {"app": "web"}, affinity=anti(key=wk.TOPOLOGY_ZONE_LABEL))
-        host, dev, s = solve_both(pods)
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
         assert s.last_device_stats.get("device_pods", 0) == 0
         assert dev.scheduled_pod_count() == host.scheduled_pod_count() == 1
         assert len(dev.pod_errors) == len(host.pod_errors) == 4
 
-    def test_cross_group_anti_routes_to_host(self):
+    def test_cross_group_anti_routes_to_host(self, solver_cls):
         guard = make_pods(1, {"app": "guard"}, name_prefix="gd",
                           affinity=anti({"app": "web"}, key=wk.TOPOLOGY_ZONE_LABEL))
         web = make_pods(3, {"app": "web"}, name_prefix="w")
-        host, dev, _ = solve_both(guard + web)
+        host, dev, _ = solve_both(guard + web, solver_cls=solver_cls)
         assert dev.scheduled_pod_count() == host.scheduled_pod_count()
         assert len(dev.pod_errors) == len(host.pod_errors)
 
 
 class TestDevicePodAffinity:
-    def test_zone_affinity_single_zone(self):
+    def test_zone_affinity_single_zone(self, solver_cls):
         pods = make_pods(6, {"app": "web"}, affinity=affinity())
-        host, dev, s = solve_both(pods)
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert s.last_device_stats["device_pods"] == 6
         assert len(zone_skew(dev)) == 1
 
-    def test_hostname_affinity_one_claim(self):
+    def test_hostname_affinity_one_claim(self, solver_cls):
         pods = make_pods(3, {"app": "web"}, affinity=affinity(key=wk.HOSTNAME_LABEL))
-        host, dev, s = solve_both(pods)
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         assert dev.node_count() == host.node_count() == 1
 
-    def test_affinity_to_other_group_routes_to_host(self):
+    def test_affinity_to_other_group_routes_to_host(self, solver_cls):
         target = make_pods(1, {"app": "db"}, name_prefix="t")[0]
         target.node_selector = {wk.TOPOLOGY_ZONE_LABEL: "zone-2"}
         followers = make_pods(3, {"app": "web"}, name_prefix="f",
                               affinity=affinity({"app": "db"}))
-        host, dev, _ = solve_both([target] + followers)
+        host, dev, _ = solve_both([target] + followers, solver_cls=solver_cls)
         assert dev.all_pods_scheduled() == host.all_pods_scheduled()
         assert dev.scheduled_pod_count() == host.scheduled_pod_count() == 4
 
 
 class TestDeviceCombined:
-    def test_config3_mix_mostly_on_device(self):
+    def test_config3_mix_mostly_on_device(self, solver_cls):
         """The BASELINE config-3 shape: zone spread + hostname anti +
         generic, one service per 50 pods — every constrained pod must run
         on the device path."""
@@ -247,7 +261,7 @@ class TestDeviceCombined:
         its = {p.name: cat for p in pools}
         topo = Topology(domains={wk.TOPOLOGY_ZONE_LABEL: {"zone-1", "zone-2", "zone-3"}},
                         pods=pods)
-        s = TPUSolver()
+        s = solver_cls()
         res = s.solve([p.clone() for p in pods], [ClaimTemplate(p) for p in pools], its,
                       topology=topo)
         assert res.all_pods_scheduled()
@@ -260,10 +274,103 @@ class TestDeviceCombined:
                               pods=pods))
         assert res.node_count() <= max(host.node_count() * 1.05, host.node_count() + 2)
 
-    def test_spread_skew_respected_on_device(self):
+    def test_spread_skew_respected_on_device(self, solver_cls):
         pods = make_pods(12, {"app": "web"},
                          topology_spread_constraints=[zone_spread(max_skew=2)])
-        _, dev, _ = solve_both(pods)
+        _, dev, _ = solve_both(pods, solver_cls=solver_cls)
         assert dev.all_pods_scheduled()
         counts = zone_skew(dev)
         assert max(counts.values()) - min(counts.values()) <= 2
+
+
+class TestSpreadClassAccounting:
+    """Hostname spread counts by SELECTOR MATCH, not ownership
+    (topologygroup.go:167-217): unconstrained same-label groups and
+    co-owner groups share the per-bin count the kernel enforces."""
+
+    def test_unconstrained_same_label_group_keeps_skew(self, solver_cls):
+        # the plain pod (higher cpu -> scans first) lands on its own bin and
+        # counts toward the spread selector; the maxSkew=1 owner group must
+        # then avoid that bin entirely instead of stacking a second matched
+        # pod onto it
+        plain = make_pods(1, {"app": "web"}, cpu=2.0, name_prefix="pl")
+        spread = make_pods(
+            3, {"app": "web"}, cpu=1.0, name_prefix="sp",
+            topology_spread_constraints=[hostname_spread(max_skew=1)],
+        )
+        host, dev, s = solve_both(plain + spread, solver_cls=solver_cls)
+        assert dev.all_pods_scheduled()
+        assert s.last_device_stats["host_pods"] == 0
+        for claim in dev.new_claims:
+            names = {p.metadata.name for p in claim.pods}
+            if any(n.startswith("sp") for n in names):
+                matched = [p for p in claim.pods
+                           if p.metadata.labels.get("app") == "web"]
+                assert len(matched) == 1, (
+                    f"owner bin holds {len(matched)} matched pods (maxSkew=1)"
+                )
+        assert host.all_pods_scheduled()
+
+    def test_co_owner_groups_share_the_cap(self, solver_cls):
+        # two deployments with the SAME constraint (same selector/key/skew)
+        # but different shapes: their counts share one class, so four pods
+        # need four distinct bins at maxSkew=1
+        a = make_pods(2, {"app": "web"}, cpu=2.0, name_prefix="a",
+                      topology_spread_constraints=[hostname_spread(max_skew=1)])
+        b = make_pods(2, {"app": "web"}, cpu=1.0, name_prefix="b",
+                      topology_spread_constraints=[hostname_spread(max_skew=1)])
+        host, dev, s = solve_both(a + b, solver_cls=solver_cls)
+        assert dev.all_pods_scheduled()
+        assert s.last_device_stats["host_pods"] == 0
+        assert all(len(c.pods) == 1 for c in dev.new_claims)
+        assert dev.node_count() == host.node_count() == 4
+
+    def test_matched_nonowner_after_owner_piles_legally(self, solver_cls):
+        # plain pods scanning AFTER the owner group may join owner bins —
+        # the constraint only gates owner placements (host parity)
+        spread = make_pods(
+            3, {"app": "web"}, cpu=2.0, name_prefix="sp",
+            topology_spread_constraints=[hostname_spread(max_skew=1)],
+        )
+        plain = make_pods(6, {"app": "web"}, cpu=1.0, name_prefix="pl")
+        host, dev, s = solve_both(spread + plain, solver_cls=solver_cls)
+        assert dev.all_pods_scheduled() and host.all_pods_scheduled()
+        # owner pods still one per bin
+        for claim in dev.new_claims:
+            sp = [p for p in claim.pods if p.metadata.name.startswith("sp")]
+            assert len(sp) <= 1
+
+    def test_zone_matched_nonowner_scans_after_owner(self, solver_cls):
+        # unconstrained same-label pods shift zone counts; the waves plan
+        # defers them so the owner's water-fill stays a legal trace
+        spread = make_pods(
+            6, {"app": "web"}, cpu=1.0, name_prefix="sp",
+            topology_spread_constraints=[zone_spread(max_skew=1)],
+        )
+        plain = make_pods(4, {"app": "web"}, cpu=2.0, name_prefix="pl")
+        host, dev, s = solve_both(spread + plain, solver_cls=solver_cls)
+        assert dev.all_pods_scheduled() and host.all_pods_scheduled()
+        assert s.last_device_stats["host_pods"] == 0
+        # owner pods spread evenly regardless of the plain group's zones
+        sp_zone = collections.Counter()
+        for claim in dev.new_claims:
+            zr = claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL)
+            for p in claim.pods:
+                if p.metadata.name.startswith("sp"):
+                    assert len(zr.values) == 1
+                    sp_zone[next(iter(zr.values))] += 1
+        assert sorted(sp_zone.values()) == [2, 2, 2]
+
+    def test_non_self_selecting_owner_is_uncapped(self, solver_cls):
+        # the constraint's selector does not match the owner's own labels:
+        # counts never move, so all pods co-locate exactly like the host
+        # engine (topology.py:200 'if self_selecting')
+        pods = make_pods(
+            8, {"app": "db"}, cpu=0.5, name_prefix="db",
+            topology_spread_constraints=[hostname_spread(max_skew=1,
+                                                         labels={"app": "web"})],
+        )
+        host, dev, s = solve_both(pods, solver_cls=solver_cls)
+        assert dev.all_pods_scheduled() and host.all_pods_scheduled()
+        assert s.last_device_stats["host_pods"] == 0
+        assert dev.node_count() == host.node_count() == 1
