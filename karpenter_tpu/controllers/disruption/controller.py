@@ -178,13 +178,26 @@ class DisruptionController:
             if method.reason == REASON_EMPTY and fc.reschedulable_pods:
                 return False  # no longer empty
         if cmd.replacements:
-            # re-simulate: the replacement types must still cover the need
-            # (validation.go:186: new sim's types ⊇ command's types)
+            # re-simulate: the fresh simulation must still produce no more
+            # claims than the command launches, and every instance type the
+            # command would launch must still be among the types the fresh
+            # simulation allows — a cheaper type that vanished (ICE'd,
+            # price change) during the validation TTL invalidates the
+            # command (validation.go:186: command types ⊆ fresh-sim types)
             sim = simulate_scheduling(
                 self.provisioner, self.cluster, self.store, list(cmd.candidates)
             )
             if not sim.all_pods_scheduled() or len(sim.new_claims) > len(cmd.replacements):
                 return False
+            fresh_types = {
+                it.name for claim in sim.new_claims for it in claim.instance_types
+            }
+            for claim in cmd.replacements:
+                claim.instance_types = [
+                    it for it in claim.instance_types if it.name in fresh_types
+                ]
+                if not claim.instance_types:
+                    return False
         return True
 
     # -- execution (controller.go executeCommand:188) --------------------

@@ -187,12 +187,17 @@ def compute_consolidation(ctx, candidates) -> Command | None:
 
 
 class MultiNodeConsolidation(Method):
-    """Binary search for the largest N where candidates[0..N] collapse into
-    ≤1 replacement (disruption/multinodeconsolidation.go:47-163)."""
+    """Largest N where candidates[0..N] collapse into ≤1 replacement
+    (disruption/multinodeconsolidation.go:47-163). The prefix search runs
+    as ONE batched device probe (ops/consolidate.py) — all N prefixes
+    evaluated in a single vmapped pack call — with the winner re-validated
+    by the full simulation; scenarios the probe can't express fall back to
+    the reference's sequential binary search."""
 
     reason = REASON_UNDERUTILIZED
     needs_validation = True
     is_consolidation = True
+    last_probe: str = ""  # "device" | "sequential" (observability + tests)
 
     def compute_command(self, candidates, budgets):
         cands = _consolidatable(candidates)
@@ -200,9 +205,52 @@ class MultiNodeConsolidation(Method):
         cands = within_budget(budgets, self.reason, cands)[:MULTI_NODE_CANDIDATE_CAP]
         if len(cands) < 2:
             return None
+
+        k = self._probe(cands)
+        if k is not None:
+            self.last_probe = "device"
+            # the probe is approximate in both directions (strict label
+            # compat under-estimates; no price filter over-estimates), so
+            # every answer is confirmed by the real simulation and a miss
+            # degenerates into the reference's binary search on the
+            # remaining range — never a silently skipped consolidation
+            if k < 2:
+                cmd = compute_consolidation(self.ctx, cands[:2])
+                if cmd is None or cmd.action == "no-op":
+                    return None  # probe confirmed: nothing consolidates
+                return self._binary_search(cands, hi=len(cands), lo=2, best=cmd)
+            cmd = compute_consolidation(self.ctx, cands[:k])
+            if cmd is not None and cmd.action != "no-op" and len(cmd.candidates) >= 2:
+                if k < len(cands):
+                    # one upward gallop step: if the probe truncated, resume
+                    # the search above k, seeded with the confirmed command
+                    up = compute_consolidation(self.ctx, cands[: k + 1])
+                    if up is not None and up.action != "no-op":
+                        return self._binary_search(
+                            cands, hi=len(cands), lo=k + 2, best=up
+                        )
+                return cmd
+            # the probe over-estimated (price filter / validation detail the
+            # kernel doesn't model): finish with the search below k
+            return self._binary_search(cands, hi=k - 1)
+        self.last_probe = "sequential"
+        return self._binary_search(cands, hi=len(cands))
+
+    def _probe(self, cands):
+        from karpenter_tpu.models.solver import TPUSolver
+        from karpenter_tpu.ops.consolidate import batched_feasible_prefix
+
+        if not isinstance(getattr(self.ctx.provisioner, "solver", None), TPUSolver):
+            return None
+        try:
+            return batched_feasible_prefix(
+                self.ctx.provisioner, self.ctx.cluster, self.ctx.store, cands
+            )
+        except Exception:
+            return None
+
+    def _binary_search(self, cands, hi, lo=1, best=None):
         # binary search on prefix length (multinodeconsolidation.go:111-163)
-        lo, hi = 1, len(cands)
-        best = None
         while lo <= hi:
             mid = (lo + hi) // 2
             cmd = compute_consolidation(self.ctx, cands[:mid])

@@ -102,6 +102,7 @@ def nodepool_ready(np) -> bool:
 
 class Provisioner:
     def __init__(self, store, cloud, solver=None, clock=None, batcher=None, recorder=None, cluster=None, registry=None):
+        from karpenter_tpu.utils.pretty import ChangeMonitor
         from karpenter_tpu.operator import metrics as m
         from karpenter_tpu.utils.clock import Clock
 
@@ -114,6 +115,7 @@ class Provisioner:
         # window (options.go:96-97); test environments inject a 0/0 batcher
         self.batcher = batcher or Batcher(self.clock)
         self.recorder = recorder
+        self._change_monitor = ChangeMonitor(clock=self.clock)
         self.cluster = cluster  # state plane (M4); optional
 
     # -- triggering (provisioning/controller.go:52-107) ------------------
@@ -185,24 +187,7 @@ class Provisioner:
             pods.extend(self.deleting_node_pods(state_nodes, pods))
             if not pods:
                 return None
-        nodepools = [np for np in self.store.list("nodepools") if nodepool_ready(np)]
-        templates, its_by_pool, overhead, limits = [], {}, {}, {}
-        domains: dict = {}
-        for np in nodepools:
-            its = self.cloud.get_instance_types(np)
-            if not its:
-                continue
-            template = ClaimTemplate(np)
-            templates.append(template)
-            its_by_pool[np.name] = its
-            self._collect_domains(domains, template, its)
-            overhead[np.name] = self._daemon_overhead(template)
-            if np.spec.limits:
-                in_use = self._nodepool_usage(np)
-                limits[np.name] = {
-                    r: v - in_use.get(r, 0.0)
-                    for r, v in resutil.parse_resources(np.spec.limits).items()
-                }
+        templates, its_by_pool, overhead, limits, domains = self.solver_inputs()
 
         # pods with unresolvable PVCs can't schedule: report and drop from
         # the batch (ValidatePersistentVolumeClaims, volumetopology.go:155)
@@ -244,6 +229,31 @@ class Provisioner:
         )
         results.truncate_instance_types()
         return results
+
+    def solver_inputs(self):
+        """Per-nodepool solver inputs: (templates, instance types by pool,
+        daemon overhead, remaining limits, topology domain universe) — the
+        NewScheduler assembly (scheduler.go:160-230), shared by the solve
+        path and the batched consolidation probe."""
+        nodepools = [np for np in self.store.list("nodepools") if nodepool_ready(np)]
+        templates, its_by_pool, overhead, limits = [], {}, {}, {}
+        domains: dict = {}
+        for np in nodepools:
+            its = self.cloud.get_instance_types(np)
+            if not its:
+                continue
+            template = ClaimTemplate(np)
+            templates.append(template)
+            its_by_pool[np.name] = its
+            self._collect_domains(domains, template, its)
+            overhead[np.name] = self._daemon_overhead(template)
+            if np.spec.limits:
+                in_use = self._nodepool_usage(np)
+                limits[np.name] = {
+                    r: v - in_use.get(r, 0.0)
+                    for r, v in resutil.parse_resources(np.spec.limits).items()
+                }
+        return templates, its_by_pool, overhead, limits, domains
 
     def _collect_domains(self, domains, template, instance_types):
         """Topology domain universe: values from instance-type requirements
@@ -347,8 +357,22 @@ class Provisioner:
             if pods and self.cluster is not None:
                 self.cluster.nominate(node.name)
         for pod_key, err in results.pod_errors.items():
-            if self.recorder is not None:
+            if self.recorder is not None and self._change_monitor.has_changed(
+                pod_key, err
+            ):
+                # emit-on-change (pretty.ChangeMonitor): a pod stuck with
+                # the SAME error re-solves every batch but reports once;
+                # a different error (or a day of stasis) reports again
                 self.recorder.publish(
                     "FailedScheduling", f"pod {pod_key} incompatible: {err}"
                 )
+        # pods that scheduled this round (onto new claims OR existing
+        # capacity) drop out of the monitor so a later relapse reports
+        # immediately
+        for claim in results.new_claims:
+            for p in claim.pods:
+                self._change_monitor.forget(p.key())
+        for node in results.existing_nodes:
+            for p in getattr(node, "scheduled_pods", None) or []:
+                self._change_monitor.forget(p.key())
         return created

@@ -13,7 +13,6 @@ once per bucket.
 
 from __future__ import annotations
 
-import math
 
 import numpy as np
 
@@ -21,7 +20,13 @@ from karpenter_tpu.api import labels as wk
 from karpenter_tpu.models.inflight import InFlightNodeClaim
 from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
 from karpenter_tpu.ops import tensorize
-from karpenter_tpu.ops.tensorize import SPREAD_OWNED_MIN, UNCAPPED, device_eligible
+from karpenter_tpu.ops.tensorize import (
+    SPREAD_OWNED_MIN,
+    UNCAPPED,
+    bucket as _bucket,
+    device_eligible,
+    pad_to,
+)
 from karpenter_tpu.utils import resources as resutil
 
 
@@ -58,8 +63,11 @@ class HostSolver(Solver):
         return sched.solve(pods)
 
 
-def _bucket(n: int, lo: int = 16) -> int:
-    return max(lo, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+# feasibility work (G*T*K*W mask cells) above which a multi-device mesh
+# earns its collective overhead; single-chip installs never shard
+SHARD_MIN_WORK = 1 << 21
 
 
 class TPUSolver(Solver):
@@ -67,6 +75,25 @@ class TPUSolver(Solver):
         self._compiled = {}
         self.host = HostSolver()
         self.last_device_stats: dict = {}
+        self._mesh = None
+        self._mesh_checked = False
+
+    def _maybe_mesh(self):
+        """The device mesh when >1 accelerator is attached (ICI on real
+        hardware, virtual devices under xla_force_host_platform_device_count
+        — parallel/mesh.py); None on single-chip installs."""
+        if not self._mesh_checked:
+            self._mesh_checked = True
+            try:
+                import jax
+
+                if len(jax.devices()) > 1:
+                    from karpenter_tpu.parallel import make_mesh
+
+                    self._mesh = make_mesh()
+            except Exception:
+                self._mesh = None
+        return self._mesh
 
     def _kernel(self, key):
         if key not in self._compiled:
@@ -94,10 +121,8 @@ class TPUSolver(Solver):
         max_bins: int | None = None,
         volume_topology=None,
     ) -> SchedulerResults:
-        # Existing-node scheduling joins the device path with M5; those
-        # snapshots route through the host loop.
         has_topology = bool(getattr(topology, "has_groups", topology is not None and not isinstance(topology, NullTopology)))
-        if existing_nodes or not templates:
+        if not templates:
             return self.host.solve(
                 pods,
                 templates,
@@ -108,6 +133,7 @@ class TPUSolver(Solver):
                 limits=limits,
                 volume_topology=volume_topology,
             )
+        existing_nodes = list(existing_nodes)
 
         # weight order decides which template a new bin opens from
         # (scheduler.go:267 tries templates in weight order)
@@ -139,6 +165,7 @@ class TPUSolver(Solver):
                     templates,
                     instance_types,
                     topology=topology,
+                    existing_nodes=existing_nodes,
                     daemon_overhead=daemon_overhead,
                     limits=limits,
                     volume_topology=volume_topology,
@@ -152,6 +179,7 @@ class TPUSolver(Solver):
                 limits=limits,
                 device_plan=plan,
             )
+            device_plan = plan
         else:
             eligible, rest = [], []
             for p in pods:
@@ -165,6 +193,7 @@ class TPUSolver(Solver):
                     pods,
                     templates,
                     instance_types,
+                    existing_nodes=existing_nodes,
                     daemon_overhead=daemon_overhead,
                     limits=limits,
                     volume_topology=volume_topology,
@@ -172,7 +201,14 @@ class TPUSolver(Solver):
             snap = tensorize(
                 eligible, templates, instance_types, daemon_overhead=daemon_overhead, limits=limits
             )
-        claims, retry, bins, exhausted = self._run_and_decode(snap, max_bins)
+            device_plan = None
+        esnap = None
+        if existing_nodes:
+            from karpenter_tpu.ops.tensorize import tensorize_existing
+
+            esnap = tensorize_existing(snap, existing_nodes, device_plan)
+        claims, retry, ecommits, bins, exhausted = self._run_and_decode(
+            snap, esnap, max_bins)
         # estimated bin axis ran dry with pods left over: double and re-run
         # on device (exact result, one more kernel dispatch) instead of
         # pushing thousands of leftovers through the host loop. Gates on the
@@ -181,15 +217,26 @@ class TPUSolver(Solver):
         # validation retries must not spin doubled re-runs.
         total = sum(len(g) for g in snap.groups)
         while retry and max_bins is None and exhausted and bins < min(total, 4096):
-            claims, retry, bins, exhausted = self._run_and_decode(
-                snap, min(2 * bins, 4096))
+            claims, retry, ecommits, bins, exhausted = self._run_and_decode(
+                snap, esnap, min(2 * bins, 4096))
         self.last_device_stats = dict(
             groups=snap.G,
             types=snap.T,
             device_pods=len(eligible) - len(retry),
             retry_pods=len(retry),
             host_pods=len(rest),
+            existing_pods=sum(len(e[1]) for e in ecommits),
         )
+        # commit device placements onto the existing nodes (deferred so a
+        # doubled re-run cannot double-apply); the host pass then sees the
+        # updated availability/requirements (existingnode.go Add:64)
+        for node, node_pods, delta, merged, gcounts in ecommits:
+            node.pods.extend(node_pods)
+            node.requests = resutil.merge(node.requests, delta)
+            node.requirements = merged
+            if has_topology:
+                for g, c in gcounts:
+                    topology.record_many(snap.groups[g][0], merged, c)
         if has_topology:
             # commit the FINAL claim set into the host topology engine once
             # (a doubled re-run discards its predecessor's claims, so decode
@@ -220,6 +267,7 @@ class TPUSolver(Solver):
                 templates,
                 instance_types,
                 topology=topology if has_topology else None,
+                existing_nodes=existing_nodes,
                 daemon_overhead=daemon_overhead,
                 limits=limits,
                 initial_claims=claims,
@@ -227,9 +275,11 @@ class TPUSolver(Solver):
             )
         for claim in claims:
             claim.finalize()
-        return SchedulerResults(new_claims=claims, existing_nodes=[], pod_errors={})
+        return SchedulerResults(
+            new_claims=claims, existing_nodes=existing_nodes, pod_errors={}
+        )
 
-    def _run_and_decode(self, snap, max_bins):
+    def _run_and_decode(self, snap, esnap, max_bins):
         G, T = snap.G, snap.T
         K, W = snap.g_mask.shape[1], snap.W
         R = len(snap.resources)
@@ -267,10 +317,7 @@ class TPUSolver(Solver):
             B = min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
         Gp, Tp, Bp = _bucket(G), _bucket(T), _bucket(B)
 
-        def pad(a, shape):
-            out = np.zeros(shape, dtype=a.dtype)
-            out[tuple(slice(0, s) for s in a.shape)] = a
-            return out
+        pad = pad_to
 
         args = dict(
             g_mask=pad(snap.g_mask, (Gp, K, W)),
@@ -293,8 +340,8 @@ class TPUSolver(Solver):
             t_alloc=pad(snap.t_alloc, (Tp, R)),
             t_cap=pad(snap.t_cap, (Tp, R)),
             t_tmpl=pad(snap.t_tmpl, (Tp,)),
-            off_zone=np.full((Tp, snap.off_zone.shape[1]), -1, dtype=np.int32),
-            off_ct=np.full((Tp, snap.off_ct.shape[1]), -1, dtype=np.int32),
+            off_zone=pad_to(snap.off_zone, (Tp, snap.off_zone.shape[1]), fill=-1),
+            off_ct=pad_to(snap.off_ct, (Tp, snap.off_ct.shape[1]), fill=-1),
             off_avail=pad(snap.off_avail, (Tp, snap.off_avail.shape[1])),
             off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
             m_mask=snap.m_mask,
@@ -302,12 +349,23 @@ class TPUSolver(Solver):
             m_overhead=snap.m_overhead,
             m_limits=snap.m_limits,
         )
-        args["off_zone"][:T] = snap.off_zone
-        args["off_ct"][:T] = snap.off_ct
-        # padded types must be infeasible: zero alloc fails fits (pods>=1)
+        # padded types must be infeasible: zero alloc fails fits (pods>=1),
+        # and their offerings carry the -1 "no domain" sentinel
+
+        E = esnap.E if esnap is not None else 0
+        Ep = _bucket(max(E, 1), lo=8)
+        if esnap is not None:
+            args.update(
+                e_avail=pad(esnap.e_avail, (Ep, R)),
+                ge_ok=pad(esnap.ge_ok, (Gp, Ep)),
+                e_npods=pad(esnap.e_npods, (Ep,)),
+                e_scnt=pad(esnap.e_scnt, (Ep, esnap.e_scnt.shape[1])),
+                e_decl=pad(esnap.e_decl, (Ep, esnap.e_decl.shape[1])),
+                e_match=pad(esnap.e_match, (Ep, esnap.e_match.shape[1])),
+            )
 
         key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], snap.g_decl.shape[1],
-               snap.g_sown.shape[1], Bp)
+               snap.g_sown.shape[1], Ep if esnap is not None else 0, Bp)
         host = self._invoke(args, key, Bp)
         assign = host["assign"][:G, :Bp]
         used = host["used"]
@@ -316,32 +374,92 @@ class TPUSolver(Solver):
         # matrix on the host: exact for single-group bins, a sound
         # prefilter for multi-group joint validation
         feas = host["F"][:G, :T]
+        assign_e = host["assign_e"][:G, :E] if esnap is not None else None
 
-        claims, retry = self._decode(snap, assign, used, feas, tmpl)
+        claims, retry, ecommits = self._decode(
+            snap, esnap, assign, assign_e, used, feas, tmpl)
         exhausted = bool(used[:B].all())
-        return claims, retry, B, exhausted
+        return claims, retry, ecommits, B, exhausted
 
     def _invoke(self, args, key, max_bins):
         """Run the compiled kernel; returns host numpy dict
-        (assign/used/tmpl/F). Overridden by NativeSolver."""
+        (assign/used/tmpl/F). Overridden by NativeSolver. Large snapshots
+        shard over the mesh (groups x types) when one is available."""
         import jax
 
-        out = self._kernel(key)(args)
+        mesh = self._maybe_mesh()
+        G, K, W = args["g_mask"].shape
+        T = args["t_mask"].shape[0]
+        if mesh is not None and G * T * K * W >= SHARD_MIN_WORK:
+            from karpenter_tpu.parallel import sharded_solve
+
+            out = sharded_solve(mesh, args, max_bins)
+        else:
+            out = self._kernel(key)(args)
         # one batched device→host fetch: over a tunneled chip each separate
         # pull pays a full round trip, which dominates these tiny arrays
-        return jax.device_get({k: out[k] for k in ("assign", "used", "tmpl", "F")})
+        return jax.device_get(
+            {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}
+        )
 
-    def _decode(self, snap, assign, used, feas, tmpl):
+    def _decode(self, snap, esnap, assign, assign_e, used, feas, tmpl):
         """Bins → InFlightNodeClaims, with host-side validation of each
         claim's joint instance-type set (the kernel approximates joint
-        offering feasibility by intersecting per-group feasibility)."""
+        offering feasibility by intersecting per-group feasibility).
+        Existing-node columns decode first (phase-A pods are the head of
+        each group) into deferred commit entries — validation is exact
+        host-side (requirement compat + float64 fit) and a failed node
+        routes its pods to retry without mutating the ExistingNode."""
         from karpenter_tpu.cloudprovider.types import satisfies_min_values
 
         cursors = [0] * snap.G
         claims = []
         retry = []
-        topology = NullTopology()
+        ecommits = []
         R = len(snap.resources)
+        # per-pod demand in float64 from the source dicts — the f32 kernel
+        # tensors are too coarse at memory-byte scale; shared by the
+        # existing-node and claim decodes
+        demand64 = np.array(
+            [[d.get(r, 0.0) for r in snap.resources] for d in snap.group_demand],
+            dtype=np.float64,
+        ).reshape(snap.G, R)
+        if esnap is not None and assign_e is not None:
+            for e in np.flatnonzero(assign_e.sum(axis=0) > 0):
+                node = esnap.nodes[int(e)]
+                counts = assign_e[:, e]
+                gidx = np.flatnonzero(counts)
+                merged = node.requirements.copy()
+                node_pods = []
+                gcounts = []
+                ok = True
+                for g in gidx:
+                    reqs = snap.group_reqs[int(g)]
+                    if merged.compatible(reqs) is not None:
+                        ok = False
+                        break
+                    merged.add(*reqs.values())
+                req_vec = counts[gidx].astype(np.float64) @ demand64[gidx]
+                delta = {
+                    r: float(v)
+                    for r, v in zip(snap.resources, req_vec.tolist())
+                    if v > 0
+                }
+                if ok:
+                    total = resutil.merge(node.requests, delta)
+                    ok = resutil.fits(total, node.cached_available)
+                for g in gidx:
+                    c = int(counts[g])
+                    taken = snap.groups[int(g)][cursors[int(g)] : cursors[int(g)] + c]
+                    cursors[int(g)] += c
+                    if ok:
+                        node_pods.extend(taken)
+                        gcounts.append((int(g), c))
+                    else:
+                        retry.extend(taken)
+                if ok:
+                    ecommits.append((node, node_pods, delta, merged, gcounts))
+        topology = NullTopology()
         # nodepool-limit accounting mirroring the kernel's (and the
         # reference's, scheduler.go:270-292): a bin's candidate types are
         # filtered to those whose worst-case capacity fits the remaining
@@ -350,12 +468,6 @@ class TPUSolver(Solver):
         # the kernel never would have opened, and the host pass then grows
         # the claim past the nodepool limit.
         rem_limits = snap.m_limits.astype(np.float64).copy()
-        # per-bin totals in one matmul, in float64 from the source demand
-        # dicts — the f32 kernel tensors are too coarse at memory-byte scale
-        demand64 = np.array(
-            [[d.get(r, 0.0) for r in snap.resources] for d in snap.group_demand],
-            dtype=np.float64,
-        ).reshape(snap.G, R)
         Bax = assign.shape[1]
         cols = np.flatnonzero(used[:Bax] & (assign.sum(axis=0) > 0))
         breq = assign[:, cols].T.astype(np.float64) @ demand64
@@ -489,7 +601,7 @@ class TPUSolver(Solver):
         # unconsumed remainder of each group)
         for g in range(snap.G):
             retry.extend(snap.groups[g][cursors[g] :])
-        return claims, retry
+        return claims, retry, ecommits
 
 
 class NativeSolver(TPUSolver):

@@ -79,10 +79,11 @@ def load():
             + [_u32p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p, _u8p,
                _u32p, _u32p]                                      # group side
             + [ctypes.c_int, _i32p, _u8p]                         # spread classes
+            + [ctypes.c_int, _f32p, _u8p, _i32p, _i32p, _u32p, _u32p]  # existing nodes
             + [_u32p, _u8p, _f32p, _f32p, _i32p]                  # type side
             + [_i32p, _i32p, _u8p]                                # offerings
             + [_u32p, _u8p, _f32p, _f32p]                         # templates
-            + [_i32p, _u8p, _i32p, _u8p]                          # outputs
+            + [_i32p, _i32p, _u8p, _i32p, _u8p]                   # outputs
         )
         _lib = lib
         return fn
@@ -133,8 +134,29 @@ def solve_step(args: dict, max_bins: int) -> dict:
     if g_smatch.shape != g_sown.shape:
         raise ValueError(f"g_sown/g_smatch shape mismatch: {g_sown.shape} vs {g_smatch.shape}")
     B = int(max_bins)
+    # existing-node tensors (default: one inert zero-capacity node)
+    e_avail = np.ascontiguousarray(
+        args.get("e_avail", np.zeros((1, R), dtype=np.float32)), dtype=np.float32
+    )
+    E = e_avail.shape[0]
+    ge_ok = np.ascontiguousarray(
+        args.get("ge_ok", np.zeros((G, E), dtype=np.uint8)), dtype=np.uint8
+    )
+    e_npods = np.ascontiguousarray(
+        args.get("e_npods", np.zeros(E, dtype=np.int32)), dtype=np.int32
+    )
+    e_scnt = np.ascontiguousarray(
+        args.get("e_scnt", np.zeros((E, C), dtype=np.int32)), dtype=np.int32
+    )
+    e_decl = np.ascontiguousarray(
+        args.get("e_decl", np.zeros((E, CW), dtype=np.uint32)), dtype=np.uint32
+    )
+    e_match = np.ascontiguousarray(
+        args.get("e_match", np.zeros((E, CW), dtype=np.uint32)), dtype=np.uint32
+    )
 
     assign = np.zeros((G, B), dtype=np.int32)
+    assign_e = np.zeros((G, E), dtype=np.int32)
     used = np.zeros(B, dtype=np.uint8)
     tmpl = np.zeros(B, dtype=np.int32)
     F = np.zeros((G, T), dtype=np.uint8)
@@ -155,6 +177,7 @@ def solve_step(args: dict, max_bins: int) -> dict:
         ),
         g_decl, g_match,
         C, g_sown, g_smatch,
+        E, e_avail, ge_ok, e_npods, e_scnt, e_decl, e_match,
         t_mask,
         np.ascontiguousarray(args["t_has"], dtype=np.uint8),
         np.ascontiguousarray(args["t_alloc"], dtype=np.float32),
@@ -167,12 +190,13 @@ def solve_step(args: dict, max_bins: int) -> dict:
         np.ascontiguousarray(args["m_has"], dtype=np.uint8),
         np.ascontiguousarray(args["m_overhead"], dtype=np.float32),
         np.ascontiguousarray(args["m_limits"], dtype=np.float32),
-        assign, used, tmpl, F,
+        assign, assign_e, used, tmpl, F,
     )
     if rc != 0:
         raise RuntimeError(f"native kernel failed: rc={rc}")
     return {
         "assign": assign,
+        "assign_e": assign_e,
         "used": used.astype(bool),
         "tmpl": tmpl,
         "F": F.astype(bool),

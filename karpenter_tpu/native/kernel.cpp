@@ -131,12 +131,16 @@ int karpenter_solve(
     const int32_t* g_bin_cap, const uint8_t* g_single,
     const uint32_t* g_decl, const uint32_t* g_match,
     int C, const int32_t* g_sown, const uint8_t* g_smatch,
+    int E, const float* e_avail, const uint8_t* ge_ok,
+    const int32_t* e_npods, const int32_t* e_scnt,
+    const uint32_t* e_decl, const uint32_t* e_match,
     const uint32_t* t_mask, const uint8_t* t_has, const float* t_alloc,
     const float* t_cap, const int32_t* t_tmpl,
     const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
     const uint32_t* m_mask, const uint8_t* m_has,
     const float* m_overhead, const float* m_limits,
-    int32_t* assign, uint8_t* used, int32_t* tmpl_out, uint8_t* F_out) {
+    int32_t* assign, int32_t* assign_e, uint8_t* used, int32_t* tmpl_out,
+    uint8_t* F_out) {
 
     // ---- feasibility: F[g,t] = requirement ∧ fit-one ∧ offering ----
     std::vector<uint8_t> F((size_t)G * T, 0);
@@ -186,6 +190,15 @@ int karpenter_solve(
     std::memset(used, 0, (size_t)B);
     std::memset(tmpl_out, 0, sizeof(int32_t) * (size_t)B);
 
+    // existing-node state (mirrors ops/kernels.py phase A): fixed capacity,
+    // evolving load + topology class state
+    std::vector<float> eload((size_t)E * R, 0.0f);
+    std::vector<int32_t> enp(e_npods, e_npods + E);
+    std::vector<int32_t> escnt(e_scnt, e_scnt + (size_t)E * C);
+    std::vector<uint32_t> edecl(e_decl, e_decl + (size_t)E * CW);
+    std::vector<uint32_t> ematch(e_match, e_match + (size_t)E * CW);
+    std::memset(assign_e, 0, sizeof(int32_t) * (size_t)G * E);
+
     std::vector<int> order;  // bin indices sorted by npods (emptiest first)
     for (int g = 0; g < G; ++g) {
         int n = g_count[g];
@@ -204,6 +217,46 @@ int karpenter_solve(
         for (int c = 0; c < C; ++c)
             if (sown_g[c] < SPREAD_UNCAPPED && smatch_g[c])
                 cap_own = std::min(cap_own, (int)sown_g[c]);
+
+        // phase A: existing nodes first (scheduler.go:250), emptiest-first;
+        // single-bin groups bootstrap fresh claims (device parity)
+        if (!single && E > 0) {
+            std::vector<int> eorder(E);
+            for (int i = 0; i < E; ++i) eorder[i] = i;
+            std::stable_sort(eorder.begin(), eorder.end(), [&](int a, int b) {
+                return enp[a] < enp[b];
+            });
+            for (int ei : eorder) {
+                if (n <= 0) break;
+                if (!ge_ok[(size_t)g * E + ei]) continue;
+                bool aok = true;
+                for (int w = 0; w < CW; ++w)
+                    if ((ematch[(size_t)ei * CW + w] & decl_g[w]) ||
+                        (edecl[(size_t)ei * CW + w] & match_g[w])) { aok = false; break; }
+                if (!aok) continue;
+                int scap = 1 << 30;
+                for (int c = 0; c < C; ++c) {
+                    if (g_sown[(size_t)g * C + c] >= SPREAD_UNCAPPED) continue;
+                    int rem = g_sown[(size_t)g * C + c] - escnt[(size_t)ei * C + c];
+                    if (!smatch_g[c]) rem = rem > 0 ? (1 << 30) : 0;
+                    scap = std::min(scap, rem > 0 ? rem : 0);
+                }
+                int q = cap_for(e_avail + (size_t)ei * R, eload.data() + (size_t)ei * R, d, R);
+                q = std::min(q, std::min(cap_g, scap));
+                if (q <= 0) continue;
+                int take = std::min(q, n);
+                n -= take;
+                assign_e[(size_t)g * E + ei] += take;
+                enp[ei] += take;
+                for (int r = 0; r < R; ++r) eload[(size_t)ei * R + r] += take * d[r];
+                for (int c = 0; c < C; ++c)
+                    if (smatch_g[c]) escnt[(size_t)ei * C + c] += take;
+                for (int w = 0; w < CW; ++w) {
+                    edecl[(size_t)ei * CW + w] |= decl_g[w];
+                    ematch[(size_t)ei * CW + w] |= match_g[w];
+                }
+            }
+        }
 
         // existing bins, emptiest first (scheduler.go:258)
         order.resize(bins.size());

@@ -1,0 +1,179 @@
+"""Batched device consolidation probe — the #2 kernel (SURVEY.md §2.6).
+
+The reference's MultiNodeConsolidation binary-searches prefix length over
+the disruption-cost-ordered candidates, each probe a full scheduling
+simulation (multinodeconsolidation.go:111-163) — log2(100) sequential
+solves. On a TPU the search becomes ONE batched counterfactual: vmap the
+pack kernel over all N prefixes at once. Prefix k's snapshot shares every
+tensor with the master except
+
+- ``g_count``: pending pods plus the reschedulable pods of candidates[:k]
+- ``e_avail``: the cluster's nodes with candidates[:k] zeroed out
+
+so the batch is two stacked arrays over a shared snapshot. ``max_bins=1``
+encodes the m→1 replacement rule (consolidation.go:164): a prefix whose
+pods don't fit into the surviving nodes plus ONE fresh claim simply leaves
+pods unassigned and is infeasible. The largest feasible prefix then gets
+the one real simulation (price filter, validation) — ≤2 device dispatches
+replacing the sequential ladder.
+
+The probe is a sound PREFILTER, not the decision: anything it cannot
+express (topology constraints, non-device-eligible pods, volume limits)
+returns None and the caller falls back to the sequential search; a probe
+hit is always re-validated by the full simulation before a command ships.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from karpenter_tpu.models.scheduler import NullTopology
+from karpenter_tpu.ops.tensorize import (
+    bucket as _bucket,
+    device_eligible,
+    pad_to as pad,
+    tensorize,
+    tensorize_existing,
+)
+
+
+@functools.lru_cache(maxsize=8)
+def _batched_kernel(max_bins: int):
+    import jax
+
+    from karpenter_tpu.ops import kernels
+
+    def probe(args):
+        out = kernels.solve_step(args, max_bins=max_bins)
+        placed = out["assign"].sum() + out["assign_e"].sum()
+        return placed, out["used"].sum()
+
+    # g_count and e_avail carry the batch axis; everything else broadcasts
+    def batched(varying, shared):
+        def one(v):
+            return probe({**shared, **v})
+
+        return jax.vmap(one)(varying)
+
+    return jax.jit(batched)
+
+
+def batched_feasible_prefix(provisioner, cluster, store, candidates):
+    """Largest k such that candidates[:k] consolidate into the remaining
+    cluster plus at most one fresh claim, decided in one device call.
+    Returns None when the probe cannot express the scenario (the caller
+    falls back to the sequential binary search)."""
+    try:
+        import jax  # noqa: F401
+    except Exception:
+        return None
+    from karpenter_tpu.utils import pod as pod_util
+
+    pending = [p for p in store.list("pods") if pod_util.is_provisionable(p)]
+    cand_pods = [list(c.reschedulable_pods) for c in candidates]
+    all_pods = pending + [p for ps in cand_pods for p in ps]
+    if not all_pods:
+        return None
+    if any(not device_eligible(p) for p in all_pods):
+        return None
+
+    templates, its_by_pool, overhead, limits, _domains = provisioner.solver_inputs()
+    if not templates:
+        return None
+
+    state_nodes = list(cluster.nodes())
+    enodes = provisioner._existing_nodes(state_nodes, NullTopology())
+    by_pid = {e.state_node.provider_id: i for i, e in enumerate(enodes)}
+    cand_cols = []
+    for c in candidates:
+        i = by_pid.get(c.provider_id)
+        if i is None:
+            return None  # candidate invisible to the probe: stay sequential
+        cand_cols.append(i)
+
+    snap = tensorize(
+        all_pods, templates, its_by_pool, daemon_overhead=overhead,
+        limits=limits or None,
+    )
+    if snap.G == 0:
+        return None
+    esnap = tensorize_existing(snap, enodes)
+
+    # per-group pod counts: pending base + per-candidate contributions.
+    # Row 0 is the PREFIX-0 BASELINE (pending pods only, every node alive):
+    # feasibility is judged on the INCREMENT over it, so a pending pod that
+    # cannot schedule anywhere (and would not block the sequential path,
+    # which only requires the candidates' pods to land —
+    # SimulationResults.all_pods_scheduled) does not poison every prefix.
+    gidx_of = {}
+    for g, pods_g in enumerate(snap.groups):
+        for p in pods_g:
+            gidx_of[p.uid] = g
+    G = snap.G
+    base = np.zeros(G, dtype=np.int32)
+    for p in pending:
+        base[gidx_of[p.uid]] += 1
+    N = len(candidates)
+    contrib = np.zeros((N, G), dtype=np.int32)
+    for j, ps in enumerate(cand_pods):
+        for p in ps:
+            contrib[j, gidx_of[p.uid]] += 1
+    g_count_k = np.concatenate(
+        [base[None, :], base[None, :] + np.cumsum(contrib, axis=0)], axis=0
+    )  # [N+1,G]: row 0 = baseline, row k = prefix k
+
+    E = esnap.E
+    e_avail_k = np.repeat(esnap.e_avail[None, :, :], N + 1, axis=0)  # [N+1,E,R]
+    for j in range(N):
+        for col in cand_cols[: j + 1]:
+            e_avail_k[j + 1, col, :] = 0.0
+
+    # shared args padded once; the batch axis buckets so XLA compiles per
+    # shape family, not per candidate count
+    Np = _bucket(N + 1, lo=4)
+    Gp, Ep = _bucket(G, lo=8), _bucket(E, lo=8)
+    Tp = _bucket(snap.T, lo=8)
+
+    R = len(snap.resources)
+    M = len(snap.templates)
+    shared = dict(
+        g_mask=pad(snap.g_mask, (Gp,) + snap.g_mask.shape[1:]),
+        g_has=pad(snap.g_has, (Gp,) + snap.g_has.shape[1:]),
+        g_demand=pad(snap.g_demand, (Gp, R)),
+        g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
+        g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
+        g_tmpl_ok=pad(snap.g_tmpl_ok, (Gp, M)),
+        ge_ok=pad(esnap.ge_ok, (Gp, Ep)),
+        e_npods=pad(esnap.e_npods, (Ep,)),
+        t_mask=pad(snap.t_mask, (Tp,) + snap.t_mask.shape[1:]),
+        t_has=pad(snap.t_has, (Tp,) + snap.t_has.shape[1:]),
+        t_alloc=pad(snap.t_alloc, (Tp, R)),
+        t_cap=pad(snap.t_cap, (Tp, R)),
+        t_tmpl=pad(snap.t_tmpl, (Tp,)),
+        off_zone=pad(snap.off_zone, (Tp, snap.off_zone.shape[1]), fill=-1),
+        off_ct=pad(snap.off_ct, (Tp, snap.off_ct.shape[1]), fill=-1),
+        off_avail=pad(snap.off_avail, (Tp, snap.off_avail.shape[1])),
+        off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
+        m_mask=snap.m_mask,
+        m_has=snap.m_has,
+        m_overhead=snap.m_overhead,
+        m_limits=snap.m_limits,
+    )
+    varying = dict(
+        g_count=pad(g_count_k, (Np, Gp)),
+        e_avail=pad(e_avail_k, (Np, Ep, R)),
+    )
+
+    placed, _used = _batched_kernel(1)(varying, shared)
+    placed = np.asarray(placed)[: N + 1]
+    need = g_count_k.sum(axis=1)
+    # prefix k feasible iff its displaced pods ALL land on top of whatever
+    # the baseline already achieves (baseline deficit = stuck pending pods)
+    deficit0 = int(need[0] - placed[0])
+    feasible = (need[1:] - placed[1:]) <= deficit0
+    ks = np.flatnonzero(feasible)
+    if ks.size == 0:
+        return 0
+    return int(ks[-1]) + 1

@@ -143,6 +143,13 @@ def pack(
     g_match,  # [G,CW] u32: hostname-anti classes whose selector matches it
     g_sown,  # [G,C] i32: per-bin cap where the group owns the spread class
     g_smatch,  # [G,C] bool: the spread class counts this group's pods
+    # existing/in-flight nodes as pre-loaded bins (existingnode.go:64)
+    ge_ok,  # [G,E] bool: group admissible on node (taints + strict labels)
+    e_avail,  # [E,R] f32: fixed available capacity (allocatable - usage)
+    e_npods,  # [E] i32: current pod count (fill priority)
+    e_scnt,  # [E,C] i32: spread-class counts from the nodes' current pods
+    e_decl,  # [E,CW] u32: anti classes declared by current pods
+    e_match,  # [E,CW] u32: anti classes matching current pods
     # static catalog
     t_alloc,  # [T,R]
     t_cap,  # [T,R]
@@ -153,6 +160,7 @@ def pack(
     m_limits,  # [M,R]
     *,
     max_bins: int,
+    with_existing: bool = True,
 ):
     """Grouped greedy pack. Returns dict with:
     assign [G,B] i32, used [B] bool, npods [B] i32, types [B,T] bool,
@@ -178,6 +186,7 @@ def pack(
     T = t_alloc.shape[0]
     M = m_overhead.shape[0]
     B = max_bins
+    E = e_avail.shape[0]
     t_is_m = t_tmpl[:, None] == jnp.arange(M)[None, :]  # [T,M]
 
     CW = g_decl.shape[1]
@@ -195,13 +204,60 @@ def pack(
         bmatch=jnp.zeros((B, CW), dtype=jnp.uint32),
         bscnt=jnp.zeros((B, C), dtype=jnp.int32),
     )
+    if with_existing:
+        state.update(
+            eload=jnp.zeros((E, R), dtype=jnp.float32),
+            enpods=e_npods.astype(jnp.int32),
+            escnt=e_scnt.astype(jnp.int32),
+            edecl=e_decl,
+            ematch=e_match,
+        )
 
     def step(state, xs):
         (d, n, gm, gh, Fg, tfull, cap_g, single, decl_g, match_g,
-         sown_g, smatch_g) = xs
+         sown_g, smatch_g, ge_g) = xs
         has_pods = n > 0
+        owned = sown_g < SPREAD_OWNED_MIN  # [C]
 
-        # ---- existing bins: compatibility ----
+        # ---- phase A: existing nodes first (scheduler.go:250) ----
+        # fixed capacity (no instance-type choice), admission precomputed
+        # host-side in ge_ok; anti/spread class state evolves like bins'.
+        # Structurally omitted (with_existing is a compile-time arg) when
+        # the snapshot has no existing nodes — the empty-cluster burst path
+        # pays nothing for steady-state support.
+        if with_existing:
+            avail_e = e_avail - state["eload"]  # [E,R]
+            ratio_e = jnp.where(
+                d[None, :] > 0, avail_e / jnp.maximum(d[None, :], _EPS), jnp.inf
+            )
+            q_e = jnp.floor(jnp.min(ratio_e, axis=-1) + _EPS).astype(jnp.int32)  # [E]
+            anti_e = jnp.all(
+                (state["ematch"] & decl_g[None, :]) == 0, axis=-1
+            ) & jnp.all((state["edecl"] & match_g[None, :]) == 0, axis=-1)
+            rem_e = sown_g[None, :] - state["escnt"]  # [E,C]
+            rem_e_eff = jnp.where(
+                smatch_g[None, :], rem_e, jnp.where(rem_e > 0, UNCAPPED, 0)
+            )
+            q_cls_e = jnp.min(jnp.where(owned[None, :], rem_e_eff, UNCAPPED), axis=-1)
+            q_e = jnp.where(ge_g & anti_e, q_e, 0)
+            q_e = jnp.minimum(jnp.minimum(q_e, cap_g), jnp.maximum(q_cls_e, 0))
+            # single-bin groups (hostname pod affinity) stay on the claim
+            # path: waves routes groups with existing matches to the host
+            # engine, so a device single group always bootstraps a fresh claim
+            q_e = jnp.where(single | ~has_pods, 0, q_e)
+            take_e = _level_fill(q_e, state["enpods"], n)
+            n = n - jnp.sum(take_e)
+
+            eload2 = state["eload"] + take_e[:, None].astype(jnp.float32) * d[None, :]
+            enpods2 = state["enpods"] + take_e
+            escnt2 = state["escnt"] + take_e[:, None] * smatch_g[None, :].astype(jnp.int32)
+            landed_e = (take_e > 0)[:, None]
+            edecl2 = jnp.where(landed_e, state["edecl"] | decl_g[None, :], state["edecl"])
+            ematch2 = jnp.where(landed_e, state["ematch"] | match_g[None, :], state["ematch"])
+        else:
+            take_e = jnp.zeros(E, dtype=jnp.int32)
+
+        # ---- phase B: open claim bins: compatibility ----
         both = state["bhas"] & gh[None, :]
         ov = jnp.any((state["bmask"] & gm[None, :, :]) != 0, axis=-1)
         compat_b = jnp.all(~both | ov, axis=-1)
@@ -228,7 +284,6 @@ def pack(
         # its own labels never moves the count, so the cap gates the bin
         # as a whole (all-or-nothing) rather than the take
         # (topology.py:200 'if self_selecting')
-        owned = sown_g < SPREAD_OWNED_MIN  # [C]
         rem_cls = sown_g[None, :] - state["bscnt"]  # [B,C]
         rem_eff = jnp.where(
             smatch_g[None, :], rem_cls, jnp.where(rem_cls > 0, UNCAPPED, 0)
@@ -352,13 +407,19 @@ def pack(
             bmatch=bmatch3,
             bscnt=bscnt3,
         )
-        return new_state, take + pods_new
+        if with_existing:
+            new_state.update(
+                eload=eload2, enpods=enpods2, escnt=escnt2,
+                edecl=edecl2, ematch=ematch2,
+            )
+        return new_state, (take + pods_new, take_e)
 
     xs = (g_demand, g_count, g_mask, g_has, F, tmpl_full, g_bin_cap, g_single,
-          g_decl, g_match, g_sown, g_smatch)
-    state, assign = jax.lax.scan(step, state, xs)
+          g_decl, g_match, g_sown, g_smatch, ge_ok)
+    state, (assign, assign_e) = jax.lax.scan(step, state, xs)
     return dict(
         assign=assign,  # [G,B] (scan stacks per-step [B] outputs)
+        assign_e=assign_e,  # [G,E] pods landed on existing nodes
         used=state["used"],
         npods=state["npods"],
         types=state["types"],
@@ -366,7 +427,7 @@ def pack(
     )
 
 
-def solve_step(args: dict, max_bins: int) -> dict:
+def solve_step(args: dict, max_bins: int, with_existing: bool | None = None) -> dict:
     """The full single-call solve: feasibility + pack over one snapshot's
     arg dict (the canonical invocation shared by the solver, the sharded
     path, and the graft entry)."""
@@ -391,6 +452,26 @@ def solve_step(args: dict, max_bins: int) -> dict:
         args["g_sown"] = jnp.full((G, C), UNCAPPED, dtype=jnp.int32)
     if "g_smatch" not in args:
         args["g_smatch"] = jnp.zeros((G, args["g_sown"].shape[1]), dtype=bool)
+    # existing-node tensors default to one inert node (zero capacity);
+    # when the caller supplied none, phase A is compiled out entirely
+    C = args["g_sown"].shape[1]
+    CW = args["g_decl"].shape[1]
+    if with_existing is None:
+        with_existing = "e_avail" in args
+    if "e_avail" not in args:
+        R = args["g_demand"].shape[1]
+        args["e_avail"] = jnp.zeros((1, R), dtype=jnp.float32)
+    E = args["e_avail"].shape[0]
+    if "ge_ok" not in args:
+        args["ge_ok"] = jnp.zeros((G, E), dtype=bool)
+    if "e_npods" not in args:
+        args["e_npods"] = jnp.zeros(E, dtype=jnp.int32)
+    if "e_scnt" not in args:
+        args["e_scnt"] = jnp.zeros((E, C), dtype=jnp.int32)
+    if "e_decl" not in args:
+        args["e_decl"] = jnp.zeros((E, CW), dtype=jnp.uint32)
+    if "e_match" not in args:
+        args["e_match"] = jnp.zeros((E, CW), dtype=jnp.uint32)
     F, price, tmpl_full = feasibility(
         args["g_mask"], args["g_has"], args["g_demand"],
         args["t_mask"], args["t_has"], args["t_alloc"],
@@ -402,8 +483,11 @@ def solve_step(args: dict, max_bins: int) -> dict:
         args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,
         args["g_bin_cap"], args["g_single"], args["g_decl"], args["g_match"],
         args["g_sown"], args["g_smatch"],
+        args["ge_ok"], args["e_avail"], args["e_npods"], args["e_scnt"],
+        args["e_decl"], args["e_match"],
         args["t_alloc"], args["t_cap"], args["t_tmpl"], args["m_mask"], args["m_has"],
         args["m_overhead"], args["m_limits"], max_bins=max_bins,
+        with_existing=with_existing,
     )
     out["F"] = F
     out["price"] = price

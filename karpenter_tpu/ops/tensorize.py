@@ -48,6 +48,22 @@ def _bits_for(n_values: int) -> int:
     return max(1, (n_values + WORD - 1) // WORD)
 
 
+def bucket(n: int, lo: int = 16) -> int:
+    """Next power-of-two shape bucket (>= lo) so XLA compiles one
+    executable per shape family — shared by the solver and the batched
+    consolidation probe so their compile caches agree."""
+    import math
+
+    return max(lo, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+def pad_to(a: np.ndarray, shape: tuple, fill=0) -> np.ndarray:
+    """Zero- (or fill-) pad `a` up to `shape` (prefix slices preserved)."""
+    out = np.full(shape, fill, dtype=a.dtype) if fill else np.zeros(shape, dtype=a.dtype)
+    out[tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
 @dataclass
 class DeviceSnapshot:
     # vocabularies
@@ -213,7 +229,7 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
             if r in snap.resources:
                 e_avail[e, snap.resources.index(r)] = max(v, 0.0)
         e_mask[e], e_has[e], _ = snap.mask_set(node.requirements)
-        e_npods[e] = len(node.state_node.pods())
+        e_npods[e] = len(node.state_node.pods)
         hostname = node.state_node.hostname
         if device_plan is not None:
             for c, pair in enumerate(device_plan.anti_tgs_by_class):
@@ -236,18 +252,33 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
         ov = ((e_mask & gm[None]) != 0).any(axis=2)  # [E,K]
         ge_ok[g] = (~gh[None, :] | (e_has & ov)).all(axis=1)
 
+    # taints + hostname checks: nodes share a handful of distinct taint
+    # profiles, so toleration is evaluated once per (profile, group), not
+    # per (node, group) — the E×G Python loop collapses to
+    # O(distinct-profiles × G) (a fleet of 1000 nodes typically has <5)
+    hreqs = [
+        snap.group_reqs[g].get_req(wk.HOSTNAME_LABEL)
+        if wk.HOSTNAME_LABEL in snap.group_reqs[g]
+        else None
+        for g in range(G)
+    ]
+    tol_cache: dict = {}  # taint fingerprint -> [G] bool tolerates
     for e, node in enumerate(existing_nodes):
-        taints = TaintSet(node.state_node.taints())
+        taints = node.state_node.taints()
+        fp = tuple((t.key, t.value, t.effect) for t in taints)
+        tol = tol_cache.get(fp)
+        if tol is None:
+            ts = TaintSet(taints)
+            tol = np.array(
+                [ts.tolerates(snap.groups[g][0]) is None for g in range(G)],
+                dtype=bool,
+            )
+            tol_cache[fp] = tol
+        ge_ok[:, e] &= tol
         for g in range(G):
-            if not ge_ok[g, e]:
-                continue
-            rep = snap.groups[g][0]
-            if taints.tolerates(rep) is not None:
-                ge_ok[g, e] = False
-                continue
-            hreq = snap.group_reqs[g].get_req(wk.HOSTNAME_LABEL)
-            if hreq is not None and not hreq.has(node.state_node.hostname):
-                ge_ok[g, e] = False
+            if hreqs[g] is not None and ge_ok[g, e]:
+                if not hreqs[g].has(node.state_node.hostname):
+                    ge_ok[g, e] = False
 
     return ExistingSnapshot(
         nodes=list(existing_nodes),

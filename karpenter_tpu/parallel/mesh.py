@@ -78,11 +78,18 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
     args.setdefault("g_smatch", np.zeros((G, 1), dtype=bool))
     # padded group rows are inert everywhere: count 0 means they never take
     # (a zero-filled g_sown row reads as cap 0, which only gates that row)
-    G_NAMES = ("g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed",
+    G_NAMES = ["g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed",
                "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single",
-               "g_decl", "g_match", "g_sown", "g_smatch")
-    T_NAMES = ("t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl",
-               "off_zone", "off_ct", "off_avail", "off_price")
+               "g_decl", "g_match", "g_sown", "g_smatch"]
+    T_NAMES = ["t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl",
+               "off_zone", "off_ct", "off_avail", "off_price"]
+    # existing-node tensors: ge_ok rides the group axis; the per-node state
+    # is scan-carried and stays replicated
+    REPL_NAMES = ["m_mask", "m_has", "m_overhead", "m_limits"]
+    if "ge_ok" in args:
+        G_NAMES.append("ge_ok")
+    REPL_NAMES += [k for k in ("e_avail", "e_npods", "e_scnt", "e_decl", "e_match")
+                   if k in args]
     for name in G_NAMES:
         args[name] = _pad_to(np.asarray(args[name]), 0, n_data)
     for name in T_NAMES:
@@ -93,8 +100,8 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
         placed[name] = shard(args[name], P(DATA_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
     for name in T_NAMES:
         placed[name] = shard(args[name], P(MODEL_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
-    for name in ("m_mask", "m_has", "m_overhead", "m_limits"):
-        placed[name] = shard(args[name], P())
+    for name in REPL_NAMES:
+        placed[name] = shard(np.asarray(args[name]), P())
 
     with mesh:
         return _jitted_solve_step(max_bins)(placed)

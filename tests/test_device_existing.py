@@ -1,0 +1,224 @@
+"""Existing-node scheduling on the device path (VERDICT r3 #3): existing
+and in-flight capacity rides the kernel as pre-loaded bins — phase A of the
+pack scan — instead of forcing the whole solve onto the host loop.
+
+Reference semantics: scheduler.go:250 (existing nodes tried before any
+claim), existingnode.go:64 (admission pipeline: taints → requirement
+compatibility → resource fit against cached availability).
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    Taint,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver, NativeSolver, TPUSolver
+from karpenter_tpu.models.existing import ExistingNode
+from karpenter_tpu.models.scheduler import NullTopology
+from karpenter_tpu.models.topology import Topology
+from karpenter_tpu.state.statenode import StateNode
+
+GIB = 2**30
+ZONES = ("zone-1", "zone-2", "zone-3")
+
+
+@pytest.fixture(params=["tpu", "native"])
+def solver_cls(request):
+    if request.param == "native":
+        from karpenter_tpu import native
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        return NativeSolver
+    return TPUSolver
+
+
+def nodepool(name="default"):
+    return NodePool(metadata=ObjectMeta(name=name))
+
+
+def catalog():
+    return [
+        make_instance_type("small", 4, 16, zones=ZONES),
+        make_instance_type("large", 32, 128, zones=ZONES),
+    ]
+
+
+def make_pods(n, labels=None, cpu=1.0, name_prefix="p", **kw):
+    return [
+        Pod(
+            metadata=ObjectMeta(name=f"{name_prefix}{i}", labels=dict(labels or {})),
+            requests={"cpu": cpu, "memory": 1 * GIB},
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+def state_node(name, cpu=8.0, mem_gib=32.0, zone="zone-1", taints=(), labels=None):
+    sn = StateNode(provider_id=f"pid-{name}")
+    node_labels = {
+        wk.NODEPOOL_LABEL: "default",
+        wk.TOPOLOGY_ZONE_LABEL: zone,
+        wk.INSTANCE_TYPE_LABEL: "large",
+        wk.CAPACITY_TYPE_LABEL: "on-demand",
+        wk.HOSTNAME_LABEL: name,
+    }
+    node_labels.update(labels or {})
+    node = Node(metadata=ObjectMeta(name=name, labels=node_labels))
+    node.allocatable = {"cpu": cpu, "memory": mem_gib * GIB, "pods": 110.0}
+    node.taints = list(taints)
+    sn.node = node
+    return sn
+
+
+def solve(cls, pods, enode_specs, topology=None):
+    pool = nodepool()
+    its = {pool.name: catalog()}
+    pods = [p.clone() for p in pods]
+    topo = topology if topology is not None else NullTopology()
+    enodes = [ExistingNode(sn, topo) for sn in enode_specs]
+    s = cls()
+    res = s.solve(pods, [ClaimTemplate(pool)], its, topology=topology,
+                  existing_nodes=enodes)
+    return res, enodes, s
+
+
+class TestExistingNodeDevice:
+    def test_existing_first_then_claims(self, solver_cls):
+        # 40 pods x 1cpu; two 8-cpu nodes absorb 16, the rest opens claims
+        pods = make_pods(40)
+        res, enodes, s = solve(solver_cls, pods, [state_node("n0"), state_node("n1")])
+        assert res.all_pods_scheduled()
+        assert sum(len(n.pods) for n in enodes) == 16
+        assert s.last_device_stats["existing_pods"] == 16
+        assert s.last_device_stats["device_pods"] == 40
+        host_res, host_nodes, _ = solve(HostSolver, pods,
+                                        [state_node("n0"), state_node("n1")])
+        assert res.node_count() == host_res.node_count()
+        assert sum(len(n.pods) for n in host_nodes) == 16
+
+    def test_all_pods_fit_existing(self, solver_cls):
+        pods = make_pods(8)
+        res, enodes, s = solve(solver_cls, pods, [state_node("n0")])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 0
+        assert len(enodes[0].pods) == 8
+        assert enodes[0].requests["cpu"] == pytest.approx(8.0)
+
+    def test_tainted_node_skipped(self, solver_cls):
+        tainted = state_node("n0", taints=[Taint("dedicated", "gpu", "NoSchedule")])
+        pods = make_pods(4)
+        res, enodes, s = solve(solver_cls, pods, [tainted])
+        assert res.all_pods_scheduled()
+        assert len(enodes[0].pods) == 0
+        assert res.node_count() == 1
+
+    def test_node_selector_respected(self, solver_cls):
+        # pod requires zone-2; only the zone-2 node may host it
+        z1 = state_node("n0", zone="zone-1")
+        z2 = state_node("n1", zone="zone-2")
+        pods = make_pods(4, node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"})
+        res, enodes, s = solve(solver_cls, pods, [z1, z2])
+        assert res.all_pods_scheduled()
+        assert len(enodes[0].pods) == 0
+        assert len(enodes[1].pods) == 4
+
+    def test_capacity_never_exceeded(self, solver_cls):
+        pods = make_pods(50, cpu=3.0)
+        res, enodes, s = solve(solver_cls, pods, [state_node("n0"), state_node("n1")])
+        assert res.all_pods_scheduled()
+        for n in enodes:
+            assert n.requests.get("cpu", 0.0) <= 8.0 + 1e-9
+
+    def test_daemon_reserve_respected(self, solver_cls):
+        # node reserves 6 cpu for a daemonset that hasn't landed: only 2
+        # of the 8 cpus remain for new pods
+        pool = nodepool()
+        its = {pool.name: catalog()}
+        topo = NullTopology()
+        enode = ExistingNode(state_node("n0"), topo,
+                             daemon_resources={"cpu": 6.0, "memory": 1 * GIB})
+        s = solver_cls()
+        res = s.solve([p.clone() for p in make_pods(4)], [ClaimTemplate(pool)], its,
+                      existing_nodes=[enode])
+        assert res.all_pods_scheduled()
+        assert len(enode.pods) <= 2
+
+    def test_spread_counts_seed_from_existing_pods(self, solver_cls):
+        # a node already holding 1 matched pod: maxSkew=1 owners must avoid
+        # it (the per-node class count seeds from the topology domain map)
+        resident = Pod(metadata=ObjectMeta(name="resident", labels={"app": "web"}),
+                       requests={"cpu": 1.0, "memory": 1 * GIB})
+        sn = state_node("n0")
+        sn.pods[resident.key()] = resident
+        spread = make_pods(
+            3, {"app": "web"}, name_prefix="sp",
+            topology_spread_constraints=[TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.HOSTNAME_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "web"}))],
+        )
+        topo = Topology(domains={wk.TOPOLOGY_ZONE_LABEL: set(ZONES)},
+                        pods=spread)
+        # seed the domain count the cluster informer would have recorded
+        for tg in topo.topologies.values():
+            tg.record("n0")
+        res, enodes, s = solve(solver_cls, spread, [sn], topology=topo)
+        assert res.all_pods_scheduled()
+        assert len(enodes[0].pods) == 0, "owner landed on a full domain"
+        assert res.node_count() == 3
+
+    def test_anti_affinity_avoids_declaring_node(self, solver_cls):
+        # a node hosting a pod that DECLARES anti-affinity against app=web:
+        # web pods must not land there (inverse group, topology.go:225)
+        guard = Pod(
+            metadata=ObjectMeta(name="guard", labels={"app": "guard"}),
+            requests={"cpu": 1.0, "memory": 1 * GIB},
+            affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                PodAffinityTerm(topology_key=wk.HOSTNAME_LABEL,
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "web"}))])),
+        )
+        sn = state_node("n0")
+        sn.pods[guard.key()] = guard
+        web = make_pods(2, {"app": "web"}, name_prefix="w",
+                        affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                            PodAffinityTerm(topology_key=wk.HOSTNAME_LABEL,
+                                            label_selector=LabelSelector(
+                                                match_labels={"app": "web"}))])))
+        topo = Topology(domains={wk.TOPOLOGY_ZONE_LABEL: set(ZONES)}, pods=web)
+        topo._update_inverse_anti_affinity(guard, {wk.HOSTNAME_LABEL: "n0"})
+        res, enodes, s = solve(solver_cls, web, [sn], topology=topo)
+        assert res.all_pods_scheduled()
+        assert len(enodes[0].pods) == 0, "web pod landed beside its declarer"
+
+    def test_parity_random_mix(self, solver_cls):
+        import random
+
+        rng = random.Random(7)
+        pods = []
+        for i in range(60):
+            cpu = rng.choice([0.25, 0.5, 1.0, 2.0])
+            pods.append(Pod(metadata=ObjectMeta(name=f"p{i}"),
+                            requests={"cpu": cpu, "memory": 1 * GIB}))
+        specs = lambda: [state_node(f"n{j}", cpu=8.0) for j in range(3)]
+        res, enodes, s = solve(solver_cls, pods, specs())
+        host_res, host_nodes, _ = solve(HostSolver, pods, specs())
+        assert res.all_pods_scheduled() and host_res.all_pods_scheduled()
+        dev_existing = sum(len(n.pods) for n in enodes)
+        host_existing = sum(len(n.pods) for n in host_nodes)
+        assert res.node_count() <= max(host_res.node_count() + 1,
+                                       int(host_res.node_count() * 1.05))
+        assert dev_existing >= host_existing - 2

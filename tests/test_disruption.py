@@ -287,3 +287,76 @@ class TestConditions:
         env.store.update("nodepools", np_)
         env.run_until_idle()
         assert not claim.is_true(COND_DRIFTED)
+
+
+class TestValidationTypeParity:
+    def test_vanished_cheaper_type_drops_command(self):
+        """A consolidation command whose replacement types all disappear
+        during the validation TTL must be dropped, not executed with stale
+        types (validation.go:186: command types ⊆ fresh-sim types)."""
+        small = make_instance_type("small", 2, 8)
+        large = make_instance_type("large", 16, 64)
+        env = Environment(instance_types=[small, large], enable_disruption=True)
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        env.create(
+            "nodepools",
+            nodepool(requirements=[NodeSelectorRequirement(
+                wk.CAPACITY_TYPE_LABEL, "In", [wk.CAPACITY_TYPE_ON_DEMAND])]),
+        )
+        big = deployment("big", 1, cpu=10.0)
+        env.create("deployments", big)
+        env.run_until_idle()
+        assert [n.labels[wk.INSTANCE_TYPE_LABEL] for n in live_nodes(env)] == ["large"]
+        # land a small pod on the existing large node, then retire the big
+        # workload: the node is underutilized but NOT empty, so the method
+        # must propose a replacement (not a bare delete)
+        env.create("deployments", deployment("small", 1, cpu=0.5))
+        env.run_until_idle()
+        big.replicas = 0
+        env.store.update("deployments", big)
+        for p in list(env.store.list("pods")):
+            if p.metadata.labels.get("app") == "big":
+                env.store.delete("pods", p)
+        # capture the pending validation command
+        d = env.disruption
+        rounds = 0
+        while d._pending is None and rounds < 50:
+            env.run_until_idle(max_rounds=1)
+            rounds += 1
+        assert d._pending is not None, "no command reached validation"
+        cmd = d._pending[0]
+        assert cmd.replacements, "expected a replacement command"
+        # the cheaper type ICEs during the TTL window
+        for off in small.offerings:
+            off.available = False
+        env.clock.step(d.validation_ttl + 1.0)
+        env.run_until_idle()
+        # command dropped: the large node survives, nothing replaced it
+        names = [n.labels[wk.INSTANCE_TYPE_LABEL] for n in live_nodes(env)]
+        assert names == ["large"], names
+
+    def test_surviving_type_intersection_executes(self):
+        """When the fresh simulation still offers the command's types the
+        command executes (the intersection is non-empty)."""
+        small = make_instance_type("small", 2, 8)
+        large = make_instance_type("large", 16, 64)
+        env = Environment(instance_types=[small, large], enable_disruption=True)
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        env.create(
+            "nodepools",
+            nodepool(requirements=[NodeSelectorRequirement(
+                wk.CAPACITY_TYPE_LABEL, "In", [wk.CAPACITY_TYPE_ON_DEMAND])]),
+        )
+        big = deployment("big", 1, cpu=10.0)
+        env.create("deployments", big)
+        env.run_until_idle()
+        big.replicas = 0
+        env.store.update("deployments", big)
+        for p in list(env.store.list("pods")):
+            if p.metadata.labels.get("app") == "big":
+                env.store.delete("pods", p)
+        env.create("deployments", deployment("small", 1, cpu=0.5))
+        env.run_until_idle()
+        assert [n.labels[wk.INSTANCE_TYPE_LABEL] for n in live_nodes(env)] == ["small"]

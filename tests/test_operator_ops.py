@@ -196,3 +196,42 @@ class TestWiring:
         # no nodepool: pod can't schedule; the provisioner publishes an event
         env.provision(Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0, "memory": GIB}))
         assert env.recorder.by_reason("FailedScheduling")
+
+
+class TestChangeMonitor:
+    def test_stable_error_reports_once_changed_reports_again(self):
+        """FailedScheduling chatter is emit-on-change (pretty.ChangeMonitor):
+        a pod stuck with the same error across batches reports once even
+        past the recorder's 90s dedupe; a different error reports anew."""
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils.pretty import ChangeMonitor
+
+        clock = FakeClock()
+        cm = ChangeMonitor(ttl=100.0, clock=clock)
+        assert cm.has_changed("pod-a", "no cpu")
+        assert not cm.has_changed("pod-a", "no cpu")
+        clock.step(95.0)  # inside TTL, same value: still suppressed
+        assert not cm.has_changed("pod-a", "no cpu")
+        assert cm.has_changed("pod-a", "no memory")  # change passes through
+        assert not cm.has_changed("pod-a", "no memory")
+        clock.step(101.0)  # TTL lapse re-reports the stable state
+        assert cm.has_changed("pod-a", "no memory")
+        cm.forget("pod-a")
+        assert cm.has_changed("pod-a", "no memory")
+
+    def test_provisioner_failed_scheduling_dedupe(self):
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+        from karpenter_tpu.operator import Environment
+
+        env = Environment(instance_types=[make_instance_type("small", 2, 8)])
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        # impossible pod: re-solved every round, must report once
+        env.create("pods", Pod(metadata=ObjectMeta(name="huge", namespace="default"),
+                               requests={"cpu": 512.0}))
+        for _ in range(3):
+            env.clock.step(120.0)  # past the recorder's own 90s window
+            env.run_until_idle(max_rounds=3)
+        evts = env.recorder.by_reason("FailedScheduling")
+        assert len(evts) == 1, [e.message for e in evts]
