@@ -83,6 +83,9 @@ class KubeStore:
 
     # -- core CRUD -------------------------------------------------------
     def create(self, kind: str, obj):
+        from karpenter_tpu.api.admission import admit
+
+        admit(kind, obj)  # webhook/CEL analog: reject illegal specs
         with self._lock:
             key = _key(kind, obj)
             if key in self._objects[kind]:
@@ -110,6 +113,9 @@ class KubeStore:
             return None
 
     def update(self, kind: str, obj):
+        from karpenter_tpu.api.admission import admit
+
+        admit(kind, obj)
         with self._lock:
             key = _key(kind, obj)
             if key not in self._objects[kind]:

@@ -69,14 +69,19 @@ class ClaimTemplate:
 class InFlightNodeClaim:
     """One hypothetical node being packed (scheduling/nodeclaim.go)."""
 
-    def __init__(self, template: ClaimTemplate, topology, daemon_resources: dict, instance_types):
+    def __init__(self, template: ClaimTemplate, topology, daemon_resources: dict, instance_types, requirements=None):
         self.template = template
         self.topology = topology
         self.daemon_resources = dict(daemon_resources or {})
         self.instance_types = list(instance_types)
         self.pods: list = []
         self.requests = dict(self.daemon_resources)
-        self.requirements = template.requirements.copy()
+        # `requirements` lets the device decoder hand over the bin's merged
+        # set directly (it already contains the template's), skipping a
+        # copy per decoded claim; the set is owned by the claim afterwards
+        self.requirements = (
+            template.requirements.copy() if requirements is None else requirements
+        )
         # nodes need hostnames for hostname-topology purposes; dropped at
         # finalize (scheduler.go FinalizeScheduling)
         self.hostname = f"hostname-{next(_hostname_counter)}"

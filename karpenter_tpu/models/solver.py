@@ -97,16 +97,27 @@ class TPUSolver(Solver):
 
     def _kernel(self, key):
         if key not in self._compiled:
-            import functools
-
             import jax
+            import jax.numpy as jnp
 
             from karpenter_tpu.ops import kernels
 
             max_bins = key[-1]
-            self._compiled[key] = jax.jit(
-                functools.partial(kernels.solve_step, max_bins=max_bins)
-            )
+
+            def packed(args):
+                # all outputs flattened into ONE int32 buffer: over a
+                # tunneled chip every separate device->host array pays a
+                # full round trip, which dominates these small tensors
+                out = kernels.solve_step(args, max_bins=max_bins)
+                return jnp.concatenate([
+                    out["assign"].ravel(),
+                    out["assign_e"].ravel(),
+                    out["used"].astype(jnp.int32),
+                    out["tmpl"],
+                    out["F"].astype(jnp.int32).ravel(),
+                ])
+
+            self._compiled[key] = jax.jit(packed)
         return self._compiled[key]
 
     def solve(
@@ -322,6 +333,7 @@ class TPUSolver(Solver):
         args = dict(
             g_mask=pad(snap.g_mask, (Gp, K, W)),
             g_has=pad(snap.g_has, (Gp, K)),
+            g_tol=pad(snap.g_tol, (Gp, K)),
             g_demand=pad(snap.g_demand, (Gp, R)),
             g_count=pad(snap.g_count, (Gp,)),
             g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
@@ -337,6 +349,7 @@ class TPUSolver(Solver):
             g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
             t_mask=pad(snap.t_mask, (Tp, K, W)),
             t_has=pad(snap.t_has, (Tp, K)),
+            t_tol=pad(snap.t_tol, (Tp, K)),
             t_alloc=pad(snap.t_alloc, (Tp, R)),
             t_cap=pad(snap.t_cap, (Tp, R)),
             t_tmpl=pad(snap.t_tmpl, (Tp,)),
@@ -346,6 +359,7 @@ class TPUSolver(Solver):
             off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
             m_mask=snap.m_mask,
             m_has=snap.m_has,
+            m_tol=snap.m_tol,
             m_overhead=snap.m_overhead,
             m_limits=snap.m_limits,
         )
@@ -384,7 +398,22 @@ class TPUSolver(Solver):
     def _invoke(self, args, key, max_bins):
         """Run the compiled kernel; returns host numpy dict
         (assign/used/tmpl/F). Overridden by NativeSolver. Large snapshots
-        shard over the mesh (groups x types) when one is available."""
+        shard over the mesh (groups x types) when one is available.
+
+        Set KARPENTER_PROFILE_DIR to capture a JAX profiler trace of each
+        kernel dispatch (the pprof analog, operator.go:174-183; view with
+        TensorBoard's profile plugin)."""
+        import os
+
+        import jax
+
+        profile_dir = os.environ.get("KARPENTER_PROFILE_DIR")
+        if profile_dir:
+            with jax.profiler.trace(profile_dir):
+                return self._invoke_inner(args, key, max_bins)
+        return self._invoke_inner(args, key, max_bins)
+
+    def _invoke_inner(self, args, key, max_bins):
         import jax
 
         mesh = self._maybe_mesh()
@@ -394,13 +423,77 @@ class TPUSolver(Solver):
             from karpenter_tpu.parallel import sharded_solve
 
             out = sharded_solve(mesh, args, max_bins)
-        else:
-            out = self._kernel(key)(args)
-        # one batched device→host fetch: over a tunneled chip each separate
-        # pull pays a full round trip, which dominates these tiny arrays
-        return jax.device_get(
-            {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}
-        )
+            return jax.device_get(
+                {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}
+            )
+        flat = np.asarray(self._kernel(key)(args))  # one device->host pull
+        B = max_bins
+        E = args["e_avail"].shape[0] if "e_avail" in args else 1
+        sizes = [G * B, G * E, B, B, G * T]
+        offs = np.cumsum([0] + sizes)
+        return {
+            "assign": flat[offs[0] : offs[1]].reshape(G, B),
+            "assign_e": flat[offs[1] : offs[2]].reshape(G, E),
+            "used": flat[offs[2] : offs[3]].astype(bool),
+            "tmpl": flat[offs[3] : offs[4]],
+            "F": flat[offs[4] : offs[5]].reshape(G, T).astype(bool),
+        }
+
+    def _compat_entry(self, snap, feas, m, gset, template):
+        """Distinct-(template, group-set) candidate types + precomputed fit
+        thresholds. Candidate types: AND of the device's per-group
+        feasibility rows — a sound PREFILTER, not the joint answer: F is
+        pairwise (group×type), so it misses three-way value intersections
+        (template ∩ pod ∩ type each pairwise-overlap but jointly empty) and
+        cross-offering splits. The host re-checks the MERGED requirement set
+        on every survivor — exact because the bitmask of the merged set IS
+        the value intersection over the interned vocabulary."""
+        bin_reqs = template.requirements.copy()
+        for g in gset:
+            bin_reqs.add(*snap.group_reqs[g].values())
+        joint = feas[gset[0]]
+        for g in gset[1:]:
+            joint = joint & feas[g]
+        tsel = np.flatnonzero(joint & (snap.t_tmpl == m))
+        if tsel.size:
+            mask_bin, has_bin, tol_bin = snap.mask_set(bin_reqs)
+            tm, th, tt = snap.t_mask[tsel], snap.t_has[tsel], snap.t_tol[tsel]
+            shared = th & has_bin[None, :]
+            overlap = ((tm & mask_bin[None, :, :]) != 0).any(axis=2)
+            # Intersects tolerates an empty meet iff BOTH operators are
+            # NotIn/DoesNotExist (requirements.py:249)
+            both_tol = tt & tol_bin[None, :]
+            req_ok = (~shared | overlap | both_tol).all(axis=1)
+            # offerings: available ∧ zone/capacity-type bit of the offering
+            # inside the bin's merged allowed sets (the per-offering joint
+            # check F cannot express)
+            off_ok = snap.off_avail[tsel].copy()
+            for label, off_idx in (
+                (wk.TOPOLOGY_ZONE_LABEL, snap.off_zone[tsel]),
+                (wk.CAPACITY_TYPE_LABEL, snap.off_ct[tsel]),
+            ):
+                k = snap.key_index.get(label)
+                if k is None or not has_bin[k]:
+                    continue
+                nv = len(snap.vocab[label])
+                if nv == 0:
+                    # key interned with zero values (e.g. a bare Exists):
+                    # offerings that define it cannot exist, ones that
+                    # don't (-1) are unconstrained
+                    continue
+                bits = np.arange(nv)
+                allowed = ((mask_bin[k, bits // 32] >> (bits % 32)) & 1).astype(bool)
+                off_ok &= np.where(off_idx >= 0, allowed[np.maximum(off_idx, 0)], True)
+            ok_rows = req_ok & off_ok.any(axis=1)
+            tsel = tsel[ok_rows]
+        objs = [snap.type_refs[int(t)][1] for t in tsel]
+        # allocatable/capacity rows over the snapshot resource axis with the
+        # fit tolerance pre-applied (resutil.fits' constants): the per-bin
+        # check reduces to one vectorized compare
+        alloc = snap.alloc64()[tsel]
+        alloc_thresh = alloc + resutil._EPS + resutil.FIT_REL_EPS * np.abs(alloc)
+        tcap = snap.cap64()[tsel]
+        return (bin_reqs, objs, alloc_thresh, tcap, tsel)
 
     def _decode(self, snap, esnap, assign, assign_e, used, feas, tmpl):
         """Bins → InFlightNodeClaims, with host-side validation of each
@@ -485,14 +578,18 @@ class TPUSolver(Solver):
         row_starts = np.searchsorted(nz_ci, np.arange(len(cols)))
         row_ends = np.append(row_starts[1:], len(nz_ci))
         tmpl_cols = tmpl[cols]
+        overhead_dicts = [
+            dict(zip(snap.resources, row.tolist())) for row in snap.m_overhead
+        ]
+        # pass 1: per-bin memberships + cache keys (cursor order is the
+        # column order; pods within a group are identical, so any
+        # consistent slicing is spec-equivalent)
+        bin_keys = []
+        bin_meta = []  # (m, bin_pods, gcounts)
+        key_rows: dict = {}  # key -> [ci...]
         for ci in range(len(cols)):
             m = int(tmpl_cols[ci])
-            template = snap.templates[m]
             bin_pods = []
-            req_vec = breq[ci]
-            requests = {
-                r: float(v) for r, v in zip(snap.resources, req_vec.tolist()) if v > 0
-            }
             gset = []
             gcounts = []
             for j in range(row_starts[ci], row_ends[ci]):
@@ -503,86 +600,70 @@ class TPUSolver(Solver):
                 bin_pods.extend(snap.groups[g][cursors[g] : cursors[g] + c])
                 cursors[g] += c
             key = (m, tuple(gset))
-            cached = compat_cache.get(key)
-            if cached is None:
-                bin_reqs = template.requirements.copy()
-                for g in gset:
-                    bin_reqs.add(*snap.group_reqs[g].values())
-                # candidate types: AND of the device's per-group feasibility
-                # rows — a sound PREFILTER, not the joint answer: F is
-                # pairwise (group×type), so it misses three-way value
-                # intersections (template ∩ pod ∩ type each pairwise-overlap
-                # but jointly empty) and cross-offering splits. The host
-                # re-checks the MERGED requirement set on every survivor —
-                # exact because the bitmask of the merged set IS the value
-                # intersection over the interned vocabulary (bench profile:
-                # the python per-type instance_type_compatible loop this
-                # replaces was the single largest decode cost).
-                joint = feas[gset[0]]
-                for g in gset[1:]:
-                    joint = joint & feas[g]
-                tsel = np.flatnonzero(joint & (snap.t_tmpl == m))
-                if tsel.size:
-                    mask_bin, has_bin, tol_bin = snap.mask_set(bin_reqs)
-                    tm, th, tt = snap.t_mask[tsel], snap.t_has[tsel], snap.t_tol[tsel]
-                    shared = th & has_bin[None, :]
-                    overlap = ((tm & mask_bin[None, :, :]) != 0).any(axis=2)
-                    # Intersects tolerates an empty meet iff BOTH operators
-                    # are NotIn/DoesNotExist (requirements.py:249)
-                    both_tol = tt & tol_bin[None, :]
-                    req_ok = (~shared | overlap | both_tol).all(axis=1)
-                    # offerings: available ∧ zone/capacity-type bit of the
-                    # offering inside the bin's merged allowed sets (the
-                    # per-offering joint check F cannot express)
-                    off_ok = snap.off_avail[tsel].copy()
-                    for label, off_idx in (
-                        (wk.TOPOLOGY_ZONE_LABEL, snap.off_zone[tsel]),
-                        (wk.CAPACITY_TYPE_LABEL, snap.off_ct[tsel]),
-                    ):
-                        k = snap.key_index.get(label)
-                        if k is None or not has_bin[k]:
-                            continue
-                        nv = len(snap.vocab[label])
-                        if nv == 0:
-                            # key interned with zero values (e.g. a bare
-                            # Exists): offerings that define it cannot exist,
-                            # ones that don't (-1) are unconstrained
-                            continue
-                        bits = np.arange(nv)
-                        allowed = ((mask_bin[k, bits // 32] >> (bits % 32)) & 1).astype(bool)
-                        off_ok &= np.where(off_idx >= 0, allowed[np.maximum(off_idx, 0)], True)
-                    ok_rows = req_ok & off_ok.any(axis=1)
-                    tsel = tsel[ok_rows]
-                candidates = [(int(t), snap.type_refs[int(t)][1]) for t in tsel]
-                # allocatable/capacity rows over the snapshot resource axis:
-                # the per-bin fit and limit checks become vectorized compares
-                alloc = snap.alloc64()[tsel]
-                tcap = snap.cap64()[tsel]
-                cached = (bin_reqs, candidates, alloc, tcap)
-                compat_cache[key] = cached
-            bin_reqs, compat, alloc, tcap = cached
-            # the vectorized form of resutil.fits' tolerance, same constants
-            ok = (
-                req_vec <= alloc + resutil._EPS + resutil.FIT_REL_EPS * np.abs(alloc)
-            ).all(axis=1)
-            ok &= (
-                tcap <= rem_limits[m] + resutil._EPS + resutil.FIT_REL_EPS * np.abs(rem_limits[m])
-            ).all(axis=1)
-            its = [it for (_, it), good in zip(compat, ok) if good]
+            bin_keys.append(key)
+            bin_meta.append((m, bin_pods, gcounts))
+            key_rows.setdefault(key, []).append(ci)
+
+        # pass 2: distinct-key candidate sets + BATCHED resource fit (one
+        # numpy reduction per key instead of two per bin); nodepool limits
+        # keep the sequential per-bin path since the debit evolves
+        no_limits = not np.isfinite(snap.m_limits).any()
+        fit_rows = [None] * len(cols)
+        its_rows = [None] * len(cols)
+        for key, rows in key_rows.items():
+            m, gset = key[0], list(key[1])
+            template = snap.templates[m]
+            cached = self._compat_entry(snap, feas, m, gset, template)
+            compat_cache[key] = cached
+            _, objs, alloc_thresh, _, _ = cached
+            rb = breq[rows]
+            if no_limits:
+                # clone bins (same key, same totals) share their candidate
+                # list outright: one fit reduction and one list build per
+                # DISTINCT demand vector, not per bin
+                ub, inv = np.unique(rb, axis=0, return_inverse=True)
+                ufits = (ub[:, None, :] <= alloc_thresh[None, :, :]).all(axis=2)
+                uits = [
+                    objs if row.all() else [objs[i] for i in np.flatnonzero(row)]
+                    for row in ufits
+                ]
+                for i, ci in enumerate(rows):
+                    fit_rows[ci] = ufits[inv[i]]
+                    its_rows[ci] = uits[inv[i]]
+            else:
+                fits = (rb[:, None, :] <= alloc_thresh[None, :, :]).all(axis=2)
+                for i, ci in enumerate(rows):
+                    fit_rows[ci] = fits[i]
+
+        for ci in range(len(cols)):
+            m, bin_pods, gcounts = bin_meta[ci]
+            template = snap.templates[m]
+            req_vec = breq[ci]
+            requests = {
+                r: float(v) for r, v in zip(snap.resources, req_vec.tolist()) if v > 0
+            }
+            bin_reqs, objs, _alloc_thresh, tcap, _ = compat_cache[bin_keys[ci]]
+            ok = fit_rows[ci]
+            if no_limits:
+                its = its_rows[ci]  # InFlightNodeClaim copies its input list
+            else:
+                ok = ok & (
+                    tcap <= rem_limits[m] + resutil._EPS
+                    + resutil.FIT_REL_EPS * np.abs(rem_limits[m])
+                ).all(axis=1)
+                its = [objs[i] for i in np.flatnonzero(ok)]
+            # bin_reqs already is template ∪ groups: hand the constructor a
+            # copy directly (it adds its own hostname row) instead of
+            # building the template set and re-intersecting per bin
             claim = InFlightNodeClaim(
                 template,
                 topology,
-                dict(zip(snap.resources, snap.m_overhead[m].tolist())),
+                overhead_dicts[m],
                 its,
+                requirements=bin_reqs.copy(),
             )
             claim.pods = bin_pods
             claim.requests = requests
-            # bin_reqs already is template ∪ groups: replace instead of
-            # re-intersecting ~K requirements per bin, keeping only the
-            # hostname row the constructor added
-            hostname_req = claim.requirements.get_req(wk.HOSTNAME_LABEL)
-            claim.requirements = bin_reqs.copy()
-            claim.requirements.add(hostname_req)
             remaining = claim.instance_types
             if remaining and claim.requirements.has_min_values():
                 _, err = satisfies_min_values(remaining, claim.requirements)
@@ -594,7 +675,8 @@ class TPUSolver(Solver):
             claim.instance_types = remaining
             # debit only once the claim survives validation — a bin dropped
             # to retry must not consume limit budget for later bins
-            rem_limits[m] -= tcap[ok].max(axis=0)
+            if not no_limits:
+                rem_limits[m] -= tcap[ok].max(axis=0)
             claim._gcounts = gcounts  # for the solver's topology commit
             claims.append(claim)
         # pods the kernel couldn't place (unsched counts are implied by the

@@ -76,13 +76,13 @@ def load():
         fn.restype = ctypes.c_int
         fn.argtypes = (
             [ctypes.c_int] * 11
-            + [_u32p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p, _u8p,
-               _u32p, _u32p]                                      # group side
+            + [_u32p, _u8p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p,
+               _u8p, _u32p, _u32p]                                # group side
             + [ctypes.c_int, _i32p, _u8p]                         # spread classes
             + [ctypes.c_int, _f32p, _u8p, _i32p, _i32p, _u32p, _u32p]  # existing nodes
-            + [_u32p, _u8p, _f32p, _f32p, _i32p]                  # type side
+            + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]            # type side
             + [_i32p, _i32p, _u8p]                                # offerings
-            + [_u32p, _u8p, _f32p, _f32p]                         # templates
+            + [_u32p, _u8p, _u8p, _f32p, _f32p]                   # templates
             + [_i32p, _i32p, _u8p, _i32p, _u8p]                   # outputs
         )
         _lib = lib
@@ -165,6 +165,9 @@ def solve_step(args: dict, max_bins: int) -> dict:
         G, T, K, W, R, M, O, B, gza.shape[1], gca.shape[1], CW,
         g_mask,
         np.ascontiguousarray(args["g_has"], dtype=np.uint8),
+        np.ascontiguousarray(
+            args.get("g_tol", np.zeros((G, K), dtype=np.uint8)), dtype=np.uint8
+        ),
         g_demand,
         np.ascontiguousarray(args["g_count"], dtype=np.int32),
         gza, gca,
@@ -180,6 +183,9 @@ def solve_step(args: dict, max_bins: int) -> dict:
         E, e_avail, ge_ok, e_npods, e_scnt, e_decl, e_match,
         t_mask,
         np.ascontiguousarray(args["t_has"], dtype=np.uint8),
+        np.ascontiguousarray(
+            args.get("t_tol", np.zeros((T, K), dtype=np.uint8)), dtype=np.uint8
+        ),
         np.ascontiguousarray(args["t_alloc"], dtype=np.float32),
         np.ascontiguousarray(args["t_cap"], dtype=np.float32),
         np.ascontiguousarray(args["t_tmpl"], dtype=np.int32),
@@ -188,6 +194,9 @@ def solve_step(args: dict, max_bins: int) -> dict:
         np.ascontiguousarray(args["off_avail"], dtype=np.uint8),
         m_mask,
         np.ascontiguousarray(args["m_has"], dtype=np.uint8),
+        np.ascontiguousarray(
+            args.get("m_tol", np.zeros((M, K), dtype=np.uint8)), dtype=np.uint8
+        ),
         np.ascontiguousarray(args["m_overhead"], dtype=np.float32),
         np.ascontiguousarray(args["m_limits"], dtype=np.float32),
         assign, assign_e, used, tmpl, F,

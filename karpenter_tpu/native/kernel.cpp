@@ -74,9 +74,15 @@ inline int spread_cap(const Bin& bin, const int32_t* sown_g,
 
 inline bool masks_compatible(const uint32_t* a_mask, const uint8_t* a_has,
                              const uint32_t* b_mask, const uint8_t* b_has,
-                             int K, int W) {
+                             int K, int W,
+                             // empty meet tolerated iff BOTH operators are
+                             // NotIn/DoesNotExist (requirements.py:249);
+                             // null = no tolerance (bin-accumulated masks)
+                             const uint8_t* a_tol = nullptr,
+                             const uint8_t* b_tol = nullptr) {
     for (int k = 0; k < K; ++k) {
         if (!a_has[k] || !b_has[k]) continue;
+        if (a_tol && b_tol && a_tol[k] && b_tol[k]) continue;
         const uint32_t* aw = a_mask + (size_t)k * W;
         const uint32_t* bw = b_mask + (size_t)k * W;
         bool overlap = false;
@@ -125,7 +131,8 @@ extern "C" {
 int karpenter_solve(
     int G, int T, int K, int W, int R, int M, int O, int B, int Vz, int Vc,
     int CW,
-    const uint32_t* g_mask, const uint8_t* g_has, const float* g_demand,
+    const uint32_t* g_mask, const uint8_t* g_has, const uint8_t* g_tol,
+    const float* g_demand,
     const int32_t* g_count, const uint8_t* g_zone_allowed,
     const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
     const int32_t* g_bin_cap, const uint8_t* g_single,
@@ -134,10 +141,11 @@ int karpenter_solve(
     int E, const float* e_avail, const uint8_t* ge_ok,
     const int32_t* e_npods, const int32_t* e_scnt,
     const uint32_t* e_decl, const uint32_t* e_match,
-    const uint32_t* t_mask, const uint8_t* t_has, const float* t_alloc,
+    const uint32_t* t_mask, const uint8_t* t_has, const uint8_t* t_tol,
+    const float* t_alloc,
     const float* t_cap, const int32_t* t_tmpl,
     const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
-    const uint32_t* m_mask, const uint8_t* m_has,
+    const uint32_t* m_mask, const uint8_t* m_has, const uint8_t* m_tol,
     const float* m_overhead, const float* m_limits,
     int32_t* assign, int32_t* assign_e, uint8_t* used, int32_t* tmpl_out,
     uint8_t* F_out) {
@@ -148,9 +156,11 @@ int karpenter_solve(
         const uint32_t* gm = g_mask + (size_t)g * K * W;
         const uint8_t* gh = g_has + (size_t)g * K;
         const float* d = g_demand + (size_t)g * R;
+        const uint8_t* gt = g_tol + (size_t)g * K;
         for (int t = 0; t < T; ++t) {
             if (!masks_compatible(gm, gh, t_mask + (size_t)t * K * W,
-                                  t_has + (size_t)t * K, K, W))
+                                  t_has + (size_t)t * K, K, W,
+                                  gt, t_tol + (size_t)t * K))
                 continue;
             if (cap_for(t_alloc + (size_t)t * R, nullptr, d, R) < 1) continue;
             bool off_ok = false;
@@ -176,7 +186,8 @@ int karpenter_solve(
         for (int m = 0; m < M; ++m) {
             if (!g_tmpl_ok[(size_t)g * M + m]) continue;
             if (masks_compatible(gm, gh, m_mask + (size_t)m * K * W,
-                                 m_has + (size_t)m * K, K, W))
+                                 m_has + (size_t)m * K, K, W,
+                                 g_tol + (size_t)g * K, m_tol + (size_t)m * K))
                 tmpl_full[(size_t)g * M + m] = 1;
         }
     }

@@ -144,27 +144,47 @@ class Environment:
             )
             self.controllers.append(self.disruption)
 
+    def _round(self, rng=None) -> bool:
+        """One reconcile round: informer-first event dispatch, then the
+        poll sources (provisioner, controllers, binder). `rng` randomizes
+        the poll ORDER (deflake mode); event dispatch stays informer-first
+        because state must mirror an event before any controller acts on
+        it (state/informer/*)."""
+        progressed = False
+        for event in self.store.drain_events():
+            self.cluster.on_event(event)
+            self.provisioner.on_event(event)
+            for c in self.controllers:
+                c.on_event(event)
+            progressed = True
+        sources = [self.provisioner.reconcile]
+        sources += [c.poll for c in self.controllers]
+        sources.append(self.binder.bind_pending)
+        if rng is not None:
+            rng.shuffle(sources)
+        for poll in sources:
+            if poll():
+                progressed = True
+        return progressed
+
     def run_until_idle(self, max_rounds: int = 100) -> int:
         """Drain events and reconcile until nothing changes; returns rounds."""
         rounds = 0
         for rounds in range(1, max_rounds + 1):
-            progressed = False
-            for event in self.store.drain_events():
-                # informer layer first: state must mirror the event before
-                # any controller acts on it (state/informer/*)
-                self.cluster.on_event(event)
-                self.provisioner.on_event(event)
-                for c in self.controllers:
-                    c.on_event(event)
-                progressed = True
-            if self.provisioner.reconcile():
-                progressed = True
-            for c in self.controllers:
-                if c.poll():
-                    progressed = True
-            if self.binder.bind_pending():
-                progressed = True
-            if not progressed:
+            if not self._round():
+                break
+        return rounds
+
+    def run_until_idle_shuffled(self, rng, max_rounds: int = 100) -> int:
+        """Deflake mode — the -race/flake-attempts analog (SURVEY.md §5):
+        the poll order is re-randomized every round, surfacing
+        order-dependent bugs the fixed reconcile order would mask. The
+        Go reference gets interleaving variance from the scheduler for
+        free; a single-threaded runtime has to inject it. Invariants must
+        hold under EVERY ordering (tests/test_deflake.py sweeps seeds)."""
+        rounds = 0
+        for rounds in range(1, max_rounds + 1):
+            if not self._round(rng=rng):
                 break
         return rounds
 

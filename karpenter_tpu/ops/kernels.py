@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from karpenter_tpu.ops.tensorize import SPREAD_OWNED_MIN, UNCAPPED
 
 _EPS = 1e-6
-_LEVEL_SEARCH_ITERS = 24  # supports levels up to ~16M pods per bin
+_LEVEL_SEARCH_ITERS = 20  # supports levels up to ~1M pods per bin
 
 
 def feasibility(
@@ -47,18 +47,30 @@ def feasibility(
     g_tmpl_ok,  # [G,M] bool (taints + custom-label definedness)
     m_mask,  # [M,K,W] u32
     m_has,  # [M,K] bool
+    g_tol=None,  # [G,K] bool NotIn/DoesNotExist operators
+    t_tol=None,  # [T,K] bool
+    m_tol=None,  # [M,K] bool
 ):
     """Returns (F [G,T] bool, price [G,T] f32, tmpl_full [G,M] bool)."""
     G, K, W = g_mask.shape
     T = t_mask.shape[0]
+    if g_tol is None:
+        g_tol = jnp.zeros((G, K), dtype=bool)
+    if t_tol is None:
+        t_tol = jnp.zeros((T, K), dtype=bool)
+    if m_tol is None:
+        m_tol = jnp.zeros((m_mask.shape[0], K), dtype=bool)
 
     # requirement overlap, key by key (K is small; the python loop unrolls
-    # into fused vector ops — no [G,T,K,W] intermediate is materialized)
+    # into fused vector ops — no [G,T,K,W] intermediate is materialized).
+    # An empty meet is tolerated iff BOTH operators are NotIn/DoesNotExist
+    # (requirements.py Intersects:249), matching the host engine exactly.
     compat = jnp.ones((G, T), dtype=bool)
     for k in range(K):
         ov = jnp.zeros((G, T), dtype=bool)
         for w in range(W):
             ov = ov | ((g_mask[:, None, k, w] & t_mask[None, :, k, w]) != 0)
+        ov = ov | (g_tol[:, None, k] & t_tol[None, :, k])
         both = g_has[:, None, k] & t_has[None, :, k]
         compat = compat & (~both | ov)
 
@@ -76,13 +88,15 @@ def feasibility(
 
     F = compat & fits & has_off
 
-    # template-level requirement overlap for new-bin placement
+    # template-level requirement overlap for new-bin placement (Compatible
+    # routes through Intersects, so the same tolerance applies)
     M = m_mask.shape[0]
     tm_ov = jnp.ones((G, M), dtype=bool)
     for k in range(K):
         ov = jnp.zeros((G, M), dtype=bool)
         for w in range(W):
             ov = ov | ((g_mask[:, None, k, w] & m_mask[None, :, k, w]) != 0)
+        ov = ov | (g_tol[:, None, k] & m_tol[None, :, k])
         both = g_has[:, None, k] & m_has[None, :, k]
         tm_ov = tm_ov & (~both | ov)
     tmpl_full = g_tmpl_ok & tm_ov
@@ -113,13 +127,14 @@ def _level_fill(q, npods, n):
     lo = jnp.int32(0)
     hi = jnp.int32(1) << _LEVEL_SEARCH_ITERS
 
-    def body(_, lohi):
-        lo, hi = lohi
+    # unrolled at trace time: a lax loop pays per-iteration dispatch
+    # overhead ~L times per scan step, which dominated the scan's device
+    # time; inlined, the search is pure dataflow XLA fuses freely
+    for _ in range(_LEVEL_SEARCH_ITERS):
         mid = (lo + hi) // 2
         enough = fill(mid) >= n_eff
-        return jnp.where(enough, lo, mid), jnp.where(enough, mid, hi)
-
-    lo, hi = jax.lax.fori_loop(0, _LEVEL_SEARCH_ITERS, body, (lo, hi))
+        lo = jnp.where(enough, lo, mid)
+        hi = jnp.where(enough, mid, hi)
     level = hi
     take = jnp.minimum(q, jnp.maximum(level - npods, 0))
     # overshoot: bins whose take reaches the final level can each give back 1
@@ -191,6 +206,9 @@ def pack(
 
     CW = g_decl.shape[1]
     C = g_sown.shape[1]
+    # static per-type check: template overhead fits the type's allocatable
+    # on EVERY dim (a group's d=0 dims never re-check it inside the scan)
+    ovh_ok = jnp.all(m_overhead[t_tmpl] <= t_alloc + _EPS, axis=-1)  # [T]
     state = dict(
         used=jnp.zeros(B, dtype=bool),
         npods=jnp.zeros(B, dtype=jnp.int32),
@@ -270,9 +288,15 @@ def pack(
         compat_b = compat_b & anti_ok
 
         # ---- per-bin capacity for this group (max over remaining types) ----
-        avail = t_alloc[None, :, :] - state["load"][:, None, :]  # [B,T,R]
-        ratio = jnp.where(d[None, None, :] > 0, avail / jnp.maximum(d[None, None, :], _EPS), jnp.inf)
-        cap_bt = jnp.floor(jnp.min(ratio, axis=-1) + _EPS).astype(jnp.int32)  # [B,T]
+        # (alloc - load)/d = alloc/d - load/d: hoisting the divisions to
+        # [T,R] and [B,R] turns the [B,T,R] inner op into subtract+min —
+        # the scan's dominant tensor, so op cost here is wall-clock
+        inv_d = jnp.where(d > 0, 1.0 / jnp.maximum(d, _EPS), 0.0)  # [R]
+        ad = jnp.where(d[None, :] > 0, t_alloc * inv_d[None, :], jnp.inf)  # [T,R]
+        ld = state["load"] * inv_d[None, :]  # [B,R] (0 where d=0)
+        cap_bt = jnp.floor(
+            jnp.min(ad[None, :, :] - ld[:, None, :], axis=-1) + _EPS
+        ).astype(jnp.int32)  # [B,T]
         cap_bt = jnp.where(state["types"] & Fg[None, :], jnp.maximum(cap_bt, 0), 0)
         q = jnp.max(cap_bt, axis=-1)  # [B]
         q = jnp.where(compat_b, q, 0)
@@ -310,7 +334,7 @@ def pack(
         fr = jnp.where(d[None, :] > 0, fresh_avail / jnp.maximum(d[None, :], _EPS), jnp.inf)
         fresh_cap = jnp.floor(jnp.min(fr, axis=-1) + _EPS).astype(jnp.int32)  # [T]
         limit_ok = jnp.all(t_cap <= state["rem"][t_tmpl] + _EPS, axis=-1)  # [T]
-        new_ok = Fg & limit_ok & jnp.take(tfull, t_tmpl) & (fresh_cap > 0)  # [T]
+        new_ok = Fg & limit_ok & jnp.take(tfull, t_tmpl) & (fresh_cap > 0) & ovh_ok  # [T]
         per_node_m = jnp.max(
             jnp.where(new_ok[:, None] & t_is_m, fresh_cap[:, None], 0), axis=0
         )  # [M]
@@ -355,7 +379,10 @@ def pack(
         upd = take > 0
         npods2 = state["npods"] + take
         load2 = state["load"] + take[:, None].astype(jnp.float32) * d[None, :]
-        fits_new = jnp.all(load2[:, None, :] <= t_alloc[None, :, :] + _EPS, axis=-1)  # [B,T]
+        # a surviving type still fits iff its capacity covered the take
+        # (d=0 dims are unchanged and held before), so cap_bt is reused
+        # instead of a second [B,T,R] reduction
+        fits_new = cap_bt >= take[:, None]  # [B,T]
         types2 = jnp.where(upd[:, None], state["types"] & Fg[None, :] & fits_new, state["types"])
         cm, ch = _combine_masks(state["bmask"], state["bhas"], gm[None, :, :], gh[None, :])
         bmask2 = jnp.where(upd[:, None, None], cm, state["bmask"])
@@ -363,10 +390,12 @@ def pack(
 
         # ---- commit: new bins ----
         new_load = m_overhead[m_star][None, :] + pods_new[:, None].astype(jnp.float32) * d[None, :]
+        # fresh_cap >= pods_new is the d>0 fit; ovh_ok (folded into new_ok)
+        # covers overhead-exceeds-alloc on undemanded dims — no [B,T,R] op
         new_types = (
             (t_tmpl[None, :] == m_star)
             & new_ok[None, :]
-            & jnp.all(new_load[:, None, :] <= t_alloc[None, :, :] + _EPS, axis=-1)
+            & (fresh_cap[None, :] >= pods_new[:, None])
         )
         # new bin requirements = template ∧ group (claim starts from template)
         nm, nh = _combine_masks(m_mask[m_star], m_has[m_star], gm, gh)
@@ -478,6 +507,8 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None) -> 
         args["g_zone_allowed"], args["g_ct_allowed"],
         args["off_zone"], args["off_ct"], args["off_avail"], args["off_price"],
         args["g_tmpl_ok"], args["m_mask"], args["m_has"],
+        g_tol=args.get("g_tol"), t_tol=args.get("t_tol"),
+        m_tol=args.get("m_tol"),
     )
     out = pack(
         args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,

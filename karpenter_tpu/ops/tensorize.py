@@ -81,6 +81,8 @@ class DeviceSnapshot:
     g_count: np.ndarray  # [G] i32
     g_mask: np.ndarray  # [G,K,W] u32
     g_has: np.ndarray  # [G,K] bool
+    g_tol: np.ndarray  # [G,K] bool operator NotIn/DoesNotExist (an empty
+    # meet with another such requirement is tolerated, requirements.py:249)
     g_tmpl_ok: np.ndarray  # [G,M] bool
     g_bin_cap: np.ndarray  # [G] i32 max pods of the group per bin (waves)
     g_single: np.ndarray  # [G] bool whole group confined to one bin (waves)
@@ -112,6 +114,7 @@ class DeviceSnapshot:
     templates: list
     m_mask: np.ndarray  # [M,K,W] u32
     m_has: np.ndarray  # [M,K] bool
+    m_tol: np.ndarray  # [M,K] bool (NotIn/DoesNotExist operators)
     m_overhead: np.ndarray  # [M,R] f32
     m_limits: np.ndarray  # [M,R] f32 (inf where unconstrained)
 
@@ -522,8 +525,12 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     # ---- templates ----
     m_mask = np.zeros((M, K, W), dtype=np.uint32)
     m_has = np.zeros((M, K), dtype=bool)
+    m_tol = np.zeros((M, K), dtype=bool)
     for m, tpl in enumerate(templates):
         m_mask[m], m_has[m] = build_mask_set(tpl.requirements)
+        for r in tpl.requirements.values():
+            if r.key in key_index:
+                m_tol[m, key_index[r.key]] = r.operator in (NOT_IN, DOES_NOT_EXIST)
 
     # ---- flattened (template, type) axis; pre-filter type vs template ----
     type_refs = []
@@ -573,7 +580,7 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     cached = dict(
         vocab=vocab, keys=keys, key_index=key_index, W=W,
         build_mask_set=build_mask_set,
-        m_mask=m_mask, m_has=m_has,
+        m_mask=m_mask, m_has=m_has, m_tol=m_tol,
         type_refs=type_refs, t_mask=t_mask, t_has=t_has, t_tol=t_tol,
         t_alloc=t_alloc, t_cap=t_cap, t_tmpl=t_tmpl,
         off_zone=off_zone, off_ct=off_ct, off_avail=off_avail,
@@ -665,7 +672,7 @@ def tensorize(
     G = len(groups)
 
     # ---- per-solve template tensors (overhead/limits change per round) ----
-    m_mask, m_has = ts["m_mask"], ts["m_has"]
+    m_mask, m_has, m_tol = ts["m_mask"], ts["m_has"], ts["m_tol"]
     m_overhead = np.zeros((M, len(resources)), dtype=np.float32)
     m_limits = np.full((M, len(resources)), np.inf, dtype=np.float32)
     for m, tpl in enumerate(templates):
@@ -686,6 +693,7 @@ def tensorize(
     g_count = np.zeros(G, dtype=np.int32)
     g_mask = np.zeros((G, K, W), dtype=np.uint32)
     g_has = np.zeros((G, K), dtype=bool)
+    g_tol = np.zeros((G, K), dtype=bool)
     g_tmpl_ok = np.zeros((G, M), dtype=bool)
     g_zone_allowed = np.ones((G, max(len(zone_vocab), 1)), dtype=bool)
     g_ct_allowed = np.ones((G, max(len(ct_vocab), 1)), dtype=bool)
@@ -697,6 +705,9 @@ def tensorize(
             g_demand[g, r_index[r]] = v
         g_count[g] = len(pods_g)
         g_mask[g], g_has[g] = build_mask_set(reqs)
+        for r in reqs.values():
+            if r.key in key_index:
+                g_tol[g, key_index[r.key]] = r.operator in (NOT_IN, DOES_NOT_EXIST)
         pod0 = pods_g[0]
         for m, tpl in enumerate(templates):
             ok = Taints(tpl.taints).tolerates(pod0) is None
@@ -735,6 +746,7 @@ def tensorize(
         g_count=g_count,
         g_mask=g_mask,
         g_has=g_has,
+        g_tol=g_tol,
         g_tmpl_ok=g_tmpl_ok,
         type_refs=type_refs,
         t_mask=t_mask,
@@ -758,6 +770,7 @@ def tensorize(
         templates=list(templates),
         m_mask=m_mask,
         m_has=m_has,
+        m_tol=m_tol,
         m_overhead=m_overhead,
         m_limits=m_limits,
     )

@@ -308,8 +308,15 @@ def compile_topology(groups: list, topology) -> WavesPlan:
                         break
                     extra_reqs.append(Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, allowed_d))
                 else:
-                    # bootstrap: deterministic sorted-first allowed domain
-                    # (the host engine's tie-break, topology.py:207)
+                    # bootstrap is SELF-affinity only: a pod whose required
+                    # affinity selector matches nobody (not even itself)
+                    # cannot schedule (topology_test.go:2126) — the host
+                    # engine produces the error
+                    if not tg.selects(rep):
+                        ok = False
+                        break
+                    # deterministic sorted-first allowed domain (the host
+                    # engine's tie-break, topology.py:207)
                     first = next(
                         (d for d in sorted(counts) if pod_zone.has(d)), None
                     )
@@ -323,6 +330,9 @@ def compile_topology(groups: list, topology) -> WavesPlan:
                     counts.values()
                 ):
                     ok = False  # cross-group or existing matches: host
+                    break
+                if not tg.selects(rep):
+                    ok = False  # matches nobody, not even itself: host fails it
                     break
                 single_bin = True
             else:

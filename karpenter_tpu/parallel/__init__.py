@@ -1,1 +1,5 @@
-from karpenter_tpu.parallel.mesh import make_mesh, sharded_solve  # noqa: F401
+from karpenter_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    make_multihost_mesh,
+    sharded_solve,
+)

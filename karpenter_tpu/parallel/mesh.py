@@ -43,6 +43,40 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(mesh_utils.create_device_mesh(shape, devs[:n]), (DATA_AXIS, MODEL_AXIS))
 
 
+def make_multihost_mesh(n_hosts: int | None = None,
+                        chips_per_host: int | None = None) -> Mesh:
+    """DCN-tier mesh: the data (group) axis spans HOSTS and the model
+    (type) axis stays INTRA-host, so the heavy collective — the [G,T]
+    feasibility all-gather feeding the pack scan — rides ICI while only
+    the group-sharded inputs cross DCN (the scaling-book layout: put the
+    bandwidth-hungry axis on the fast interconnect).
+
+    On real multi-host installs, jax.devices() already interleaves
+    processes and `mesh_utils` keeps each host's chips contiguous on the
+    trailing axis; under xla_force_host_platform_device_count the same
+    program dry-runs single-process with virtual "hosts"."""
+    devs = jax.devices()
+    if n_hosts is None:
+        n_hosts = max(
+            getattr(jax, "process_count", lambda: 1)(), 1
+        )
+        if n_hosts == 1:
+            # virtual topology: treat the device array as 2 "hosts" when
+            # it splits evenly, else fall back to the flat mesh
+            n_hosts = 2 if len(devs) % 2 == 0 and len(devs) >= 4 else 1
+    if chips_per_host is None:
+        chips_per_host = len(devs) // n_hosts
+    n = n_hosts * chips_per_host
+    if n_hosts <= 1 or n == 0 or n > len(devs):
+        # over-asked topology (more hosts than devices) degrades to the
+        # flat single-tier mesh rather than erroring
+        return make_mesh(min(max(n, 1), len(devs)))
+    arr = mesh_utils.create_device_mesh(
+        (n_hosts, chips_per_host), devs[:n],
+    )
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
 def _pad_to(a: np.ndarray, axis: int, mult: int) -> np.ndarray:
     size = a.shape[axis]
     target = ((size + mult - 1) // mult) * mult
@@ -83,9 +117,15 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
                "g_decl", "g_match", "g_sown", "g_smatch"]
     T_NAMES = ["t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl",
                "off_zone", "off_ct", "off_avail", "off_price"]
+    if "g_tol" in args:
+        G_NAMES.append("g_tol")
+    if "t_tol" in args:
+        T_NAMES.append("t_tol")
     # existing-node tensors: ge_ok rides the group axis; the per-node state
     # is scan-carried and stays replicated
     REPL_NAMES = ["m_mask", "m_has", "m_overhead", "m_limits"]
+    if "m_tol" in args:
+        REPL_NAMES.append("m_tol")
     if "ge_ok" in args:
         G_NAMES.append("ge_ok")
     REPL_NAMES += [k for k in ("e_avail", "e_npods", "e_scnt", "e_decl", "e_match")

@@ -1,0 +1,106 @@
+"""Admission-layer validation (webhooks.go:82-125 + the CEL markers from
+hack/validation): illegal NodePool specs are rejected at store write time,
+complementing the runtime validation controller's readiness gating.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.admission import AdmissionError, validate_nodepool_admission
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import NodeSelectorRequirement, ObjectMeta, Taint
+from karpenter_tpu.kube.store import KubeStore
+
+
+def nodepool(name="default"):
+    return NodePool(metadata=ObjectMeta(name=name))
+
+
+class TestNodePoolAdmission:
+    def test_valid_default_admits(self):
+        assert validate_nodepool_admission(nodepool()) == []
+        KubeStore().create("nodepools", nodepool())
+
+    def test_weight_range(self):
+        np_ = nodepool()
+        np_.spec.weight = 101
+        assert any("weight" in e for e in validate_nodepool_admission(np_))
+        with pytest.raises(AdmissionError):
+            KubeStore().create("nodepools", np_)
+        np_.spec.weight = 100
+        assert validate_nodepool_admission(np_) == []
+
+    def test_invalid_operator_rejected(self):
+        np_ = nodepool()
+        np_.spec.template.requirements = [
+            NodeSelectorRequirement(wk.ARCH_LABEL, "Within", ["amd64"])
+        ]
+        assert any("operator" in e for e in validate_nodepool_admission(np_))
+
+    def test_in_requires_values(self):
+        np_ = nodepool()
+        np_.spec.template.requirements = [
+            NodeSelectorRequirement(wk.ARCH_LABEL, "In", [])
+        ]
+        assert any("requires values" in e for e in validate_nodepool_admission(np_))
+
+    def test_exists_must_not_carry_values(self):
+        np_ = nodepool()
+        np_.spec.template.requirements = [
+            NodeSelectorRequirement("example.com/x", "Exists", ["v"])
+        ]
+        assert any("must not carry" in e for e in validate_nodepool_admission(np_))
+
+    def test_gt_requires_single_integer(self):
+        np_ = nodepool()
+        np_.spec.template.requirements = [
+            NodeSelectorRequirement("example.com/cores", "Gt", ["four"])
+        ]
+        assert any("integer" in e for e in validate_nodepool_admission(np_))
+
+    def test_min_values_bounds(self):
+        np_ = nodepool()
+        np_.spec.template.requirements = [
+            NodeSelectorRequirement(wk.INSTANCE_TYPE_LABEL, "Exists", [],
+                                    min_values=51)
+        ]
+        assert any("minValues" in e for e in validate_nodepool_admission(np_))
+
+    def test_invalid_taint_effect(self):
+        np_ = nodepool()
+        np_.spec.template.taints = [Taint("dedicated", "x", "Sometimes")]
+        assert any("effect" in e for e in validate_nodepool_admission(np_))
+
+    def test_restricted_label_left_to_runtime_validation(self):
+        # the admission layer checks SHAPE only; restricted-domain policy is
+        # the runtime validation controller's (reference split: CEL vs
+        # controller) — so this admits, then readiness gates it
+        np_ = nodepool()
+        np_.spec.template.labels = {wk.HOSTNAME_LABEL: "oops"}
+        assert validate_nodepool_admission(np_) == []
+        from karpenter_tpu.controllers.nodepool.validation import validate_nodepool
+
+        assert any("restricted" in e for e in validate_nodepool(np_))
+
+    def test_malformed_label_key_rejected(self):
+        np_ = nodepool()
+        np_.spec.template.labels = {"-bad/key!": "v"}
+        assert any("invalid key" in e for e in validate_nodepool_admission(np_))
+
+    def test_negative_consolidate_after(self):
+        np_ = nodepool()
+        np_.spec.disruption.consolidate_after = -5.0
+        assert any("consolidateAfter" in e for e in validate_nodepool_admission(np_))
+
+    def test_bad_limits_rejected(self):
+        np_ = nodepool()
+        np_.spec.limits = {"cpu": "banana"}
+        assert any("limits" in e for e in validate_nodepool_admission(np_))
+
+    def test_update_also_gated(self):
+        store = KubeStore()
+        np_ = nodepool()
+        store.create("nodepools", np_)
+        np_.spec.weight = 999
+        with pytest.raises(AdmissionError):
+            store.update("nodepools", np_)

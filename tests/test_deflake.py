@@ -1,0 +1,85 @@
+"""Deflake harness (SURVEY.md §5 race-detection/deflake analog): the same
+scenario must converge to the same invariants under RANDOMIZED controller
+orderings — the single-threaded runtime's stand-in for the reference's
+-race + flake-attempt runs.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import Deployment, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.operator import Environment
+
+GIB = 2**30
+
+
+def build_env():
+    return Environment(
+        instance_types=[
+            make_instance_type("small", 2, 8),
+            make_instance_type("large", 16, 64),
+        ],
+        enable_disruption=True,
+    )
+
+
+def pod_template(name, cpu):
+    return Pod(metadata=ObjectMeta(name=name, labels={"app": name}),
+               requests={"cpu": cpu, "memory": 0.5 * GIB})
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42, 1337])
+class TestShuffledOrderings:
+    def test_provision_invariants_hold(self, seed):
+        rng = random.Random(seed)
+        env = build_env()
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        for i in range(3):
+            env.create("deployments",
+                       Deployment(metadata=ObjectMeta(name=f"d{i}"), replicas=4,
+                                  template=pod_template(f"d{i}", 0.5)))
+        env.run_until_idle_shuffled(rng, max_rounds=200)
+        pods = env.store.list("pods")
+        assert len(pods) == 12
+        assert all(p.node_name for p in pods), "pod left unbound"
+        nodes = env.store.list("nodes")
+        claims = env.store.list("nodeclaims")
+        assert len(nodes) == len(claims), "claim/node leak"
+        # capacity never exceeded on any node
+        for n in nodes:
+            used = sum(p.requests.get("cpu", 0.0) for p in pods
+                       if p.node_name == n.metadata.name)
+            assert used <= n.allocatable["cpu"] + 1e-9
+
+    def test_scale_down_consolidates_under_any_order(self, seed):
+        rng = random.Random(seed)
+        env = build_env()
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pool.spec.disruption.consolidate_after = 0.0
+        pool.spec.disruption.budgets[0].nodes = "100%"
+        env.create("nodepools", pool)
+        deploys = [
+            Deployment(metadata=ObjectMeta(name=f"d{i}"), replicas=4,
+                       template=pod_template(f"d{i}", 1.5))
+            for i in range(2)
+        ]
+        for d in deploys:
+            env.create("deployments", d)
+        env.run_until_idle_shuffled(rng, max_rounds=200)
+        start_nodes = len(env.store.list("nodes"))
+        for d in deploys:
+            d.replicas = 1
+            env.store.update("deployments", d)
+        for _ in range(12):
+            before = len(env.store.list("nodes"))
+            env.clock.step(20.0)
+            env.run_until_idle_shuffled(rng, max_rounds=200)
+            if len(env.store.list("nodes")) == before:
+                break
+        pods = [p for p in env.store.list("pods") if p.node_name]
+        assert len(pods) == 2, "workload lost during shuffled consolidation"
+        assert len(env.store.list("nodes")) <= start_nodes

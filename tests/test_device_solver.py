@@ -240,3 +240,105 @@ class TestDecodeJointCompat:
         host, dev = run_both([p], [pool], catalog)
         assert host.scheduled_pod_count() == 0
         assert dev.scheduled_pod_count() == 0
+
+
+class TestIntersectsTolerance:
+    """Device feasibility must honor the NotIn/NotIn empty-meet tolerance
+    (requirements.py Intersects:249) instead of conservatively failing —
+    VERDICT r3 weak #8. A pod excluding value `a` fits a type excluding
+    value `b` even when the interned masks share no bit."""
+
+    def _workload(self):
+        from karpenter_tpu.api.objects import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        from karpenter_tpu.scheduling import NOT_IN, Requirement
+
+        its = [
+            make_instance_type(
+                "m1", 8, 32,
+                extra_requirements=[
+                    Requirement("example.com/tier", NOT_IN, ["b"])
+                ],
+            )
+        ]
+        pods = [
+            Pod(
+                metadata=ObjectMeta(name=f"p{i}"),
+                requests={"cpu": 1.0, "memory": 1 * GIB},
+                affinity=Affinity(node_affinity=NodeAffinity(required=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement("example.com/tier", "NotIn", ["a"])
+                    ])])),
+            )
+            for i in range(4)
+        ]
+        return pods, its
+
+    def test_device_schedules_not_in_not_in(self):
+        pods, its = self._workload()
+        pool = nodepool()
+        s = TPUSolver()
+        res = s.solve([p.clone() for p in pods], [ClaimTemplate(pool)],
+                      {pool.name: its})
+        assert res.all_pods_scheduled(), res.pod_errors
+        # parity point: the pods must land on the DEVICE, not via host retry
+        assert s.last_device_stats["device_pods"] == 4
+        assert s.last_device_stats["retry_pods"] == 0
+
+    def test_native_schedules_not_in_not_in(self):
+        from karpenter_tpu import native
+        from karpenter_tpu.models import NativeSolver
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        pods, its = self._workload()
+        pool = nodepool()
+        s = NativeSolver()
+        res = s.solve([p.clone() for p in pods], [ClaimTemplate(pool)],
+                      {pool.name: its})
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert s.last_device_stats["device_pods"] == 4
+        assert s.last_device_stats["retry_pods"] == 0
+
+    def test_in_not_in_disjoint_still_infeasible(self):
+        # IN[a] vs NotIn[a]: empty meet with only ONE tolerant operator
+        # remains incompatible on every engine
+        from karpenter_tpu.api.objects import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        from karpenter_tpu.scheduling import NOT_IN, Requirement
+
+        its = [
+            make_instance_type(
+                "m1", 8, 32,
+                extra_requirements=[
+                    Requirement("example.com/tier", NOT_IN, ["a"])
+                ],
+            )
+        ]
+        pods = [
+            Pod(
+                metadata=ObjectMeta(name="p0"),
+                requests={"cpu": 1.0, "memory": 1 * GIB},
+                affinity=Affinity(node_affinity=NodeAffinity(required=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement("example.com/tier", "In", ["a"])
+                    ])])),
+            )
+        ]
+        pool = nodepool()
+        host = HostSolver().solve([p.clone() for p in pods],
+                                  [ClaimTemplate(pool)], {pool.name: its})
+        dev = TPUSolver().solve([p.clone() for p in pods],
+                                [ClaimTemplate(pool)], {pool.name: its})
+        assert not host.all_pods_scheduled()
+        assert not dev.all_pods_scheduled()

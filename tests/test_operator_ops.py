@@ -235,3 +235,41 @@ class TestChangeMonitor:
             env.run_until_idle(max_rounds=3)
         evts = env.recorder.by_reason("FailedScheduling")
         assert len(evts) == 1, [e.message for e in evts]
+
+
+class TestLeaderElection:
+    def test_acquire_renew_failover(self):
+        """Lease-based single-writer semantics (operator.go LeaderElection:
+        acquire, renew within the deadline, standby takes over on expiry)."""
+        from karpenter_tpu.kube.store import KubeStore
+        from karpenter_tpu.operator.leaderelection import LeaderElector
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = KubeStore(clock=clock)
+        a = LeaderElector(store, "instance-a", clock=clock)
+        b = LeaderElector(store, "instance-b", clock=clock)
+        assert a.try_acquire() and a.is_leader()
+        assert not b.try_acquire() and not b.is_leader()
+        # renewal keeps the lease across the duration boundary
+        clock.step(10.0)
+        assert a.try_acquire()
+        clock.step(10.0)
+        assert not b.try_acquire(), "renewed lease must not be stolen"
+        # a stops renewing: b takes over after expiry
+        clock.step(16.0)
+        assert b.try_acquire() and b.is_leader()
+        assert not a.is_leader()
+
+    def test_release_hands_off_immediately(self):
+        from karpenter_tpu.kube.store import KubeStore
+        from karpenter_tpu.operator.leaderelection import LeaderElector
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = KubeStore(clock=clock)
+        a = LeaderElector(store, "a", clock=clock)
+        b = LeaderElector(store, "b", clock=clock)
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire() and b.is_leader()

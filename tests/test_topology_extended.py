@@ -1,0 +1,382 @@
+"""Extended topology coverage toward the reference's 79-spec suite
+(pkg/controllers/provisioning/scheduling/topology_test.go), driven on the
+host engine AND both device engines (the device path routes inexpressible
+shapes to its host fallback, so every engine must give the same answer).
+
+Named gaps from the round-3 review: capacity-type/arch spread, minDomains
+variants, same-selector/different-parameter spreads, relaxation
+interacting with topology, selector-limited spread, interdependent
+selectors, namespace filtering, dependent-affinity chains.
+"""
+
+import collections
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver, NativeSolver, TPUSolver
+from karpenter_tpu.models.topology import Topology
+
+GIB = 2**30
+ZONES = ("zone-1", "zone-2", "zone-3")
+
+
+@pytest.fixture(params=["host", "tpu", "native"])
+def solver_cls(request):
+    if request.param == "native":
+        from karpenter_tpu import native
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        return NativeSolver
+    return {"host": HostSolver, "tpu": TPUSolver}[request.param]
+
+
+def nodepool(name="default"):
+    return NodePool(metadata=ObjectMeta(name=name))
+
+
+def catalog():
+    return [
+        make_instance_type("small-amd", 4, 16, zones=ZONES),
+        make_instance_type("small-arm", 4, 16, arch=wk.ARCHITECTURE_ARM64, zones=ZONES),
+        make_instance_type("large", 32, 128, zones=ZONES),
+    ]
+
+
+def make_pods(n, labels=None, cpu=1.0, name_prefix="p", namespace="default", **kw):
+    return [
+        Pod(
+            metadata=ObjectMeta(name=f"{name_prefix}{i}", labels=dict(labels or {}),
+                                namespace=namespace),
+            requests={"cpu": cpu, "memory": 1 * GIB},
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+def spread(key, max_skew=1, labels=None, **kw):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        when_unsatisfiable=kw.pop("when", "DoNotSchedule"),
+        label_selector=LabelSelector(match_labels=labels or {"app": "web"}),
+        **kw,
+    )
+
+
+def affinity_term(labels, key=wk.TOPOLOGY_ZONE_LABEL, namespaces=()):
+    return Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(topology_key=key,
+                        label_selector=LabelSelector(match_labels=labels),
+                        namespaces=list(namespaces))]))
+
+
+def solve(solver_cls, pods, domains=None):
+    pool = nodepool()
+    topo = Topology(
+        domains=domains if domains is not None else {
+            wk.TOPOLOGY_ZONE_LABEL: set(ZONES),
+            wk.CAPACITY_TYPE_LABEL: {wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND},
+            wk.ARCH_LABEL: {wk.ARCHITECTURE_AMD64, wk.ARCHITECTURE_ARM64},
+        },
+        pods=pods,
+    )
+    return solver_cls().solve(
+        [p.clone() for p in pods], [ClaimTemplate(pool)], {pool.name: catalog()},
+        topology=topo)
+
+
+def key_skew(res, key):
+    counts = collections.Counter()
+    for claim in res.new_claims:
+        req = claim.requirements.get_req(key)
+        assert len(req.values) == 1, f"claim not pinned to one {key}"
+        counts[next(iter(req.values))] += len(claim.pods)
+    return counts
+
+
+class TestCapacityTypeAndArchSpread:
+    def test_balance_across_capacity_types(self, solver_cls):
+        # topology_test.go:640 "should balance pods across capacity types"
+        pods = make_pods(4, {"app": "web"},
+                         topology_spread_constraints=[spread(wk.CAPACITY_TYPE_LABEL)])
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.CAPACITY_TYPE_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_capacity_type_skew_not_violated_do_not_schedule(self, solver_cls):
+        # :668 — only spot offered: a maxSkew=1 constraint over both
+        # capacity types still schedules (min over EXISTING domains when
+        # the other never materializes is gated by domain discovery)
+        pods = make_pods(6, {"app": "web"},
+                         topology_spread_constraints=[spread(wk.CAPACITY_TYPE_LABEL)])
+        res = solve(solver_cls, pods)
+        counts = key_skew(res, wk.CAPACITY_TYPE_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_balance_across_arch(self, solver_cls):
+        # :882 "should balance pods across arch (no constraints)"
+        pods = make_pods(4, {"app": "web"},
+                         topology_spread_constraints=[spread(wk.ARCH_LABEL)])
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.ARCH_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestMinDomains:
+    def test_satisfied_equal_allows_scheduling(self, solver_cls):
+        # :489 satisfied minDomains (equal) schedules freely
+        pods = make_pods(6, {"app": "web"},
+                         topology_spread_constraints=[
+                             spread(wk.TOPOLOGY_ZONE_LABEL, min_domains=3)])
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_satisfied_greater_than_minimum(self, solver_cls):
+        # :509 minDomains below the available count is inert
+        pods = make_pods(6, {"app": "web"},
+                         topology_spread_constraints=[
+                             spread(wk.TOPOLOGY_ZONE_LABEL, min_domains=2)])
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+
+    def test_violated_caps_per_domain(self, solver_cls):
+        # :469 fewer domains than minDomains: global min treated as 0, so
+        # each domain holds at most maxSkew pods
+        pods = make_pods(4, {"app": "web"},
+                         topology_spread_constraints=[
+                             spread(wk.TOPOLOGY_ZONE_LABEL, min_domains=3)])
+        res = solve(solver_cls, pods,
+                    domains={wk.TOPOLOGY_ZONE_LABEL: {"zone-1", "zone-2"}})
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert all(v <= 1 for v in counts.values())
+
+
+class TestSameSelectorDifferentParams:
+    def test_conflicting_skews_both_hold(self, solver_cls):
+        # same (key, selector) with different maxSkew: counts interact —
+        # the device plan routes these to the host engine, and BOTH
+        # constraints must hold in the answer
+        a = make_pods(6, {"app": "web"}, name_prefix="a", cpu=2.0,
+                      topology_spread_constraints=[spread(wk.TOPOLOGY_ZONE_LABEL,
+                                                          max_skew=1)])
+        b = make_pods(6, {"app": "web"}, name_prefix="b", cpu=1.0,
+                      topology_spread_constraints=[spread(wk.TOPOLOGY_ZONE_LABEL,
+                                                          max_skew=2)])
+        res = solve(solver_cls, a + b)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 2
+
+    def test_interdependent_selectors(self, solver_cls):
+        # :444 two groups each spreading over a selector matching BOTH
+        sel = {"team": "x"}
+        a = make_pods(3, {"team": "x", "app": "a"}, name_prefix="a", cpu=2.0,
+                      topology_spread_constraints=[
+                          spread(wk.TOPOLOGY_ZONE_LABEL, labels=sel)])
+        b = make_pods(3, {"team": "x", "app": "b"}, name_prefix="b", cpu=1.0,
+                      topology_spread_constraints=[
+                          spread(wk.TOPOLOGY_ZONE_LABEL, labels=sel)])
+        res = solve(solver_cls, a + b)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_match_all_when_selector_absent(self, solver_cls):
+        # :432 a nil labelSelector matches every pod
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+            when_unsatisfiable="DoNotSchedule", label_selector=None)
+        pods = make_pods(3, {"app": "web"},
+                         topology_spread_constraints=[tsc])
+        pods += make_pods(3, {"app": "other"}, name_prefix="q",
+                          topology_spread_constraints=[tsc])
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestSelectorLimitedSpread:
+    def test_node_selector_limits_domains(self, solver_cls):
+        # :1208 a nodeSelector pinning one zone forces the whole spread
+        # into that zone
+        pods = make_pods(3, {"app": "web"},
+                         node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"},
+                         topology_spread_constraints=[spread(wk.TOPOLOGY_ZONE_LABEL)])
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert set(counts) == {"zone-2"}
+
+    def test_node_affinity_limits_domains(self, solver_cls):
+        # :1256 required node affinity restricts the domain universe
+        from karpenter_tpu.api.objects import NodeAffinity, NodeSelectorTerm
+
+        pods = make_pods(4, {"app": "web"},
+                         topology_spread_constraints=[spread(wk.TOPOLOGY_ZONE_LABEL)],
+                         affinity=Affinity(node_affinity=NodeAffinity(required=[
+                             NodeSelectorTerm(match_expressions=[
+                                 NodeSelectorRequirement(
+                                     wk.TOPOLOGY_ZONE_LABEL, "In",
+                                     ["zone-1", "zone-2"])])])))
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert set(counts) <= {"zone-1", "zone-2"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestRelaxationWithTopology:
+    def test_schedule_anyway_violates_when_needed(self, solver_cls):
+        # :703 ScheduleAnyway relaxes once DoNotSchedule-style placement
+        # fails (zero domains known)
+        tsc = spread(wk.TOPOLOGY_ZONE_LABEL, when="ScheduleAnyway")
+        pods = make_pods(4, {"app": "web"}, topology_spread_constraints=[tsc])
+        res = solve(solver_cls, pods, domains={wk.TOPOLOGY_ZONE_LABEL: set()})
+        assert res.all_pods_scheduled()
+
+    def test_preferred_affinity_violation_allowed(self, solver_cls):
+        # :1646 preferred pod affinity to a pod that never lands
+        aff = Affinity(pod_affinity=PodAffinity(preferred=[
+            WeightedPodAffinityTerm(weight=1, pod_affinity_term=PodAffinityTerm(
+                topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                label_selector=LabelSelector(match_labels={"app": "ghost"})))]))
+        pods = make_pods(2, {"app": "web"}, affinity=aff)
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+
+    def test_conflicting_preference_with_required_constraint(self, solver_cls):
+        # :2046 a preferred affinity that conflicts with a required node
+        # selector loses; the pod still schedules
+        aff = Affinity(pod_affinity=PodAffinity(preferred=[
+            WeightedPodAffinityTerm(weight=1, pod_affinity_term=PodAffinityTerm(
+                topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                label_selector=LabelSelector(match_labels={"app": "zone1"})))]))
+        anchor = make_pods(1, {"app": "zone1"}, name_prefix="anchor",
+                           node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-1"})
+        follower = make_pods(1, {"app": "web"}, name_prefix="f",
+                             node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-3"},
+                             affinity=aff)
+        res = solve(solver_cls, anchor + follower)
+        assert res.all_pods_scheduled()
+
+
+class TestNamespaceFiltering:
+    def test_affinity_ignores_other_namespace(self, solver_cls):
+        # :2256 affinity terms are namespace-scoped: a matching pod in a
+        # different namespace does not satisfy the dependency
+        target = make_pods(1, {"app": "db"}, name_prefix="t", namespace="other",
+                           node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"})
+        follower = make_pods(1, {"app": "web"}, name_prefix="f",
+                             affinity=affinity_term({"app": "db"}))
+        res = solve(solver_cls, target + follower)
+        assert res.scheduled_pod_count() == 1  # the follower fails
+        assert len(res.pod_errors) == 1
+
+    def test_affinity_explicit_namespace_list(self, solver_cls):
+        # :2294 naming the namespace in the term crosses the boundary (the
+        # target is zone-pinned so it schedules first, like the reference
+        # scenario where the target is already bound)
+        target = make_pods(1, {"app": "db"}, name_prefix="t", namespace="other",
+                           node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"})
+        follower = make_pods(1, {"app": "web"}, name_prefix="f",
+                             affinity=affinity_term({"app": "db"},
+                                                    namespaces=("other", "default")))
+        res = solve(solver_cls, target + follower)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert set(counts) == {"zone-2"}
+
+
+class TestDependentAffinities:
+    def test_chain_lands_in_one_zone(self, solver_cls):
+        # :2205 a→b→c chains resolve into a single zone
+        a = make_pods(1, {"app": "a"}, name_prefix="a",
+                      node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"})
+        b = make_pods(1, {"app": "b"}, name_prefix="b",
+                      affinity=affinity_term({"app": "a"}))
+        c = make_pods(1, {"app": "c"}, name_prefix="c",
+                      affinity=affinity_term({"app": "b"}))
+        res = solve(solver_cls, a + b + c)
+        assert res.all_pods_scheduled()
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert set(counts) == {"zone-2"}
+
+    def test_affinity_to_nonexistent_pod_fails(self, solver_cls):
+        # :2126 affinity to nothing cannot schedule
+        pods = make_pods(2, {"app": "web"}, name_prefix="f",
+                         affinity=affinity_term({"app": "ghost"}))
+        res = solve(solver_cls, pods)
+        assert not res.all_pods_scheduled()
+        assert res.scheduled_pod_count() == 0
+
+    def test_unsatisfiable_dependency_fails_chain_tail(self, solver_cls):
+        # :2240 the tail of a chain whose head fails also fails
+        head = make_pods(1, {"app": "h"}, name_prefix="h",
+                         affinity=affinity_term({"app": "ghost"}))
+        tail = make_pods(1, {"app": "t"}, name_prefix="t",
+                         affinity=affinity_term({"app": "h"}))
+        res = solve(solver_cls, head + tail)
+        assert res.scheduled_pod_count() == 0
+
+
+class TestCombinedConstraints:
+    def test_hostname_and_zone_spread_together(self, solver_cls):
+        # :928 both constraints hold simultaneously
+        pods = make_pods(6, {"app": "web"},
+                         topology_spread_constraints=[
+                             spread(wk.TOPOLOGY_ZONE_LABEL),
+                             spread(wk.HOSTNAME_LABEL)])
+        res = solve(solver_cls, pods)
+        assert res.all_pods_scheduled()
+        zc = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        for claim in res.new_claims:
+            matched = [p for p in claim.pods
+                       if p.metadata.labels.get("app") == "web"]
+            assert len(matched) <= 1
+
+    def test_zone_anti_affinity_with_existing_inverse(self, solver_cls):
+        # :1946 inverse anti-affinity with pre-recorded declarer domains
+        guard = Pod(
+            metadata=ObjectMeta(name="guard", labels={"app": "guard"}),
+            requests={"cpu": 1.0, "memory": 1 * GIB},
+            affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                PodAffinityTerm(topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "web"}))])),
+        )
+        pods = make_pods(2, {"app": "web"}, name_prefix="w")
+        pool = nodepool()
+        topo = Topology(domains={wk.TOPOLOGY_ZONE_LABEL: set(ZONES)}, pods=pods)
+        topo._update_inverse_anti_affinity(
+            guard, {wk.TOPOLOGY_ZONE_LABEL: "zone-1"})
+        res = solver_cls().solve(
+            [p.clone() for p in pods], [ClaimTemplate(pool)],
+            {pool.name: catalog()}, topology=topo)
+        assert res.all_pods_scheduled()
+        # web pods must EXCLUDE the declarer's zone; anti-affinity only
+        # narrows, so claims need not pin to a single zone
+        for claim in res.new_claims:
+            zr = claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL)
+            assert not zr.has("zone-1"), "web claim allows the declarer zone"
