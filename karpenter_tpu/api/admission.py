@@ -45,7 +45,7 @@ def _validate_requirement(r, where: str) -> list[str]:
     if op in ("Exists", "DoesNotExist") and values:
         errs.append(f"{where}: operator {op} must not carry values")
     if op in ("Gt", "Lt"):
-        if len(values) != 1 or not str(values[0]).lstrip("-").isdigit():
+        if len(values) != 1 or not re.fullmatch(r"-?\d+", str(values[0])):
             errs.append(f"{where}: operator {op} requires one integer value")
         elif int(values[0]) < 0:
             errs.append(f"{where}: operator {op} value must be >= 0")

@@ -31,6 +31,7 @@ class Environment:
         validation_ttl: float | None = None,
         provider_metrics: bool = True,
         options=None,
+        store=None,  # share an apiserver across instances (HA/standby)
     ):
         from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
         from karpenter_tpu.controllers.provisioning.batcher import Batcher
@@ -40,7 +41,7 @@ class Environment:
 
         self.options = options or Options.from_env()
         self.clock = clock or FakeClock()
-        self.store = KubeStore(self.clock)
+        self.store = store or KubeStore(self.clock)
         self.recorder = Recorder(clock=self.clock)
         # per-environment registry: two Environments in one process (the
         # pytest norm) must not clobber each other's gauge sweeps
@@ -50,6 +51,14 @@ class Environment:
             self.cloud = MetricsCloudProvider(self.cloud, registry=self.registry)
         self.binder = Binder(self.store)
         self.cluster = Cluster(self.store, clock=self.clock)
+        # leader election gates every reconcile round (operator.go
+        # LeaderElection): a single-instance environment always holds the
+        # lease; a standby Environment sharing the store stays passive
+        from karpenter_tpu.operator.leaderelection import LeaderElector
+
+        self.elector = LeaderElector(
+            self.store, identity=f"karpenter-{id(self):x}", clock=self.clock
+        )
         # sync mode collapses the batch window so tests drive deterministically
         batcher = (
             Batcher(self.clock, idle_duration=0.0, max_duration=0.0)
@@ -150,13 +159,27 @@ class Environment:
         the poll ORDER (deflake mode); event dispatch stays informer-first
         because state must mirror an event before any controller acts on
         it (state/informer/*)."""
+        was_leader = self.elector.is_leader()
+        leading = self.elector.try_acquire()
+        if leading and not was_leader:
+            # takeover: warm the informer cache from the store snapshot —
+            # the hermetic store's event queue is single-consumer, so a
+            # standby has not seen the events the old leader drained
+            self.cluster.resync()
+        if not leading:
+            return False  # standby: hold position until the lease frees
         progressed = False
         for event in self.store.drain_events():
             self.cluster.on_event(event)
             self.provisioner.on_event(event)
             for c in self.controllers:
                 c.on_event(event)
-            progressed = True
+            # the elector's own renewals are bookkeeping, not work: they
+            # must not hold the loop out of idle (one spurious full round
+            # per renewal otherwise)
+            if not (event.kind == "leases"
+                    and getattr(event.obj.metadata, "namespace", "") == "kube-system"):
+                progressed = True
         sources = [self.provisioner.reconcile]
         sources += [c.poll for c in self.controllers]
         sources.append(self.binder.bind_pending)

@@ -63,8 +63,12 @@ class LeaderElector:
             return True
         expired = now - lease.renewed >= lease.duration
         if lease.holder == self.identity:
-            lease.renewed = now
-            self.store.update("leases", lease)
+            # renew at most once per RETRY_PERIOD: an update per reconcile
+            # round would flood the watch stream (and read as progress to
+            # idle detection)
+            if now - lease.renewed >= RETRY_PERIOD:
+                lease.renewed = now
+                self.store.update("leases", lease)
             return True
         if expired:
             lease.holder = self.identity

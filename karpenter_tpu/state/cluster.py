@@ -59,6 +59,25 @@ class Cluster:
             # any nodepool change can change the consolidation answer
             self.mark_unconsolidated()
 
+    def resync(self):
+        """Full rebuild from the store snapshot — leadership takeover: a
+        fresh leader's informer cache must warm before it reconciles (the
+        reference's client-go informers re-list on start; the hermetic
+        store's event queue is single-consumer, so a standby that never
+        drained catches up here)."""
+        self._nodes.clear()
+        self._node_name_to_pid.clear()
+        self._claim_name_to_pid.clear()
+        self._bindings.clear()
+        self._antiaffinity_pods.clear()
+        self._state_seq += 1
+        for claim in self.store.list("nodeclaims"):
+            self.update_node_claim(claim)
+        for node in self.store.list("nodes"):
+            self.update_node(node)
+        for pod in self.store.list("pods"):
+            self.update_pod(pod)
+
     # -- node / claim tracking (cluster.go UpdateNode/UpdateNodeClaim) ---
     def _state_for(self, provider_id: str) -> StateNode:
         if not provider_id:

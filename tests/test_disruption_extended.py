@@ -1,0 +1,199 @@
+"""Extended disruption specs toward the reference's suites
+(pkg/controllers/disruption/{budgets,drift,emptiness,orchestration}
+tests): cron-windowed and reason-scoped budgets, percentage rounding,
+multi-pool trimming, orchestration rollback, do-not-disrupt interplay.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import Budget, NodePool
+from karpenter_tpu.api.objects import Deployment, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.controllers.disruption.helpers import (
+    build_disruption_budgets,
+    within_budget,
+)
+from karpenter_tpu.operator import Environment
+
+GIB = 2**30
+
+
+def nodepool(name="default", budgets=None):
+    np_ = NodePool(metadata=ObjectMeta(name=name))
+    if budgets is not None:
+        np_.spec.disruption.budgets = budgets
+    return np_
+
+
+def build_env(n_nodes=5, budgets=None, pods_per_node=1):
+    env = Environment(
+        instance_types=[make_instance_type("small", 2, 8)],
+        enable_disruption=True,
+    )
+    pool = nodepool(budgets=budgets)
+    pool.spec.disruption.consolidate_after = 0.0
+    env.create("nodepools", pool)
+    for i in range(n_nodes):
+        env.create("deployments", Deployment(
+            metadata=ObjectMeta(name=f"d{i}"), replicas=pods_per_node,
+            template=Pod(metadata=ObjectMeta(name=f"d{i}", labels={"app": f"d{i}"}),
+                         requests={"cpu": 1.2, "memory": 0.5 * GIB})))
+    env.run_until_idle()
+    return env
+
+
+class TestBudgetComputation:
+    def test_percentage_rounds_up(self):
+        # GetScaledValueFromIntOrPercent(roundUp=true): 10% of 5 -> 1, so a
+        # small fleet can always make progress (nodepool.go:271)
+        env = build_env(n_nodes=5, budgets=[Budget(nodes="10%")])
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Underutilized"] == 1
+
+    def test_percentage_of_larger_fleet(self):
+        env = build_env(n_nodes=5, budgets=[Budget(nodes="40%")])
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Underutilized"] == 2
+
+    def test_absolute_count(self):
+        env = build_env(n_nodes=5, budgets=[Budget(nodes="3")])
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Underutilized"] == 3
+
+    def test_reason_scoped_budget(self):
+        # a budget naming reasons caps only those reasons
+        env = build_env(n_nodes=4, budgets=[
+            Budget(nodes="100%"),
+            Budget(nodes="0", reasons=["Drifted"]),
+        ])
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Drifted"] == 0
+        assert b["default"]["Underutilized"] == 4
+
+    def test_most_restrictive_active_budget_wins(self):
+        env = build_env(n_nodes=4, budgets=[
+            Budget(nodes="100%"), Budget(nodes="1"),
+        ])
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Underutilized"] == 1
+
+    def test_cron_window_gates_budget(self):
+        # a scheduled zero-budget only binds while its window is open: pin
+        # the clock to just after midnight UTC, then step past the window
+        import datetime as dt
+
+        midnight = dt.datetime(2026, 1, 5, 0, 0, tzinfo=dt.timezone.utc).timestamp()
+        env = build_env(n_nodes=4, budgets=[
+            Budget(nodes="100%"),
+            Budget(nodes="0", schedule="0 0 * * *", duration=3600.0),
+        ])
+        env.clock.step(midnight + 60.0 - env.clock.now())
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Underutilized"] == 0  # inside the 00:00 window
+        env.clock.step(2 * 3600.0)  # past the window
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Underutilized"] == 4
+
+    def test_disrupting_nodes_debit_budget(self):
+        env = build_env(n_nodes=4, budgets=[Budget(nodes="2")])
+        sns = env.cluster.nodes()
+        env.cluster.mark_for_deletion(sns[0].provider_id)
+        b = build_disruption_budgets(env.cluster, env.store, env.clock)
+        assert b["default"]["Underutilized"] == 1
+
+
+class TestWithinBudget:
+    class _C:
+        def __init__(self, pool):
+            self.node_pool = type("P", (), {"name": pool})()
+
+    def test_trims_per_pool(self):
+        budgets = {"a": {"Underutilized": 1}, "b": {"Underutilized": 2}}
+        cands = [self._C("a"), self._C("a"), self._C("b"), self._C("b"),
+                 self._C("b")]
+        out = within_budget(budgets, "Underutilized", cands)
+        pools = [c.node_pool.name for c in out]
+        assert pools.count("a") == 1 and pools.count("b") == 2
+
+    def test_unknown_pool_blocked(self):
+        out = within_budget({}, "Underutilized", [self._C("ghost")])
+        assert out == []
+
+
+class TestOrchestrationRollback:
+    def test_failed_replacement_rolls_back(self):
+        """A consolidation whose replacement claim never materializes rolls
+        back: candidates untainted and unfenced (orchestration queue
+        10-minute rollback, queue.go)."""
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8),
+                            make_instance_type("large", 16, 64)],
+            enable_disruption=True,
+        )
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        pool = nodepool()
+        pool.spec.template.requirements = [NodeSelectorRequirement(
+            wk.CAPACITY_TYPE_LABEL, "In", [wk.CAPACITY_TYPE_ON_DEMAND])]
+        env.create("nodepools", pool)
+        big = Deployment(metadata=ObjectMeta(name="big"), replicas=1,
+                         template=Pod(metadata=ObjectMeta(name="big",
+                                                          labels={"app": "big"}),
+                                      requests={"cpu": 10.0, "memory": 1 * GIB}))
+        env.create("deployments", big)
+        env.run_until_idle()
+        small = Deployment(metadata=ObjectMeta(name="small"), replicas=1,
+                           template=Pod(metadata=ObjectMeta(name="small",
+                                                            labels={"app": "small"}),
+                                        requests={"cpu": 0.5, "memory": 0.5 * GIB}))
+        env.create("deployments", small)
+        env.run_until_idle()
+        big.replicas = 0
+        env.store.update("deployments", big)
+        for p in list(env.store.list("pods")):
+            if p.metadata.labels.get("app") == "big":
+                env.store.delete("pods", p)
+        # let the command compute + validate, then sabotage every launch
+        # (ICE on create: the lifecycle deletes the unlaunchable claim and
+        # the orchestration queue must roll the candidate back)
+        from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+
+        def boom(nc):
+            raise InsufficientCapacityError("capacity gone")
+
+        env.cloud.create = boom
+        before_nodes = {n.metadata.name for n in env.store.list("nodes")}
+        env.clock.step(20.0)
+        env.run_until_idle(max_rounds=50)
+        # replacement could not launch: after the rollback TTL the original
+        # node must survive untainted with its pod intact
+        env.clock.step(11 * 60.0)
+        env.run_until_idle(max_rounds=50)
+        after = {n.metadata.name for n in env.store.list("nodes")}
+        assert before_nodes <= after, "candidate deleted despite failed launch"
+        node = env.store.get("nodes", next(iter(before_nodes)))
+        assert all(t.key != wk.DISRUPTION_TAINT_KEY for t in node.taints), (
+            "disruption taint not rolled back"
+        )
+
+    def test_do_not_disrupt_pod_blocks_candidate(self):
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+        )
+        pool = nodepool()
+        pool.spec.disruption.consolidate_after = 0.0
+        env.create("nodepools", pool)
+        tpl = Pod(metadata=ObjectMeta(name="d0", labels={"app": "d0"},
+                                      annotations={wk.DO_NOT_DISRUPT_ANNOTATION: "true"}),
+                  requests={"cpu": 0.2, "memory": 0.25 * GIB})
+        env.create("deployments", Deployment(metadata=ObjectMeta(name="d0"),
+                                             replicas=1, template=tpl))
+        env.run_until_idle()
+        for _ in range(3):
+            env.clock.step(20.0)
+            env.run_until_idle(max_rounds=50)
+        # underutilized but pinned: the node must survive
+        assert len([n for n in env.store.list("nodes")
+                    if n.metadata.deletion_timestamp is None]) == 1

@@ -213,10 +213,16 @@ class TestLeaseGC:
             name=node_name, namespace="kube-node-lease",
             owner_references=[{"kind": "Node", "name": node_name}]))
 
+    @staticmethod
+    def _node_leases(env):
+        # scope to the kubelet heartbeat namespace: the operator's own
+        # leader-election lease (kube-system) is not GC fodder
+        return env.store.list("leases", namespace="kube-node-lease")
+
     def test_orphaned_lease_deleted(self, env):
         env.create("leases", self._lease("gone-node"))
         env.run_until_idle()
-        assert env.store.list("leases") == []
+        assert self._node_leases(env) == []
 
     def test_live_lease_kept(self, env):
         env.create("nodepools", nodepool())
@@ -224,9 +230,9 @@ class TestLeaseGC:
         node = env.store.list("nodes")[0]
         env.create("leases", self._lease(node.name))
         env.run_until_idle()
-        assert len(env.store.list("leases")) == 1
+        assert len(self._node_leases(env)) == 1
 
     def test_unowned_lease_ignored(self, env):
         env.create("leases", Lease(metadata=ObjectMeta(name="x", namespace="kube-node-lease")))
         env.run_until_idle()
-        assert len(env.store.list("leases")) == 1
+        assert len(self._node_leases(env)) == 1

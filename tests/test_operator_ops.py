@@ -273,3 +273,40 @@ class TestLeaderElection:
         assert a.try_acquire()
         a.release()
         assert b.try_acquire() and b.is_leader()
+
+    def test_standby_environment_stays_passive_then_takes_over(self):
+        """Two operators over one shared apiserver: only the lease holder
+        reconciles (operator.go LeaderElection); on lease expiry the
+        standby resyncs its informer cache from the store snapshot and
+        takes over the full reconcile load."""
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+        from karpenter_tpu.operator import Environment
+
+        GIB = 2**30
+        active = Environment(instance_types=[make_instance_type("m", 4, 16)])
+        standby = Environment(instance_types=[make_instance_type("m", 4, 16)],
+                              clock=active.clock, cloud=active.cloud,
+                              store=active.store)
+        active.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        active.run_until_idle(max_rounds=2)  # acquires the lease
+        active.store.create("pods", Pod(metadata=ObjectMeta(name="p0",
+                                                            namespace="default"),
+                                        requests={"cpu": 1.0, "memory": GIB}))
+        assert standby.run_until_idle(max_rounds=5) == 1, "standby acted"
+        assert not standby.elector.is_leader()
+        active.run_until_idle()
+        pods = active.store.list("pods")
+        assert all(p.node_name for p in pods)
+        # the active instance stops renewing; after expiry the standby
+        # acquires, resyncs state, and handles new work end-to-end
+        active.clock.step(20.0)
+        active.store.create("pods", Pod(metadata=ObjectMeta(name="p1",
+                                                            namespace="default"),
+                                        requests={"cpu": 1.0, "memory": GIB}))
+        standby.run_until_idle(max_rounds=20)
+        assert standby.elector.is_leader()
+        assert all(p.node_name for p in standby.store.list("pods")), (
+            "new leader failed to reconcile after takeover"
+        )

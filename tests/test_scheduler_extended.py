@@ -1,0 +1,239 @@
+"""Extended scheduler specs toward the reference's provisioning suite
+(pkg/controllers/provisioning/suite_test.go, scheduling_test.go): numeric
+operators, minValues, daemonset overhead, startup taints, host ports, pod
+overhead, init containers, offering exhaustion — run on the host engine
+AND both device engines where the feature is device-expressible.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver, NativeSolver, TPUSolver
+from karpenter_tpu.scheduling import Requirement, IN
+
+GIB = 2**30
+
+
+@pytest.fixture(params=["host", "tpu", "native"])
+def solver_cls(request):
+    if request.param == "native":
+        from karpenter_tpu import native
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        return NativeSolver
+    return {"host": HostSolver, "tpu": TPUSolver}[request.param]
+
+
+def nodepool(name="default"):
+    return NodePool(metadata=ObjectMeta(name=name))
+
+
+def pod(name, cpu=1.0, mem_gib=1.0, **kw):
+    return Pod(metadata=ObjectMeta(name=name),
+               requests={"cpu": cpu, "memory": mem_gib * GIB}, **kw)
+
+
+def sized_catalog():
+    """Types carrying a numeric instance-cpu label for Gt/Lt specs (the
+    cloud-provider analog of karpenter.k8s.aws/instance-cpu)."""
+    out = []
+    for cpu in (2, 8, 32):
+        out.append(make_instance_type(
+            f"c{cpu}", cpu, cpu * 4,
+            extra_requirements=[Requirement("example.com/cpu", IN, [str(cpu)])],
+        ))
+    return out
+
+
+def sized_pool():
+    """The label is provider-defined, not well-known: the pool must declare
+    it or the one-way Compatible rule denies pod requirements on it
+    (requirements.go:174)."""
+    np_ = nodepool()
+    np_.spec.template.requirements = [
+        NodeSelectorRequirement("example.com/cpu", "Exists", [])
+    ]
+    return np_
+
+
+def solve(solver_cls, pods, catalog, pools=None, **kw):
+    pools = pools or [nodepool()]
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    return solver_cls().solve([p.clone() for p in pods], templates, its, **kw)
+
+
+def node_aff(*reqs):
+    return Affinity(node_affinity=NodeAffinity(required=[
+        NodeSelectorTerm(match_expressions=list(reqs))]))
+
+
+class TestNumericOperators:
+    def test_gt_filters_small_types(self, solver_cls):
+        # instance_selection_test.go: Gt keeps only types above the bound
+        pods = [pod("p0", affinity=node_aff(
+            NodeSelectorRequirement("example.com/cpu", "Gt", ["7"])))]
+        res = solve(solver_cls, pods, sized_catalog(), pools=[sized_pool()])
+        assert res.all_pods_scheduled()
+        names = {it.name for c in res.new_claims for it in c.instance_types}
+        assert names <= {"c8", "c32"} and names
+
+    def test_lt_filters_large_types(self, solver_cls):
+        pods = [pod("p0", affinity=node_aff(
+            NodeSelectorRequirement("example.com/cpu", "Lt", ["8"])))]
+        res = solve(solver_cls, pods, sized_catalog(), pools=[sized_pool()])
+        assert res.all_pods_scheduled()
+        names = {it.name for c in res.new_claims for it in c.instance_types}
+        assert names == {"c2"}
+
+    def test_gt_unsatisfiable(self, solver_cls):
+        pods = [pod("p0", affinity=node_aff(
+            NodeSelectorRequirement("example.com/cpu", "Gt", ["99"])))]
+        res = solve(solver_cls, pods, sized_catalog(), pools=[sized_pool()])
+        assert not res.all_pods_scheduled()
+
+
+class TestMinValues:
+    def test_min_values_keeps_enough_types(self, solver_cls):
+        # scheduling.go minValues: the claim must retain >= N distinct
+        # values of the keyed requirement
+        pods = [pod("p0", affinity=node_aff(
+            NodeSelectorRequirement(wk.INSTANCE_TYPE_LABEL, "Exists", [],
+                                    min_values=2)))]
+        res = solve(solver_cls, pods, sized_catalog())
+        assert res.all_pods_scheduled()
+        (claim,) = res.new_claims
+        assert len({it.name for it in claim.instance_types}) >= 2
+
+    def test_min_values_unsatisfiable_fails(self, solver_cls):
+        pods = [pod("p0", affinity=node_aff(
+            NodeSelectorRequirement(wk.INSTANCE_TYPE_LABEL, "Exists", [],
+                                    min_values=4)))]
+        res = solve(solver_cls, pods, sized_catalog())
+        assert not res.all_pods_scheduled()
+
+
+class TestDaemonOverhead:
+    def test_daemon_requests_reserve_capacity(self, solver_cls):
+        # NewScheduler's daemon overhead: each new node reserves the
+        # daemonset's requests before pods pack (suite_test.go daemonset)
+        pods = [pod(f"p{i}", cpu=0.5) for i in range(4)]
+        base = solve(solver_cls, pods, [make_instance_type("small", 4, 16)])
+        assert base.all_pods_scheduled() and base.node_count() == 1
+        res = solve(solver_cls, pods, [make_instance_type("small", 4, 16)],
+                    daemon_overhead={"default": {"cpu": 2.0, "memory": 1 * GIB}})
+        assert res.all_pods_scheduled()
+        # ~3.96 allocatable cpu minus 2 reserved -> 2 pods of 0.5 per node
+        assert res.node_count() == 2
+
+    def test_daemon_overhead_excludes_too_small_types(self, solver_cls):
+        pods = [pod("p0", cpu=1.5)]
+        res = solve(solver_cls, pods,
+                    [make_instance_type("tiny", 2, 8),
+                     make_instance_type("big", 8, 32)],
+                    daemon_overhead={"default": {"cpu": 1.0, "memory": 1 * GIB}})
+        assert res.all_pods_scheduled()
+        names = {it.name for c in res.new_claims for it in c.instance_types}
+        assert names == {"big"}
+
+
+class TestTaintsExtended:
+    def test_startup_taints_do_not_block(self, solver_cls):
+        # suite_test.go: startup taints are ignored for scheduling
+        np_ = nodepool()
+        np_.spec.template.startup_taints = [
+            Taint("node.cilium.io/agent-not-ready", "true", "NoExecute")]
+        pods = [pod("p0")]
+        res = solve(solver_cls, pods, [make_instance_type("m", 4, 16)],
+                    pools=[np_])
+        assert res.all_pods_scheduled()
+
+    def test_toleration_operator_exists(self, solver_cls):
+        np_ = nodepool()
+        np_.spec.template.taints = [Taint("dedicated", "gpu", "NoSchedule")]
+        tolerant = pod("t0", tolerations=[
+            Toleration(key="dedicated", operator="Exists")])
+        res = solve(solver_cls, [tolerant], [make_instance_type("m", 4, 16)],
+                    pools=[np_])
+        assert res.all_pods_scheduled()
+        intolerant = pod("x0")
+        res2 = solve(solver_cls, [intolerant], [make_instance_type("m", 4, 16)],
+                     pools=[np_])
+        assert not res2.all_pods_scheduled()
+
+
+class TestHostPorts:
+    def test_host_port_conflict_forces_two_nodes(self, solver_cls):
+        a = pod("a", host_ports=[("", 8080, "TCP")])
+        b = pod("b", host_ports=[("", 8080, "TCP")])
+        res = solve(solver_cls, [a, b], [make_instance_type("m", 8, 32)])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 2
+
+    def test_distinct_host_ports_share_node(self, solver_cls):
+        a = pod("a", host_ports=[("", 8080, "TCP")])
+        b = pod("b", host_ports=[("", 9090, "TCP")])
+        res = solve(solver_cls, [a, b], [make_instance_type("m", 8, 32)])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 1
+
+
+class TestRequestShapes:
+    def test_pod_overhead_counted(self, solver_cls):
+        # pod.spec.overhead joins the effective request (resources.go Merge)
+        p = pod("p0", cpu=1.0)
+        p.overhead = {"cpu": 3.5}
+        res = solve(solver_cls, [p], [make_instance_type("small", 4, 16),
+                                      make_instance_type("large", 16, 64)])
+        assert res.all_pods_scheduled()
+        names = {it.name for c in res.new_claims for it in c.instance_types}
+        assert names == {"large"}
+
+    def test_init_container_max_semantics(self, solver_cls):
+        # effective request = max(max(init), sum(containers)) (podresources)
+        p = Pod(metadata=ObjectMeta(name="p0"),
+                containers=[{"requests": {"cpu": 1.0, "memory": 1 * GIB}}],
+                init_containers=[{"requests": {"cpu": 6.0, "memory": 1 * GIB}}])
+        res = solve(solver_cls, [p], [make_instance_type("small", 4, 16),
+                                      make_instance_type("large", 16, 64)])
+        assert res.all_pods_scheduled()
+        names = {it.name for c in res.new_claims for it in c.instance_types}
+        assert names == {"large"}
+
+
+class TestOfferings:
+    def test_unavailable_offerings_filtered(self, solver_cls):
+        # an ICE'd zone/capacity offering cannot host (offering.available)
+        it = make_instance_type("m", 8, 32, zones=("zone-1", "zone-2"))
+        for o in it.offerings:
+            if o.zone == "zone-1":
+                o.available = False
+        pods = [pod("p0", node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-1"})]
+        res = solve(solver_cls, pods, [it])
+        assert not res.all_pods_scheduled()
+        pods2 = [pod("p1", node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"})]
+        res2 = solve(solver_cls, pods2, [it])
+        assert res2.all_pods_scheduled()
+
+    def test_fully_ice_type_skipped_for_alternative(self, solver_cls):
+        dead = make_instance_type("dead", 8, 32)
+        for o in dead.offerings:
+            o.available = False
+        live = make_instance_type("live", 8, 32)
+        res = solve(solver_cls, [pod("p0")], [dead, live])
+        assert res.all_pods_scheduled()
+        names = {it.name for c in res.new_claims for it in c.instance_types}
+        assert names == {"live"}
