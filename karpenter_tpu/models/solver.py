@@ -70,9 +70,44 @@ class HostSolver(Solver):
 SHARD_MIN_WORK = 1 << 21
 
 
+def _packed_kernel(max_bins: int, use_pallas: bool = False):
+    """Jitted solve kernel with all outputs flattened into ONE int32
+    buffer: over a tunneled chip every separate device->host array pays a
+    full ~64ms round trip, which dominates these small tensors.
+
+    Module-level cache: solver instances come and go (every Environment
+    builds one), but the jit wrapper must be shared or each instance
+    re-traces the scan — the dominant cost of a test suite with hundreds
+    of environments."""
+    cached = _PACKED_KERNELS.get((max_bins, use_pallas))
+    if cached is not None:
+        return cached
+
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops import kernels
+
+    def packed(args):
+        out = kernels.solve_step(args, max_bins=max_bins, use_pallas=use_pallas)
+        return jnp.concatenate([
+            out["assign"].ravel(),
+            out["assign_e"].ravel(),
+            out["used"].astype(jnp.int32),
+            out["tmpl"],
+            out["F"].astype(jnp.int32).ravel(),
+        ])
+
+    fn = jax.jit(packed)
+    _PACKED_KERNELS[(max_bins, use_pallas)] = fn
+    return fn
+
+
+_PACKED_KERNELS: dict = {}
+
+
 class TPUSolver(Solver):
     def __init__(self):
-        self._compiled = {}
         self.host = HostSolver()
         self.last_device_stats: dict = {}
         self._mesh = None
@@ -96,29 +131,14 @@ class TPUSolver(Solver):
         return self._mesh
 
     def _kernel(self, key):
-        if key not in self._compiled:
-            import jax
-            import jax.numpy as jnp
+        # the pallas toggle resolves HOST-side per call and keys the cache:
+        # a trace-time env read would freeze the first solve's choice into
+        # the module-lifetime jit wrapper
+        import os
 
-            from karpenter_tpu.ops import kernels
-
-            max_bins = key[-1]
-
-            def packed(args):
-                # all outputs flattened into ONE int32 buffer: over a
-                # tunneled chip every separate device->host array pays a
-                # full round trip, which dominates these small tensors
-                out = kernels.solve_step(args, max_bins=max_bins)
-                return jnp.concatenate([
-                    out["assign"].ravel(),
-                    out["assign_e"].ravel(),
-                    out["used"].astype(jnp.int32),
-                    out["tmpl"],
-                    out["F"].astype(jnp.int32).ravel(),
-                ])
-
-            self._compiled[key] = jax.jit(packed)
-        return self._compiled[key]
+        return _packed_kernel(
+            key[-1], os.environ.get("KARPENTER_PALLAS") == "1"
+        )
 
     def solve(
         self,

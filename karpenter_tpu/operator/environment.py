@@ -54,10 +54,16 @@ class Environment:
         # leader election gates every reconcile round (operator.go
         # LeaderElection): a single-instance environment always holds the
         # lease; a standby Environment sharing the store stays passive
+        import uuid
+
         from karpenter_tpu.operator.leaderelection import LeaderElector
 
+        # identity must be unique per INSTANCE lifetime: id(self) is reused
+        # after GC, which would let a new instance inherit a dead leader's
+        # lease and skip the takeover resync
         self.elector = LeaderElector(
-            self.store, identity=f"karpenter-{id(self):x}", clock=self.clock
+            self.store, identity=f"karpenter-{uuid.uuid4().hex[:12]}",
+            clock=self.clock,
         )
         # sync mode collapses the batch window so tests drive deterministically
         batcher = (
@@ -164,8 +170,11 @@ class Environment:
         if leading and not was_leader:
             # takeover: warm the informer cache from the store snapshot —
             # the hermetic store's event queue is single-consumer, so a
-            # standby has not seen the events the old leader drained
+            # standby has not seen the events the old leader drained — and
+            # arm the batcher: pod events the old leader consumed but never
+            # finished reconciling must not strand pending pods
             self.cluster.resync()
+            self.provisioner.trigger()
         if not leading:
             return False  # standby: hold position until the lease frees
         progressed = False

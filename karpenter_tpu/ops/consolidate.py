@@ -46,7 +46,7 @@ def _batched_kernel(max_bins: int):
     from karpenter_tpu.ops import kernels
 
     def probe(args):
-        out = kernels.solve_step(args, max_bins=max_bins)
+        out = kernels.solve_step(args, max_bins=max_bins, use_pallas=False)
         placed = out["assign"].sum() + out["assign_e"].sum()
         return placed, out["used"].sum()
 

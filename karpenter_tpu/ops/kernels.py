@@ -50,6 +50,7 @@ def feasibility(
     g_tol=None,  # [G,K] bool NotIn/DoesNotExist operators
     t_tol=None,  # [T,K] bool
     m_tol=None,  # [M,K] bool
+    use_pallas: bool = False,  # route compat through the Mosaic kernel
 ):
     """Returns (F [G,T] bool, price [G,T] f32, tmpl_full [G,M] bool)."""
     G, K, W = g_mask.shape
@@ -61,18 +62,29 @@ def feasibility(
     if m_tol is None:
         m_tol = jnp.zeros((m_mask.shape[0], K), dtype=bool)
 
-    # requirement overlap, key by key (K is small; the python loop unrolls
-    # into fused vector ops — no [G,T,K,W] intermediate is materialized).
-    # An empty meet is tolerated iff BOTH operators are NotIn/DoesNotExist
-    # (requirements.py Intersects:249), matching the host engine exactly.
-    compat = jnp.ones((G, T), dtype=bool)
-    for k in range(K):
-        ov = jnp.zeros((G, T), dtype=bool)
-        for w in range(W):
-            ov = ov | ((g_mask[:, None, k, w] & t_mask[None, :, k, w]) != 0)
-        ov = ov | (g_tol[:, None, k] & t_tol[None, :, k])
-        both = g_has[:, None, k] & t_has[None, :, k]
-        compat = compat & (~both | ov)
+    # requirement overlap, key by key. An empty meet is tolerated iff BOTH
+    # operators are NotIn/DoesNotExist (requirements.py Intersects:249),
+    # matching the host engine exactly. Two equivalent formulations:
+    # the hand-tiled Pallas kernel (single-word vocabularies, unsharded,
+    # KARPENTER_PALLAS=1) or the jnp loop XLA fuses (K is small; the
+    # python loop unrolls into fused vector ops — no [G,T,K,W]
+    # intermediate is materialized).
+    if use_pallas and W == 1 and K <= 128:
+        from karpenter_tpu.ops.pallas_kernels import compat_pallas
+
+        compat = compat_pallas(
+            g_mask[:, :, 0].astype(jnp.int32), g_has, g_tol,
+            t_mask[:, :, 0].astype(jnp.int32), t_has, t_tol,
+        )
+    else:
+        compat = jnp.ones((G, T), dtype=bool)
+        for k in range(K):
+            ov = jnp.zeros((G, T), dtype=bool)
+            for w in range(W):
+                ov = ov | ((g_mask[:, None, k, w] & t_mask[None, :, k, w]) != 0)
+            ov = ov | (g_tol[:, None, k] & t_tol[None, :, k])
+            both = g_has[:, None, k] & t_has[None, :, k]
+            compat = compat & (~both | ov)
 
     # resource fit: every demanded resource within allocatable
     fits = jnp.all(g_demand[:, None, :] <= t_alloc[None, :, :] + _EPS, axis=-1)
@@ -456,7 +468,8 @@ def pack(
     )
 
 
-def solve_step(args: dict, max_bins: int, with_existing: bool | None = None) -> dict:
+def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
+               use_pallas: bool | None = None) -> dict:
     """The full single-call solve: feasibility + pack over one snapshot's
     arg dict (the canonical invocation shared by the solver, the sharded
     path, and the graft entry)."""
@@ -501,6 +514,14 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None) -> 
         args["e_decl"] = jnp.zeros((E, CW), dtype=jnp.uint32)
     if "e_match" not in args:
         args["e_match"] = jnp.zeros((E, CW), dtype=jnp.uint32)
+    if use_pallas is None:
+        # opt-in; NOTE callers that cache jitted wrappers must resolve the
+        # flag HOST-side and key their cache on it (models/solver.py does)
+        # or the first trace freezes the choice — vmapped/sharded callers
+        # pass False explicitly
+        import os
+
+        use_pallas = os.environ.get("KARPENTER_PALLAS") == "1"
     F, price, tmpl_full = feasibility(
         args["g_mask"], args["g_has"], args["g_demand"],
         args["t_mask"], args["t_has"], args["t_alloc"],
@@ -509,6 +530,7 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None) -> 
         args["g_tmpl_ok"], args["m_mask"], args["m_has"],
         g_tol=args.get("g_tol"), t_tol=args.get("t_tol"),
         m_tol=args.get("m_tol"),
+        use_pallas=use_pallas,
     )
     out = pack(
         args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,

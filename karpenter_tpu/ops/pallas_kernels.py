@@ -1,0 +1,94 @@
+"""Pallas TPU kernel for the requirement-compatibility hot op.
+
+`compat[G,T]` — the inner op of `ops.kernels.feasibility` — is a bitwise
+"masked matmul": for every (group, type) pair, AND over requirement keys of
+(both-defined ⇒ mask overlap ∨ both-NotIn tolerance). XLA fuses the jnp
+formulation well, but the op is also the perfect Pallas shape: a 2D grid of
+(8 × 128) tiles doing pure VPU bitwise work with one lane-reduction, no
+matmul unit involved (see /opt/skills/guides/pallas_guide.md — grid over
+G/8 × T/128, masks padded to the 128-lane register width).
+
+Scope: the single-word vocabulary case (W == 1, i.e. ≤32 interned values
+per key — the overwhelmingly common catalog shape); wider vocabularies
+keep the jnp path. Enabled with KARPENTER_PALLAS=1 on a real TPU;
+`interpret=True` runs the same kernel on CPU for the parity tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+TILE_G = 8
+TILE_T = 128
+LANES = 128  # key axis padded to the register width
+
+
+def _pad_axis(a, axis, target):
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, target - a.shape[axis])
+    return jnp.pad(a, pad)
+
+
+def _compat_kernel(gm_ref, gh_ref, gtol_ref, tm_ref, th_ref, ttol_ref, out_ref):
+    tm = tm_ref[...]  # [TILE_T, LANES] i32 masks
+    th = th_ref[...]  # [TILE_T, LANES] i32 0/1
+    ttol = ttol_ref[...]
+    for i in range(TILE_G):  # static unroll: 8 rows of (128,128) VPU work
+        gm = gm_ref[i, :][None, :]
+        gh = gh_ref[i, :][None, :]
+        gtol = gtol_ref[i, :][None, :]
+        both = gh & th
+        ov = (gm & tm) != 0
+        tol = (gtol & ttol) != 0
+        bad = both & jnp.logical_not(ov) & jnp.logical_not(tol)
+        out_ref[i, :] = (jnp.sum(bad.astype(jnp.int32), axis=1) == 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def compat_pallas(g_mask, g_has, g_tol, t_mask, t_has, t_tol, *, interpret=False):
+    """compat [G,T] bool via Pallas. Inputs: g_mask/t_mask [G|T, K] i32
+    single-word value masks; g_has/t_has/g_tol/t_tol [G|T, K] bool."""
+    from jax.experimental import pallas as pl
+
+    G, K = g_mask.shape
+    T = t_mask.shape[0]
+    Gp = -(-G // TILE_G) * TILE_G
+    Tp = -(-T // TILE_T) * TILE_T
+
+    def prep(mask, has, tol, n_pad):
+        m = _pad_axis(_pad_axis(mask.astype(jnp.int32), 1, LANES), 0, n_pad)
+        h = _pad_axis(_pad_axis(has.astype(jnp.int32), 1, LANES), 0, n_pad)
+        t = _pad_axis(_pad_axis(tol.astype(jnp.int32), 1, LANES), 0, n_pad)
+        return m, h, t
+
+    gm, gh, gtol = prep(g_mask, g_has, g_tol, Gp)
+    tm, th, ttol = prep(t_mask, t_has, t_tol, Tp)
+
+    out = pl.pallas_call(
+        _compat_kernel,
+        grid=(Gp // TILE_G, Tp // TILE_T),
+        in_specs=[
+            pl.BlockSpec((TILE_G, LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_G, LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_G, LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_T, LANES), lambda i, j: (j, 0)),
+            pl.BlockSpec((TILE_T, LANES), lambda i, j: (j, 0)),
+            pl.BlockSpec((TILE_T, LANES), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_G, TILE_T), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Gp, Tp), jnp.bool_),
+        interpret=interpret,
+    )(gm, gh, gtol, tm, th, ttol)
+    return out[:G, :T]
+
+
+def compat_reference(g_mask, g_has, g_tol, t_mask, t_has, t_tol):
+    """The jnp formulation (mirrors ops.kernels.feasibility's compat loop)
+    — the oracle for the Pallas kernel."""
+    ov = (g_mask[:, None, :] & t_mask[None, :, :]) != 0
+    tol = g_tol[:, None, :] & t_tol[None, :, :]
+    both = g_has[:, None, :] & t_has[None, :, :]
+    return jnp.all(~both | ov | tol, axis=-1)

@@ -29,7 +29,8 @@ MODEL_AXIS = "model"
 def _jitted_solve_step(max_bins: int):
     """One jitted executable per max_bins; jax.jit's own cache handles the
     per-shape/per-sharding specializations under it."""
-    return jax.jit(functools.partial(kernels.solve_step, max_bins=max_bins))
+    return jax.jit(functools.partial(kernels.solve_step, max_bins=max_bins,
+                                     use_pallas=False))
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:

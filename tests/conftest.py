@@ -23,6 +23,13 @@ try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # pallas registers its "tpu" MLIR lowerings at import; that must happen
+    # while the plugin platform is still known, BEFORE the factories are
+    # popped below (the kernels themselves run in interpret mode on CPU)
+    try:
+        from jax.experimental import pallas  # noqa: F401
+    except Exception:
+        pass
     from jax._src import xla_bridge
 
     for _plat in ("axon", "tpu"):
