@@ -518,10 +518,14 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
         # opt-in; NOTE callers that cache jitted wrappers must resolve the
         # flag HOST-side and key their cache on it (models/solver.py does)
         # or the first trace freezes the choice — vmapped/sharded callers
-        # pass False explicitly
+        # pass False explicitly. Mosaic only compiles for TPU, so non-TPU
+        # backends always take the jnp path.
         import os
 
-        use_pallas = os.environ.get("KARPENTER_PALLAS") == "1"
+        use_pallas = (
+            os.environ.get("KARPENTER_PALLAS") == "1"
+            and jax.default_backend() not in ("cpu", "gpu")
+        )
     F, price, tmpl_full = feasibility(
         args["g_mask"], args["g_has"], args["g_demand"],
         args["t_mask"], args["t_has"], args["t_alloc"],

@@ -1,0 +1,207 @@
+"""Extended lifecycle-ring specs toward the reference's nodeclaim
+lifecycle/termination suites (pkg/controllers/nodeclaim/lifecycle,
+node/termination): registration-liveness TTL, ICE handling, PDB-blocked
+eviction retry, startup-taint clearing, drift/expiration conditions, hash
+propagation.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodeclaim import (
+    COND_DRIFTED,
+    COND_EXPIRED,
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+)
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Deployment,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    Taint,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.operator import Environment
+
+# import AFTER Environment: lifecycle -> operator.metrics -> operator
+# package -> environment -> lifecycle is a cycle when entered from the
+# controller side
+from karpenter_tpu.controllers.nodeclaim.lifecycle import REGISTRATION_TTL  # noqa: E402
+
+GIB = 2**30
+
+
+@pytest.fixture
+def env():
+    return Environment(instance_types=[make_instance_type("small", 2, 8),
+                                       make_instance_type("large", 16, 64)])
+
+
+def nodepool(**kw):
+    np_ = NodePool(metadata=ObjectMeta(name="default"))
+    for k, v in kw.items():
+        setattr(np_.spec.template, k, v)
+    return np_
+
+
+def pod(name, cpu=1.0, labels=None, **kw):
+    return Pod(metadata=ObjectMeta(name=name, labels=labels or {"app": name}),
+               requests={"cpu": cpu, "memory": 0.5 * GIB}, **kw)
+
+
+def live_nodes(env):
+    return [n for n in env.store.list("nodes")
+            if n.metadata.deletion_timestamp is None]
+
+
+class TestLifecycleConditions:
+    def test_full_condition_ladder(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p0"))
+        (claim,) = env.store.list("nodeclaims")
+        assert claim.is_true(COND_LAUNCHED)
+        assert claim.is_true(COND_REGISTERED)
+        assert claim.is_true(COND_INITIALIZED)
+        assert claim.status.provider_id
+
+    def test_startup_taints_cleared_on_initialize(self, env):
+        env.create("nodepools", nodepool(
+            startup_taints=[Taint("node.cilium.io/agent-not-ready", "true",
+                                  "NoExecute")]))
+        env.provision(pod("p0"))
+        (node,) = live_nodes(env)
+        assert all(t.key != "node.cilium.io/agent-not-ready" for t in node.taints)
+
+    def test_registration_liveness_ttl_reaps_claim(self, env):
+        """A claim whose node never registers is deleted after the 15-min
+        liveness TTL and re-provisioned (liveness.go:40-58)."""
+        env.create("nodepools", nodepool())
+        # sabotage registration: the provider launches but never materializes
+        # a Node (strip the kwok node after launch)
+        env.create("pods", pod("p0"))
+        orig = env.cloud.create
+
+        def launch_without_node(nc):
+            claim = orig(nc)
+            # vaporize the backing node out from under the claim (the
+            # cloud "launched" an instance that never joins the cluster)
+            env.store._objects["nodes"].clear()
+            return claim
+
+        env.cloud.create = launch_without_node
+        env.run_until_idle()
+        claims = env.store.list("nodeclaims")
+        assert claims and not claims[0].is_true(COND_REGISTERED)
+        first_claim = claims[0].name
+        env.cloud.create = orig  # capacity recovers
+        for _ in range(5):
+            env.clock.step(REGISTRATION_TTL + 1.0)
+            env.run_until_idle(max_rounds=200)
+            pods = env.store.list("pods")
+            if pods and all(p.node_name for p in pods):
+                break
+        # stuck claim reaped; the pod landed on a fresh, registered claim
+        names = {c.name for c in env.store.list("nodeclaims")}
+        assert first_claim not in names
+        pods = env.store.list("pods")
+        assert pods and all(p.node_name for p in pods)
+
+
+class TestTermination:
+    def test_pdb_blocks_drain_until_released(self, env):
+        env.create("nodepools", nodepool())
+        env.create("pdbs", PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            selector=LabelSelector(match_labels={"app": "guarded"}),
+            min_available=1))
+        env.create("deployments", Deployment(
+            metadata=ObjectMeta(name="guarded"), replicas=1,
+            template=pod("guarded", labels={"app": "guarded"})))
+        env.run_until_idle()
+        (node,) = live_nodes(env)
+        env.store.delete("nodes", node)  # begin graceful termination
+        env.run_until_idle(max_rounds=30)
+        # eviction 429s: the node survives with its finalizer, pod unevicted
+        assert any(n.metadata.name == node.metadata.name
+                   for n in env.store.list("nodes"))
+        assert env.recorder.by_reason("EvictionBlocked")
+        bound = [p for p in env.store.list("pods")
+                 if p.metadata.deletion_timestamp is None]
+        assert len(bound) == 1
+        # PDB released: drain completes, node goes away; the deployment's
+        # replacement pod reschedules
+        env.store.delete("pdbs", env.store.list("pdbs")[0])
+        env.clock.step(30.0)
+        env.run_until_idle(max_rounds=100)
+        assert all(n.metadata.name != node.metadata.name
+                   for n in env.store.list("nodes"))
+
+    def test_daemonset_pods_not_evicted(self, env):
+        from karpenter_tpu.api.objects import DaemonSet
+
+        env.create("nodepools", nodepool())
+        env.create("daemonsets", DaemonSet(
+            metadata=ObjectMeta(name="logging"),
+            template=pod("logging", cpu=0.1)))
+        env.create("deployments", Deployment(
+            metadata=ObjectMeta(name="app"), replicas=1,
+            template=pod("app", cpu=0.5)))
+        env.run_until_idle()
+        (node,) = live_nodes(env)
+        env.store.delete("nodes", node)
+        env.run_until_idle(max_rounds=100)
+        # the workload pod rescheduled; no daemonset eviction event exists
+        assert not any("logging" in e.message
+                       for e in env.recorder.by_reason("EvictionBlocked"))
+
+
+class TestDriftAndExpiration:
+    def test_nodepool_hash_change_drifts_claims(self, env):
+        np_ = nodepool()
+        env.create("nodepools", np_)
+        env.provision(pod("p0"))
+        (claim,) = env.store.list("nodeclaims")
+        assert not claim.is_true(COND_DRIFTED)
+        np_.spec.template.labels = {"team": "new"}
+        env.store.update("nodepools", np_)
+        env.run_until_idle()
+        (claim,) = env.store.list("nodeclaims")
+        assert claim.is_true(COND_DRIFTED)
+
+    def test_expire_after_sets_expired(self, env):
+        np_ = nodepool()
+        np_.spec.disruption.expire_after = 3600.0
+        env.create("nodepools", np_)
+        env.provision(pod("p0"))
+        (claim,) = env.store.list("nodeclaims")
+        assert not claim.is_true(COND_EXPIRED)
+        env.clock.step(3601.0)
+        env.run_until_idle()
+        (claim,) = env.store.list("nodeclaims")
+        assert claim.is_true(COND_EXPIRED)
+
+    def test_cloud_provider_drift_reason(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p0"))
+        inner = getattr(env.cloud, "inner", env.cloud)
+        inner.is_drifted = lambda nc: "ImageDrift"
+
+        env.run_until_idle()
+        (claim,) = env.store.list("nodeclaims")
+        assert claim.is_true(COND_DRIFTED)
+
+
+class TestHashPropagation:
+    def test_claims_stamped_with_pool_hash(self, env):
+        np_ = nodepool()
+        env.create("nodepools", np_)
+        env.provision(pod("p0"))
+        np_ = env.store.get("nodepools", "default")
+        (claim,) = env.store.list("nodeclaims")
+        want = np_.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION)
+        assert want
+        assert claim.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION) == want
