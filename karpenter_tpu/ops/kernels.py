@@ -468,6 +468,18 @@ def pack(
     )
 
 
+def pallas_enabled() -> bool:
+    """Opt-in (KARPENTER_PALLAS=1) AND a TPU backend: Mosaic only compiles
+    for TPU — every other platform (cpu, gpu, metal, future plugins) takes
+    the jnp path. The image's plugin platform reports as "axon"/"tpu"."""
+    import os
+
+    if os.environ.get("KARPENTER_PALLAS") != "1":
+        return False
+    backend = jax.default_backend()
+    return backend == "tpu" or "axon" in backend or "tpu" in backend
+
+
 def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
                use_pallas: bool | None = None) -> dict:
     """The full single-call solve: feasibility + pack over one snapshot's
@@ -515,17 +527,11 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
     if "e_match" not in args:
         args["e_match"] = jnp.zeros((E, CW), dtype=jnp.uint32)
     if use_pallas is None:
-        # opt-in; NOTE callers that cache jitted wrappers must resolve the
-        # flag HOST-side and key their cache on it (models/solver.py does)
-        # or the first trace freezes the choice — vmapped/sharded callers
-        # pass False explicitly. Mosaic only compiles for TPU, so non-TPU
-        # backends always take the jnp path.
-        import os
-
-        use_pallas = (
-            os.environ.get("KARPENTER_PALLAS") == "1"
-            and jax.default_backend() not in ("cpu", "gpu")
-        )
+        # NOTE callers that cache jitted wrappers must resolve the flag
+        # HOST-side and key their cache on it (models/solver.py does) or
+        # the first trace freezes the choice — vmapped/sharded callers
+        # pass False explicitly
+        use_pallas = pallas_enabled()
     F, price, tmpl_full = feasibility(
         args["g_mask"], args["g_has"], args["g_demand"],
         args["t_mask"], args["t_has"], args["t_alloc"],

@@ -1,0 +1,173 @@
+"""Internals specs: the relaxation ladder order, queue staleness, claim
+instance-type truncation, and recorder rate limiting — the reference's
+preferences/queue/nodeclaim/events unit suites.
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.models.preferences import Preferences
+
+GIB = 2**30
+
+
+def pod(name="p", **kw):
+    return Pod(metadata=ObjectMeta(name=name),
+               requests={"cpu": 1.0, "memory": 1 * GIB}, **kw)
+
+
+class TestRelaxationLadder:
+    def test_required_or_alternative_dropped_first(self):
+        # preferences.go:38 order: OR-alternatives before any preference
+        p = pod(affinity=Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(wk.ARCH_LABEL, "In", ["amd64"])]),
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(wk.ARCH_LABEL, "In", ["arm64"])]),
+                ],
+                preferred=[PreferredSchedulingTerm(
+                    weight=1,
+                    preference=NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(wk.OS_LABEL, "In", ["linux"])]))],
+            )))
+        assert Preferences().relax(p)
+        assert len(p.affinity.node_affinity.required) == 1
+        assert p.affinity.node_affinity.preferred  # untouched this step
+
+    def test_heaviest_preferred_pod_affinity_dropped(self):
+        terms = [
+            WeightedPodAffinityTerm(weight=10, pod_affinity_term=PodAffinityTerm(
+                topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                label_selector=LabelSelector(match_labels={"app": "light"}))),
+            WeightedPodAffinityTerm(weight=90, pod_affinity_term=PodAffinityTerm(
+                topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                label_selector=LabelSelector(match_labels={"app": "heavy"}))),
+        ]
+        p = pod(affinity=Affinity(pod_affinity=PodAffinity(preferred=list(terms))))
+        assert Preferences().relax(p)
+        left = p.affinity.pod_affinity.preferred
+        assert len(left) == 1
+        sel = left[0].pod_affinity_term.label_selector.match_labels
+        assert sel == {"app": "light"}, "heaviest term must drop first"
+
+    def test_schedule_anyway_spread_dropped(self):
+        p = pod(topology_spread_constraints=[TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels={"app": "x"}))])
+        assert Preferences().relax(p)
+        assert p.topology_spread_constraints == []
+
+    def test_do_not_schedule_spread_never_dropped(self):
+        p = pod(topology_spread_constraints=[TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "x"}))])
+        assert not Preferences().relax(p)
+        assert len(p.topology_spread_constraints) == 1
+
+    def test_ladder_exhausts(self):
+        p = pod(affinity=Affinity(node_affinity=NodeAffinity(
+            preferred=[PreferredSchedulingTerm(
+                weight=5,
+                preference=NodeSelectorTerm(match_expressions=[
+                    NodeSelectorRequirement(wk.OS_LABEL, "In", ["linux"])]))])))
+        assert Preferences().relax(p)
+        assert not Preferences().relax(p)
+
+
+class TestQueueStaleness:
+    def test_unrelaxed_requeue_eventually_stops(self):
+        from karpenter_tpu.models.queue import SchedulingQueue
+
+        pods = [pod("a"), pod("b")]
+        q = SchedulingQueue(pods)
+        first = q.pop()
+        # re-push WITHOUT relaxation: the queue must not yield it forever
+        seen = 0
+        q.push(first, relaxed=False)
+        while q.pop() is not None and seen < 50:
+            seen += 1
+        assert seen < 50, "unrelaxed requeue loops forever"
+
+    def test_relaxed_requeue_resets(self):
+        from karpenter_tpu.models.queue import SchedulingQueue
+
+        pods = [pod("a")]
+        q = SchedulingQueue(pods)
+        p = q.pop()
+        q.push(p, relaxed=True)
+        assert q.pop() is p  # a relaxed pod gets another full attempt
+
+
+class TestInstanceTypeTruncation:
+    def test_claims_truncate_to_sixty(self):
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+        from karpenter_tpu.models import ClaimTemplate, HostSolver
+
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        cat = [make_instance_type(f"t{i}", 4 + (i % 7), 16) for i in range(100)]
+        res = HostSolver().solve([pod("p0")], [ClaimTemplate(pool)],
+                                 {"default": cat})
+        res.truncate_instance_types()
+        (claim,) = res.new_claims
+        assert len(claim.instance_types) == 60  # nodeclaim.go MaxInstanceTypes
+
+    def test_truncation_respects_min_values(self):
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+        from karpenter_tpu.cloudprovider.types import truncate_instance_types
+        from karpenter_tpu.scheduling import Requirement, Requirements, EXISTS
+
+        cat = [make_instance_type(f"t{i}", 4, 16) for i in range(30)]
+        reqs = Requirements(
+            Requirement(wk.INSTANCE_TYPE_LABEL, EXISTS, min_values=20))
+        out, err = truncate_instance_types(cat, reqs, 10)
+        # cannot keep 20 distinct values in 10 slots: truncation must refuse
+        assert err
+
+
+class TestRecorderRateLimit:
+    def test_token_bucket_caps_burst(self):
+        from karpenter_tpu.operator.events import (
+            RATE_LIMIT_BURST,
+            Recorder,
+        )
+        from karpenter_tpu.utils.clock import FakeClock
+
+        r = Recorder(clock=FakeClock())
+        for i in range(RATE_LIMIT_BURST + 10):
+            r.publish("Spam", f"msg-{i}")  # distinct messages evade dedupe
+        assert len(r.events) == RATE_LIMIT_BURST
+        assert r.dropped == 10
+
+    def test_dedupe_counts_repeats(self):
+        from karpenter_tpu.operator.events import Recorder
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        r = Recorder(clock=clock)
+        r.publish("X", "same")
+        r.publish("X", "same")
+        r.publish("X", "same")
+        assert len(r.events) == 1
+        assert r.events[0].count == 3
+        clock.step(91.0)  # past the 90s TTL
+        r.publish("X", "same")
+        assert len(r.events) == 2
