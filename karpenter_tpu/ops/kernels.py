@@ -477,7 +477,7 @@ def pallas_enabled() -> bool:
     if os.environ.get("KARPENTER_PALLAS") != "1":
         return False
     backend = jax.default_backend()
-    return backend == "tpu" or "axon" in backend or "tpu" in backend
+    return "axon" in backend or "tpu" in backend
 
 
 def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,

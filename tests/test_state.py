@@ -176,3 +176,87 @@ class TestNomination:
         env.provisioner.trigger()
         env.provisioner.reconcile()
         assert len(env.store.list("nodeclaims")) == 1
+
+
+class TestStatePlaneExtended:
+    """§2.4 depth: nomination TTL, consolidation fence, resync parity,
+    anti-affinity index (cluster.go Synced/Nominate/ConsolidationState)."""
+
+    def test_nomination_expires_after_window(self, env):
+        from karpenter_tpu.state.statenode import NOMINATION_WINDOW
+
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        (sn,) = env.cluster.nodes()
+        env.cluster.nominate(sn.node.metadata.name)
+        # nodes() returns snapshots; read the LIVE state node for the flag
+        (live,) = env.cluster.state_nodes()
+        assert live.nominated(env.clock.now())
+        env.clock.step(NOMINATION_WINDOW + 1.0)
+        assert not live.nominated(env.clock.now())
+
+    def test_consolidation_fence_changes_on_state(self, env):
+        env.create("nodepools", nodepool())
+        before = env.cluster.consolidation_state()
+        env.provision(pod("p1"))
+        after = env.cluster.consolidation_state()
+        assert before != after, "cluster change must move the fence"
+        idle1 = env.cluster.consolidation_state()
+        idle2 = env.cluster.consolidation_state()
+        assert idle1 == idle2, "fence must be stable while nothing changes"
+
+    def test_resync_rebuilds_identical_view(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"), pod("p2"))
+        before = {
+            sn.provider_id: (sn.node.metadata.name, len(sn.pods))
+            for sn in env.cluster.nodes()
+        }
+        bindings_before = dict(env.cluster._bindings)
+        env.cluster.resync()
+        after = {
+            sn.provider_id: (sn.node.metadata.name, len(sn.pods))
+            for sn in env.cluster.nodes()
+        }
+        assert after == before
+        assert dict(env.cluster._bindings) == bindings_before
+        assert env.cluster.synced()
+
+    def test_anti_affinity_index_tracks_bound_pods(self, env):
+        from karpenter_tpu.api.objects import (
+            Affinity,
+            LabelSelector,
+            PodAffinity,
+            PodAffinityTerm,
+        )
+        from karpenter_tpu.api import labels as wk
+
+        env.create("nodepools", nodepool())
+        anti = pod("guard")
+        anti.metadata.labels = {"app": "guard"}
+        anti.affinity = Affinity(pod_anti_affinity=PodAffinity(required=[
+            PodAffinityTerm(topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                            label_selector=LabelSelector(
+                                match_labels={"app": "web"}))]))
+        env.provision(anti)
+        entries = list(env.cluster.pods_with_anti_affinity())
+        assert len(entries) == 1
+        p, labels = entries[0]
+        assert p.metadata.name == "guard"
+        assert labels.get(wk.TOPOLOGY_ZONE_LABEL)
+        # unbinding drops it from the index
+        env.store.delete("pods", env.store.list("pods")[0])
+        env.run_until_idle()
+        assert list(env.cluster.pods_with_anti_affinity()) == []
+
+    def test_synced_false_while_claim_unmirrored(self, env):
+        """A launched claim the mirror hasn't absorbed blocks the gate
+        (cluster.go Synced:85) — and the provisioner respects it."""
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        assert env.cluster.synced()
+        # simulate a watch lag: drop the claim from the mirror only
+        env.cluster._claim_name_to_pid.clear()
+        assert not env.cluster.synced()
+        env.cluster.resync()
+        assert env.cluster.synced()
