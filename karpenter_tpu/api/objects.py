@@ -189,6 +189,21 @@ class PersistentVolume:
 
 
 @dataclass
+class VolumeAttachment:
+    """storage.k8s.io VolumeAttachment: a CSI volume attached to a node.
+    The termination controller awaits these draining away before releasing
+    a node's finalizer (node/termination awaits volume detachment so
+    stateful workloads never lose data to an early instance delete).
+    Existence of the object is what blocks — the attach/detach controller
+    deletes it once the volume is unmounted."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    attacher: str = ""  # CSI driver name
+    node_name: str = ""
+    pv_name: str = ""  # spec.source.persistentVolumeName
+
+
+@dataclass
 class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""

@@ -32,15 +32,19 @@ from karpenter_tpu.controllers.disruption.queue import (
 
 POLL_PERIOD = 10.0  # controller.go:65
 VALIDATION_TTL = 15.0  # consolidation.go:44
+ABNORMAL_RUN_GAP = 15 * 60.0  # logAbnormalRuns threshold (controller.go:274-283)
 
 
 class DisruptionContext:
-    def __init__(self, provisioner, cluster, store, clock, options=None):
+    def __init__(self, provisioner, cluster, store, clock, options=None, registry=None):
+        from karpenter_tpu.operator import metrics as _m
+
         self.provisioner = provisioner
         self.cluster = cluster
         self.store = store
         self.clock = clock
         self.options = options or {}
+        self.registry = registry or _m.REGISTRY
 
 
 class DisruptionController:
@@ -69,7 +73,9 @@ class DisruptionController:
         self.recorder = recorder
         self.poll_period = poll_period
         self.validation_ttl = validation_ttl
-        self.ctx = DisruptionContext(provisioner, cluster, store, self.clock, options)
+        self.ctx = DisruptionContext(
+            provisioner, cluster, store, self.clock, options, registry=self.registry
+        )
         self.queue = OrchestrationQueue(store, cluster, self.clock, recorder)
         self.methods = [
             Drift(self.ctx),
@@ -93,6 +99,7 @@ class DisruptionController:
         now = self.clock.now()
         if now - self._last_run < self.poll_period:
             return progressed
+        self._log_abnormal_run(now)
         self._last_run = now
         if not self.cluster.synced():
             return progressed
@@ -100,6 +107,27 @@ class DisruptionController:
         if self._pending is not None:
             return self._handle_pending() or progressed
         return self._compute_round() or progressed
+
+    # -- watchdog (logAbnormalRuns, controller.go:274-283) ---------------
+    def _log_abnormal_run(self, now: float):
+        """Flag pathological gaps between disruption-loop runs — a method
+        that silently hangs (unbounded simulation, stuck cloud call) shows
+        up here long before anything else notices."""
+        from karpenter_tpu.operator import metrics as m
+
+        if self._last_run <= -1e17:  # first run ever
+            return
+        gap = now - self._last_run
+        if gap < ABNORMAL_RUN_GAP:
+            return
+        self.registry.counter(
+            m.DISRUPTION_ABNORMAL_RUNS, "disruption loop gaps exceeding 15 min"
+        ).inc()
+        if self.recorder is not None:
+            self.recorder.publish(
+                "AbnormalDisruptionRun",
+                f"disruption loop ran {gap:.0f}s after the previous run",
+            )
 
     # -- taint hygiene (controller.go:121-128) ---------------------------
     def _cleanup_orphan_taints(self):

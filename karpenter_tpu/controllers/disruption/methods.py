@@ -29,6 +29,8 @@ from karpenter_tpu.controllers.disruption.types import Command
 
 MULTI_NODE_CANDIDATE_CAP = 100  # multinodeconsolidation.go:82
 SPOT_TO_SPOT_MIN_TYPES = 15  # consolidation.go:253-277
+MULTI_NODE_TIMEOUT = 60.0  # multinodeconsolidation.go:37
+SINGLE_NODE_TIMEOUT = 180.0  # singlenodeconsolidation.go:46
 
 
 class Method:
@@ -186,13 +188,46 @@ def compute_consolidation(ctx, candidates) -> Command | None:
     return Command(candidates, replacements=[replacement], reason=REASON_UNDERUTILIZED)
 
 
+def filter_out_same_type(replacement, candidates) -> list:
+    """Price-sanity filter for m→1 replacements
+    (multinodeconsolidation.go:181-215): when the replacement's instance-type
+    options include a type we are deleting, drop every option that is not
+    strictly cheaper than the cheapest such overlapping node — otherwise the
+    "consolidation" would relaunch one of its own victims, which is just a
+    delete with extra churn."""
+    existing_prices: dict = {}
+    for c in candidates:
+        if c.instance_type is None:
+            continue
+        p = c.price
+        if p <= 0:
+            continue  # delisted offering: price unknown, can't anchor the filter
+        prev = existing_prices.get(c.instance_type.name)
+        if prev is None or p < prev:
+            existing_prices[c.instance_type.name] = p
+    max_price = float("inf")
+    for it in replacement.instance_types:
+        if it.name in existing_prices:
+            max_price = min(max_price, existing_prices[it.name])
+    if max_price == float("inf"):
+        return list(replacement.instance_types)
+    kept = []
+    for it in replacement.instance_types:
+        ofs = it.offerings.available().compatible(replacement.requirements)
+        if ofs and min(o.price for o in ofs) < max_price:
+            kept.append(it)
+    return kept
+
+
 class MultiNodeConsolidation(Method):
     """Largest N where candidates[0..N] collapse into ≤1 replacement
     (disruption/multinodeconsolidation.go:47-163). The prefix search runs
     as ONE batched device probe (ops/consolidate.py) — all N prefixes
     evaluated in a single vmapped pack call — with the winner re-validated
     by the full simulation; scenarios the probe can't express fall back to
-    the reference's sequential binary search."""
+    the reference's sequential binary search. The whole search is bounded
+    by a 1-minute wall clock (multinodeconsolidation.go:37): on timeout the
+    best command found so far is returned rather than searching unbounded."""
 
     reason = REASON_UNDERUTILIZED
     needs_validation = True
@@ -205,6 +240,7 @@ class MultiNodeConsolidation(Method):
         cands = within_budget(budgets, self.reason, cands)[:MULTI_NODE_CANDIDATE_CAP]
         if len(cands) < 2:
             return None
+        self._deadline = self.ctx.clock.now() + MULTI_NODE_TIMEOUT
 
         k = self._probe(cands)
         if k is not None:
@@ -215,17 +251,17 @@ class MultiNodeConsolidation(Method):
             # degenerates into the reference's binary search on the
             # remaining range — never a silently skipped consolidation
             if k < 2:
-                cmd = compute_consolidation(self.ctx, cands[:2])
-                if cmd is None or cmd.action == "no-op":
+                cmd = self._confirm(cands[:2])
+                if cmd is None:
                     return None  # probe confirmed: nothing consolidates
                 return self._binary_search(cands, hi=len(cands), lo=2, best=cmd)
-            cmd = compute_consolidation(self.ctx, cands[:k])
-            if cmd is not None and cmd.action != "no-op" and len(cmd.candidates) >= 2:
+            cmd = self._confirm(cands[:k])
+            if cmd is not None and len(cmd.candidates) >= 2:
                 if k < len(cands):
                     # one upward gallop step: if the probe truncated, resume
                     # the search above k, seeded with the confirmed command
-                    up = compute_consolidation(self.ctx, cands[: k + 1])
-                    if up is not None and up.action != "no-op":
+                    up = self._confirm(cands[: k + 1])
+                    if up is not None:
                         return self._binary_search(
                             cands, hi=len(cands), lo=k + 2, best=up
                         )
@@ -249,12 +285,39 @@ class MultiNodeConsolidation(Method):
         except Exception:
             return None
 
+    def _confirm(self, prefix):
+        """One real simulation of a candidate prefix, with the same-type
+        price filter applied to any replacement. None = prefix fails."""
+        cmd = compute_consolidation(self.ctx, prefix)
+        if cmd is None or cmd.action == "no-op":
+            return None
+        if cmd.action == "replace":
+            kept = filter_out_same_type(cmd.replacements[0], prefix)
+            if not kept:
+                return None
+            cmd.replacements[0].instance_types = kept
+        return cmd
+
+    def _timed_out(self) -> bool:
+        if self.ctx.clock.now() <= self._deadline:
+            return False
+        from karpenter_tpu.operator import metrics as m
+
+        self.ctx.registry.counter(
+            m.CONSOLIDATION_TIMEOUTS, "consolidation searches cut off by wall clock"
+        ).inc(type="multi")
+        return True
+
     def _binary_search(self, cands, hi, lo=1, best=None):
-        # binary search on prefix length (multinodeconsolidation.go:111-163)
+        # binary search on prefix length (multinodeconsolidation.go:111-163),
+        # returning the best-so-far command when the 1-min budget expires
+        # (:124-135)
         while lo <= hi:
+            if self._timed_out():
+                break
             mid = (lo + hi) // 2
-            cmd = compute_consolidation(self.ctx, cands[:mid])
-            if cmd is not None and cmd.action != "no-op":
+            cmd = self._confirm(cands[:mid])
+            if cmd is not None:
                 best = cmd
                 lo = mid + 1
             else:
@@ -265,8 +328,8 @@ class MultiNodeConsolidation(Method):
 
 
 class SingleNodeConsolidation(Method):
-    """Linear scan, one candidate at a time
-    (disruption/singlenodeconsolidation.go:47-120)."""
+    """Linear scan, one candidate at a time, abandoned after a 3-minute
+    wall clock (disruption/singlenodeconsolidation.go:46-120)."""
 
     reason = REASON_UNDERUTILIZED
     needs_validation = True
@@ -276,7 +339,16 @@ class SingleNodeConsolidation(Method):
         cands = _consolidatable(candidates)
         cands.sort(key=lambda c: c.disruption_cost)
         cands = within_budget(budgets, self.reason, cands)
+        deadline = self.ctx.clock.now() + SINGLE_NODE_TIMEOUT
         for c in cands:
+            if self.ctx.clock.now() > deadline:
+                from karpenter_tpu.operator import metrics as m
+
+                self.ctx.registry.counter(
+                    m.CONSOLIDATION_TIMEOUTS,
+                    "consolidation searches cut off by wall clock",
+                ).inc(type="single")
+                return None  # abandon mid-scan (:71-75)
             cmd = compute_consolidation(self.ctx, [c])
             if cmd is not None:
                 return cmd

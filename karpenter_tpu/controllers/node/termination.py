@@ -63,9 +63,44 @@ class NodeTerminationController:
                     )
         if draining:
             return progressed
+        if self._blocking_volume_attachments(node):
+            # drain done but CSI volumes still attached: hold the finalizer
+            # until the attach/detach controller catches up, so a stateful
+            # workload's data is flushed before the instance disappears
+            # (the reference's await-volume-detach step between drain and
+            # finalizer release)
+            if self.recorder is not None:
+                self.recorder.publish(
+                    "AwaitingVolumeDetachment",
+                    f"volumes still attached to {node.name}",
+                )
+            return progressed
         # drain complete: release the node
         node.metadata.finalizers = [
             f for f in node.metadata.finalizers if f != wk.TERMINATION_FINALIZER
         ]
         self.store.update("nodes", node)
         return True
+
+    def _blocking_volume_attachments(self, node) -> list:
+        """VolumeAttachments on this node that gate finalizer release.
+        Attachments whose PV is used only by pods that survive the drain
+        (daemonset- or node-owned) never detach, so they don't block."""
+        vas = [
+            va
+            for va in self.store.list("volumeattachments")
+            if va.node_name == node.name and va.metadata.deletion_timestamp is None
+        ]
+        if not vas:
+            return []
+        from karpenter_tpu.scheduling.volumes import VolumeUsage
+
+        undrainable_pvs = set()
+        for pod in self.store.list("pods"):
+            if pod.node_name != node.name:
+                continue
+            if not (pod.owned_by_daemonset() or pod_util.is_owned_by_node(pod)):
+                continue
+            for _driver, vol_id in VolumeUsage.pod_volumes(pod, kube=self.store):
+                undrainable_pvs.add(vol_id)
+        return [va for va in vas if va.pv_name not in undrainable_pvs]

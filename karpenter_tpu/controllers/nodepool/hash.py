@@ -31,11 +31,24 @@ class NodePoolHashController:
                 if ann.get(wk.NODEPOOL_HASH_VERSION_ANNOTATION) != HASH_VERSION:
                     # hash-version migration: re-stamp owned claims so a
                     # version bump alone never reads as drift
-                    # (hash/controller.go updateNodeClaimHash)
+                    # (hash/controller.go updateNodeClaimHash :89-106)
+                    from karpenter_tpu.api.nodeclaim import COND_DRIFTED
+
                     for claim in self.store.list("nodeclaims"):
                         if claim.metadata.labels.get(wk.NODEPOOL_LABEL) != np.name:
                             continue
-                        claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION] = h
+                        if (
+                            claim.metadata.annotations.get(
+                                wk.NODEPOOL_HASH_VERSION_ANNOTATION
+                            )
+                            == HASH_VERSION
+                        ):
+                            continue
+                        # an already-drifted claim keeps its stale hash: the
+                        # old hashing scheme is gone, so its drift verdict
+                        # cannot be re-derived and must stand
+                        if not claim.is_true(COND_DRIFTED):
+                            claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION] = h
                         claim.metadata.annotations[
                             wk.NODEPOOL_HASH_VERSION_ANNOTATION
                         ] = HASH_VERSION

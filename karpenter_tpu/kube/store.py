@@ -57,6 +57,7 @@ KINDS = (
     "pvcs",
     "pvs",
     "storageclasses",
+    "volumeattachments",
     "namespaces",
     "leases",
     "events",

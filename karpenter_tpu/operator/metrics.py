@@ -190,6 +190,8 @@ DISRUPTION_ACTIONS = f"{NAMESPACE}_disruption_actions_performed_total"
 DISRUPTION_ELIGIBLE_NODES = f"{NAMESPACE}_disruption_eligible_nodes"
 DISRUPTION_PODS = f"{NAMESPACE}_disruption_pods_disrupted_total"
 DISRUPTION_BUDGETS = f"{NAMESPACE}_disruption_allowed_disruptions"
+CONSOLIDATION_TIMEOUTS = f"{NAMESPACE}_disruption_consolidation_timeouts_total"
+DISRUPTION_ABNORMAL_RUNS = f"{NAMESPACE}_disruption_abnormal_runs_total"
 CLUSTER_STATE_SYNCED = f"{NAMESPACE}_cluster_state_synced"
 CLOUDPROVIDER_DURATION = f"{NAMESPACE}_cloudprovider_duration_seconds"
 CLOUDPROVIDER_ERRORS = f"{NAMESPACE}_cloudprovider_errors_total"

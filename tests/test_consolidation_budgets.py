@@ -1,0 +1,186 @@
+"""Consolidation wall-clock budgets + the same-type price-sanity filter.
+
+Scenario sources: the reference's timeout constants and search-abandonment
+behavior (disruption/multinodeconsolidation.go:37,124-135;
+singlenodeconsolidation.go:46,71-75) and filterOutSameType
+(multinodeconsolidation.go:181-215).
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from karpenter_tpu.api.nodepool import (
+    CONSOLIDATION_WHEN_UNDERUTILIZED,
+    REASON_UNDERUTILIZED,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.controllers.disruption import methods as methods_mod
+from karpenter_tpu.controllers.disruption.controller import DisruptionContext
+from karpenter_tpu.controllers.disruption.methods import (
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+    filter_out_same_type,
+)
+from karpenter_tpu.controllers.disruption.types import Command
+from karpenter_tpu.operator import metrics as m
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def stub_candidate(i, pool="default", instance_type=None, price=0.0):
+    return SimpleNamespace(
+        name=f"node-{i}",
+        provider_id=f"pid-{i}",
+        disruption_cost=float(i),
+        reschedulable_pods=[SimpleNamespace(uid=f"pod-{i}")],
+        node_pool=SimpleNamespace(
+            name=pool,
+            spec=SimpleNamespace(
+                disruption=SimpleNamespace(
+                    consolidation_policy=CONSOLIDATION_WHEN_UNDERUTILIZED
+                )
+            ),
+        ),
+        instance_type=instance_type,
+        price=price,
+    )
+
+
+@pytest.fixture
+def ctx():
+    clock = FakeClock(start=0.0)
+    registry = m.Registry()
+    return DisruptionContext(
+        provisioner=SimpleNamespace(),  # no .solver → device probe disabled
+        cluster=None,
+        store=None,
+        clock=clock,
+        registry=registry,
+    )
+
+
+BUDGETS = {"default": {REASON_UNDERUTILIZED: 1000}}
+
+
+class TestMultiNodeTimeout:
+    def test_timeout_returns_best_so_far(self, ctx, monkeypatch):
+        """Each simulation takes 25 s of fake time; the 1-min budget expires
+        mid-binary-search and the best command found so far is returned
+        instead of completing the search (multinodeconsolidation.go:124-135)."""
+        cands = [stub_candidate(i) for i in range(10)]
+
+        def slow_compute(_ctx, prefix):
+            ctx.clock.step(25.0)
+            return Command(prefix, reason=REASON_UNDERUTILIZED)
+
+        monkeypatch.setattr(methods_mod, "compute_consolidation", slow_compute)
+        method = MultiNodeConsolidation(ctx)
+        cmd = method.compute_command(list(cands), BUDGETS)
+        assert cmd is not None
+        # without the timeout an always-succeeding search reaches all 10
+        assert 2 <= len(cmd.candidates) < 10
+        counter = ctx.registry.counter(m.CONSOLIDATION_TIMEOUTS, "")
+        assert counter.value(type="multi") == 1
+
+    def test_no_timeout_completes_search(self, ctx, monkeypatch):
+        cands = [stub_candidate(i) for i in range(10)]
+        monkeypatch.setattr(
+            methods_mod,
+            "compute_consolidation",
+            lambda _ctx, prefix: Command(prefix, reason=REASON_UNDERUTILIZED),
+        )
+        method = MultiNodeConsolidation(ctx)
+        cmd = method.compute_command(list(cands), BUDGETS)
+        assert cmd is not None and len(cmd.candidates) == 10
+        counter = ctx.registry.counter(m.CONSOLIDATION_TIMEOUTS, "")
+        assert counter.value(type="multi") == 0
+
+
+class TestSingleNodeTimeout:
+    def test_timeout_abandons_scan(self, ctx, monkeypatch):
+        """Each per-candidate simulation takes 100 s; the 3-min budget
+        expires before the scan reaches the candidate that would have
+        consolidated (singlenodeconsolidation.go:71-75)."""
+        cands = [stub_candidate(i) for i in range(5)]
+
+        def slow_compute(_ctx, prefix):
+            ctx.clock.step(100.0)
+            if prefix[0].name == "node-2":
+                return Command(prefix, reason=REASON_UNDERUTILIZED)
+            return None
+
+        monkeypatch.setattr(methods_mod, "compute_consolidation", slow_compute)
+        method = SingleNodeConsolidation(ctx)
+        assert method.compute_command(list(cands), BUDGETS) is None
+        counter = ctx.registry.counter(m.CONSOLIDATION_TIMEOUTS, "")
+        assert counter.value(type="single") == 1
+
+    def test_fast_scan_finds_candidate(self, ctx, monkeypatch):
+        cands = [stub_candidate(i) for i in range(5)]
+
+        def fast_compute(_ctx, prefix):
+            if prefix[0].name == "node-2":
+                return Command(prefix, reason=REASON_UNDERUTILIZED)
+            return None
+
+        monkeypatch.setattr(methods_mod, "compute_consolidation", fast_compute)
+        method = SingleNodeConsolidation(ctx)
+        cmd = method.compute_command(list(cands), BUDGETS)
+        assert cmd is not None and cmd.candidates[0].name == "node-2"
+
+
+class TestFilterOutSameType:
+    def test_own_type_at_same_price_is_dropped(self):
+        """[large, large, small] → 1×{small, nano}: small is one of the
+        candidates, so only types strictly cheaper than the small node
+        survive (multinodeconsolidation.go:181-215)."""
+        small = make_instance_type("small", 2, 8)
+        nano = make_instance_type("nano", 1, 2)
+        large = make_instance_type("large", 16, 64)
+        small_price = min(o.price for o in small.offerings)
+        cands = [
+            stub_candidate(0, instance_type=large, price=1.0),
+            stub_candidate(1, instance_type=large, price=1.0),
+            stub_candidate(2, instance_type=small, price=small_price),
+        ]
+        replacement = SimpleNamespace(
+            instance_types=[small, nano], requirements=Requirements()
+        )
+        kept = filter_out_same_type(replacement, cands)
+        assert [it.name for it in kept] == ["nano"]
+
+    def test_no_overlap_keeps_everything(self):
+        small = make_instance_type("small", 2, 8)
+        nano = make_instance_type("nano", 1, 2)
+        large = make_instance_type("large", 16, 64)
+        cands = [stub_candidate(0, instance_type=large, price=1.0)]
+        replacement = SimpleNamespace(
+            instance_types=[small, nano], requirements=Requirements()
+        )
+        kept = filter_out_same_type(replacement, cands)
+        assert [it.name for it in kept] == ["small", "nano"]
+
+    def test_replacement_never_launches_own_type(self, ctx, monkeypatch):
+        """A simulated m→1 replacement whose only option is a candidate's
+        own type is rejected outright — equivalent to the reference skipping
+        the prefix (replacementHasValidInstanceTypes=false)."""
+        small = make_instance_type("small", 2, 8)
+        small_price = min(o.price for o in small.offerings)
+        cands = [
+            stub_candidate(0, instance_type=small, price=small_price),
+            stub_candidate(1, instance_type=small, price=small_price),
+        ]
+        replacement = SimpleNamespace(
+            instance_types=[small], requirements=Requirements()
+        )
+
+        monkeypatch.setattr(
+            methods_mod,
+            "compute_consolidation",
+            lambda _ctx, prefix: Command(
+                prefix, replacements=[replacement], reason=REASON_UNDERUTILIZED
+            ),
+        )
+        method = MultiNodeConsolidation(ctx)
+        assert method.compute_command(list(cands), BUDGETS) is None

@@ -1,0 +1,182 @@
+"""Termination/watchdog polish specs: volume-detach wait before finalizer
+release, the hash-version migration drift nuance, and the abnormal-run
+watchdog.
+
+Scenario sources: the reference's node/termination await-volume-detach step,
+nodepool/hash/controller.go:89-106 (drifted claims keep their stale hash
+across a hash-version bump), and disruption/controller.go:274-283
+(logAbnormalRuns).
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodeclaim import COND_DRIFTED
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimRef,
+    Pod,
+    VolumeAttachment,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.controllers.disruption.controller import ABNORMAL_RUN_GAP
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator import metrics as m
+
+GIB = 2**30
+
+
+@pytest.fixture
+def env():
+    return Environment(instance_types=[make_instance_type("small", 2, 8)])
+
+
+def nodepool():
+    return NodePool(metadata=ObjectMeta(name="default"))
+
+
+def pod(name, claims=(), **kw):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels={"app": name}),
+        requests={"cpu": 0.5, "memory": 0.25 * GIB},
+        volumes=[PersistentVolumeClaimRef(claim_name=c) for c in claims],
+        **kw,
+    )
+
+
+def node_names(env):
+    return {n.metadata.name for n in env.store.list("nodes")}
+
+
+class TestVolumeDetachWait:
+    def _stateful_node(self, env):
+        env.create("nodepools", nodepool())
+        env.create("pvs", PersistentVolume(metadata=ObjectMeta(name="pv-1")))
+        env.create(
+            "pvcs",
+            PersistentVolumeClaim(metadata=ObjectMeta(name="data"), volume_name="pv-1"),
+        )
+        env.provision(pod("app", claims=["data"]))
+        (node,) = env.store.list("nodes")
+        env.create(
+            "volumeattachments",
+            VolumeAttachment(
+                metadata=ObjectMeta(name="va-1"),
+                attacher="ebs.csi",
+                node_name=node.metadata.name,
+                pv_name="pv-1",
+            ),
+        )
+        return node
+
+    def test_attached_volume_holds_finalizer(self, env):
+        node = self._stateful_node(env)
+        env.store.delete("nodes", node)
+        env.run_until_idle(max_rounds=50)
+        # drain finished (pod evicted) but the CSI volume is still attached:
+        # the finalizer must not release until the attachment is gone
+        assert node.metadata.name in node_names(env)
+        assert wk.TERMINATION_FINALIZER in node.metadata.finalizers
+        assert env.recorder.by_reason("AwaitingVolumeDetachment")
+        # the attach/detach controller catches up
+        va = env.store.get("volumeattachments", "va-1")
+        env.store.delete("volumeattachments", va)
+        env.clock.step(30.0)
+        env.run_until_idle(max_rounds=50)
+        assert node.metadata.name not in node_names(env)
+
+    def test_daemonset_owned_volume_does_not_block(self, env):
+        env.create("nodepools", nodepool())
+        env.create("pvs", PersistentVolume(metadata=ObjectMeta(name="pv-ds")))
+        env.create(
+            "pvcs",
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="ds-data"), volume_name="pv-ds"
+            ),
+        )
+        env.provision(pod("app"))
+        (node,) = env.store.list("nodes")
+        # a daemonset pod with a volume rides the node down — its attachment
+        # will never detach before the node dies, so it must not block
+        ds_pod = pod("ds", claims=["ds-data"])
+        ds_pod.metadata.owner_references = [
+            {"kind": "DaemonSet", "name": "ds", "uid": "u1", "controller": True}
+        ]
+        ds_pod.node_name = node.metadata.name
+        env.create("pods", ds_pod)
+        env.create(
+            "volumeattachments",
+            VolumeAttachment(
+                metadata=ObjectMeta(name="va-ds"),
+                attacher="ebs.csi",
+                node_name=node.metadata.name,
+                pv_name="pv-ds",
+            ),
+        )
+        env.store.delete("nodes", node)
+        env.clock.step(30.0)
+        env.run_until_idle(max_rounds=100)
+        assert node.metadata.name not in node_names(env)
+
+
+class TestHashVersionMigration:
+    def test_drifted_claim_keeps_stale_hash(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p0"))
+        np_ = env.store.list("nodepools")[0]
+        claims = env.store.list("nodeclaims")
+        drifted, = claims
+        drifted.set_condition(COND_DRIFTED, reason="test")
+        # simulate a pre-migration world: old hash version + stale hash
+        np_.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION] = "v0"
+        drifted.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION] = "v0"
+        drifted.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION] = "stale"
+        env.run_until_idle()
+        # version bumped, but the drift verdict (and its hash basis) stands
+        assert (
+            drifted.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION]
+            == wk.NODEPOOL_HASH_VERSION
+        )
+        assert drifted.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION] == "stale"
+
+    def test_undrifted_claim_restamped_on_version_bump(self, env):
+        env.create("nodepools", nodepool())
+        env.provision(pod("p0"))
+        np_ = env.store.list("nodepools")[0]
+        claim, = env.store.list("nodeclaims")
+        np_.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION] = "v0"
+        claim.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION] = "v0"
+        claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION] = "stale"
+        env.run_until_idle()
+        assert claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION] == np_.static_hash()
+        assert not claim.is_true(COND_DRIFTED)
+
+
+class TestAbnormalRunWatchdog:
+    def test_gap_over_threshold_flagged(self):
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+        )
+        d = env.disruption
+        d.poll()  # first run: baseline, never abnormal
+        env.clock.step(ABNORMAL_RUN_GAP + 60.0)
+        d.poll()
+        counter = d.registry.counter(m.DISRUPTION_ABNORMAL_RUNS, "")
+        assert counter.value() == 1
+        assert env.recorder.by_reason("AbnormalDisruptionRun")
+
+    def test_normal_cadence_not_flagged(self):
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+        )
+        d = env.disruption
+        for _ in range(5):
+            d.poll()
+            env.clock.step(d.poll_period + 1.0)
+        counter = d.registry.counter(m.DISRUPTION_ABNORMAL_RUNS, "")
+        assert counter.value() == 0
