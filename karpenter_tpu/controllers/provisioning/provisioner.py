@@ -90,6 +90,27 @@ class StoreClusterView:
         ]
 
 
+def collect_domains(domains: dict, template, instance_types):
+    """Topology domain universe: values from instance-type requirements
+    compatible with the nodepool (provisioner.go:264-296). Shared by the
+    provisioner and the perf harness (which must assemble the same scheduler
+    inputs the product path does)."""
+    np_reqs = template.requirements
+    for key, req in np_reqs.items():
+        if not req.complement:
+            domains.setdefault(key, set()).update(req.values)
+    for it in instance_types:
+        if it.requirements.intersects(np_reqs) is not None:
+            continue
+        for key, req in it.requirements.items():
+            if req.complement:
+                continue
+            allowed = np_reqs.get_req(key)
+            vals = {v for v in req.values if allowed.has(v)}
+            if vals:
+                domains.setdefault(key, set()).update(vals)
+
+
 def nodepool_ready(np) -> bool:
     conds = getattr(np.status, "conditions", None) or []
     for c in conds:
@@ -256,22 +277,7 @@ class Provisioner:
         return templates, its_by_pool, overhead, limits, domains
 
     def _collect_domains(self, domains, template, instance_types):
-        """Topology domain universe: values from instance-type requirements
-        compatible with the nodepool (provisioner.go:264-296)."""
-        np_reqs = template.requirements
-        for key, req in np_reqs.items():
-            if not req.complement:
-                domains.setdefault(key, set()).update(req.values)
-        for it in instance_types:
-            if it.requirements.intersects(np_reqs) is not None:
-                continue
-            for key, req in it.requirements.items():
-                if req.complement:
-                    continue
-                allowed = np_reqs.get_req(key)
-                vals = {v for v in req.values if allowed.has(v)}
-                if vals:
-                    domains.setdefault(key, set()).update(vals)
+        collect_domains(domains, template, instance_types)
 
     def _daemon_overhead(self, template) -> dict:
         """Sum of daemonset pod requests that would land on this pool's

@@ -365,6 +365,8 @@ class TPUSolver(Solver):
             # count is 0 so they never take
             g_sown=pad(snap.g_sown, (Gp, snap.g_sown.shape[1])),
             g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
+            g_aneed=pad(snap.g_aneed, (Gp, snap.g_aneed.shape[1])),
+            g_amatch=pad(snap.g_amatch, (Gp, snap.g_amatch.shape[1])),
             t_mask=pad(snap.t_mask, (Tp, K, W)),
             t_has=pad(snap.t_has, (Tp, K)),
             t_tol=pad(snap.t_tol, (Tp, K)),
@@ -394,10 +396,12 @@ class TPUSolver(Solver):
                 e_scnt=pad(esnap.e_scnt, (Ep, esnap.e_scnt.shape[1])),
                 e_decl=pad(esnap.e_decl, (Ep, esnap.e_decl.shape[1])),
                 e_match=pad(esnap.e_match, (Ep, esnap.e_match.shape[1])),
+                e_aff=pad(esnap.e_aff, (Ep, esnap.e_aff.shape[1])),
             )
 
         key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], snap.g_decl.shape[1],
-               snap.g_sown.shape[1], Ep if esnap is not None else 0, Bp)
+               snap.g_sown.shape[1], snap.g_aneed.shape[1],
+               Ep if esnap is not None else 0, Bp)
         host = self._invoke(args, key, Bp)
         assign = host["assign"][:G, :Bp]
         used = host["used"]

@@ -79,7 +79,8 @@ def load():
             + [_u32p, _u8p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p,
                _u8p, _u32p, _u32p]                                # group side
             + [ctypes.c_int, _i32p, _u8p]                         # spread classes
-            + [ctypes.c_int, _f32p, _u8p, _i32p, _i32p, _u32p, _u32p]  # existing nodes
+            + [ctypes.c_int, _u8p, _u8p]                          # affinity classes
+            + [ctypes.c_int, _f32p, _u8p, _i32p, _i32p, _u32p, _u32p, _i32p]  # existing nodes
             + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]            # type side
             + [_i32p, _i32p, _u8p]                                # offerings
             + [_u32p, _u8p, _u8p, _f32p, _f32p]                   # templates
@@ -133,6 +134,15 @@ def solve_step(args: dict, max_bins: int) -> dict:
     )
     if g_smatch.shape != g_sown.shape:
         raise ValueError(f"g_sown/g_smatch shape mismatch: {g_sown.shape} vs {g_smatch.shape}")
+    A = np.asarray(args.get("g_aneed", args.get("g_amatch", np.zeros((G, 1))))).shape[1]
+    g_aneed = np.ascontiguousarray(
+        args.get("g_aneed", np.zeros((G, A), dtype=np.uint8)), dtype=np.uint8
+    )
+    g_amatch = np.ascontiguousarray(
+        args.get("g_amatch", np.zeros((G, A), dtype=np.uint8)), dtype=np.uint8
+    )
+    if g_amatch.shape != g_aneed.shape:
+        raise ValueError(f"g_aneed/g_amatch shape mismatch: {g_aneed.shape} vs {g_amatch.shape}")
     B = int(max_bins)
     # existing-node tensors (default: one inert zero-capacity node)
     e_avail = np.ascontiguousarray(
@@ -154,6 +164,11 @@ def solve_step(args: dict, max_bins: int) -> dict:
     e_match = np.ascontiguousarray(
         args.get("e_match", np.zeros((E, CW), dtype=np.uint32)), dtype=np.uint32
     )
+    e_aff = np.ascontiguousarray(
+        args.get("e_aff", np.zeros((E, A), dtype=np.int32)), dtype=np.int32
+    )
+    if e_aff.shape != (E, A):
+        raise ValueError(f"e_aff shape mismatch: {e_aff.shape} vs {(E, A)}")
 
     assign = np.zeros((G, B), dtype=np.int32)
     assign_e = np.zeros((G, E), dtype=np.int32)
@@ -180,7 +195,8 @@ def solve_step(args: dict, max_bins: int) -> dict:
         ),
         g_decl, g_match,
         C, g_sown, g_smatch,
-        E, e_avail, ge_ok, e_npods, e_scnt, e_decl, e_match,
+        A, g_aneed, g_amatch,
+        E, e_avail, ge_ok, e_npods, e_scnt, e_decl, e_match, e_aff,
         t_mask,
         np.ascontiguousarray(args["t_has"], dtype=np.uint8),
         np.ascontiguousarray(

@@ -38,7 +38,17 @@ struct Bin {
     std::vector<uint32_t> decl;       // [CW] hostname-anti classes declared
     std::vector<uint32_t> match;      // [CW] hostname-anti classes matched
     std::vector<int32_t> scnt;        // [C] spread-class matched-pod counts
+    std::vector<int32_t> aff;         // [A] affinity-class matched-pod counts
 };
+
+// hostname pod-affinity classes (mirrors ops/kernels.py baff): a group
+// OWNING class a may only land on bins whose matched count is already
+// positive (nextDomainAffinity options, topology.py:209)
+inline bool aff_ok(const Bin& bin, const uint8_t* aneed_g, int A) {
+    for (int a = 0; a < A; ++a)
+        if (aneed_g[a] && bin.aff[a] <= 0) return false;
+    return true;
+}
 
 // hostname anti-affinity conflict classes (mirrors ops/kernels.py:199-203):
 // a bin hosting pods MATCHED by class c excludes groups DECLARING c and
@@ -138,9 +148,11 @@ int karpenter_solve(
     const int32_t* g_bin_cap, const uint8_t* g_single,
     const uint32_t* g_decl, const uint32_t* g_match,
     int C, const int32_t* g_sown, const uint8_t* g_smatch,
+    int A, const uint8_t* g_aneed, const uint8_t* g_amatch,
     int E, const float* e_avail, const uint8_t* ge_ok,
     const int32_t* e_npods, const int32_t* e_scnt,
     const uint32_t* e_decl, const uint32_t* e_match,
+    const int32_t* e_aff,
     const uint32_t* t_mask, const uint8_t* t_has, const uint8_t* t_tol,
     const float* t_alloc,
     const float* t_cap, const int32_t* t_tmpl,
@@ -208,6 +220,7 @@ int karpenter_solve(
     std::vector<int32_t> escnt(e_scnt, e_scnt + (size_t)E * C);
     std::vector<uint32_t> edecl(e_decl, e_decl + (size_t)E * CW);
     std::vector<uint32_t> ematch(e_match, e_match + (size_t)E * CW);
+    std::vector<int32_t> eaff(e_aff, e_aff + (size_t)E * A);
     std::memset(assign_e, 0, sizeof(int32_t) * (size_t)G * E);
 
     std::vector<int> order;  // bin indices sorted by npods (emptiest first)
@@ -224,6 +237,10 @@ int karpenter_solve(
         const uint32_t* match_g = g_match + (size_t)g * CW;
         const int32_t* sown_g = g_sown + (size_t)g * C;
         const uint8_t* smatch_g = g_smatch + (size_t)g * C;
+        const uint8_t* aneed_g = g_aneed + (size_t)g * A;
+        const uint8_t* amatch_g = g_amatch + (size_t)g * A;
+        bool any_aneed = false;
+        for (int a = 0; a < A; ++a) any_aneed = any_aneed || aneed_g[a];
         int cap_own = 1 << 30;  // fresh-bin cap from owned spread classes
         for (int c = 0; c < C; ++c)
             if (sown_g[c] < SPREAD_UNCAPPED && smatch_g[c])
@@ -244,6 +261,8 @@ int karpenter_solve(
                 for (int w = 0; w < CW; ++w)
                     if ((ematch[(size_t)ei * CW + w] & decl_g[w]) ||
                         (edecl[(size_t)ei * CW + w] & match_g[w])) { aok = false; break; }
+                for (int a = 0; a < A && aok; ++a)
+                    if (aneed_g[a] && eaff[(size_t)ei * A + a] <= 0) aok = false;
                 if (!aok) continue;
                 int scap = 1 << 30;
                 for (int c = 0; c < C; ++c) {
@@ -262,6 +281,8 @@ int karpenter_solve(
                 for (int r = 0; r < R; ++r) eload[(size_t)ei * R + r] += take * d[r];
                 for (int c = 0; c < C; ++c)
                     if (smatch_g[c]) escnt[(size_t)ei * C + c] += take;
+                for (int a = 0; a < A; ++a)
+                    if (amatch_g[a]) eaff[(size_t)ei * A + a] += take;
                 for (int w = 0; w < CW; ++w) {
                     edecl[(size_t)ei * CW + w] |= decl_g[w];
                     ematch[(size_t)ei * CW + w] |= match_g[w];
@@ -283,6 +304,7 @@ int karpenter_solve(
                 Bin& bin = bins[bi];
                 if (!tmpl_full[(size_t)g * M + bin.tmpl]) continue;
                 if (!anti_ok(bin, decl_g, match_g, CW)) continue;
+                if (!aff_ok(bin, aneed_g, A)) continue;
                 if (!masks_compatible(bin.mask.data(), bin.has.data(), gm, gh, K, W))
                     continue;
                 int q = 0;
@@ -301,6 +323,7 @@ int karpenter_solve(
             Bin& bin = bins[bi];
             if (!tmpl_full[(size_t)g * M + bin.tmpl]) continue;
             if (!anti_ok(bin, decl_g, match_g, CW)) continue;
+            if (!aff_ok(bin, aneed_g, A)) continue;
             if (!masks_compatible(bin.mask.data(), bin.has.data(), gm, gh, K, W))
                 continue;
             // capacity = max over surviving types still feasible for g
@@ -337,6 +360,8 @@ int karpenter_solve(
             }
             for (int c = 0; c < C; ++c)
                 if (smatch_g[c]) bin.scnt[c] += take;
+            for (int a = 0; a < A; ++a)
+                if (amatch_g[a]) bin.aff[a] += take;
         }
 
         // new bins from the first (weight-ordered) feasible template.
@@ -344,8 +369,22 @@ int karpenter_solve(
         // landed on an existing bin (followers join the first pod's claim
         // or fail, topology.py:207 bootstrap)
         bool opened_for_single = false;
+        // affinity owners may open a fresh bin only to BOOTSTRAP: every
+        // owned class must be self-matched with zero matches anywhere, and
+        // the bootstrap opens exactly ONE bin (topology.py:211-221)
+        bool aff_new_ok = true;
+        if (any_aneed) {
+            for (int a = 0; a < A && aff_new_ok; ++a) {
+                if (!aneed_g[a]) continue;
+                long total = 0;
+                for (const Bin& bn : bins) total += bn.aff[a];
+                for (int ei = 0; ei < E; ++ei) total += eaff[(size_t)ei * A + a];
+                if (total > 0 || !amatch_g[a]) aff_new_ok = false;
+            }
+        }
         while (n > 0 && (int)bins.size() < B) {
             if (single && (n < g_count[g] || opened_for_single)) break;
+            if (any_aneed && (!aff_new_ok || opened_for_single)) break;
             int m_star = -1, per_node = 0;
             for (int m = 0; m < M && m_star < 0; ++m) {
                 if (!tmpl_full[(size_t)g * M + m]) continue;
@@ -383,6 +422,9 @@ int karpenter_solve(
             bin.scnt.assign(C, 0);
             for (int c = 0; c < C; ++c)
                 if (smatch_g[c]) bin.scnt[c] = take;
+            bin.aff.assign(A, 0);
+            for (int a = 0; a < A; ++a)
+                if (amatch_g[a]) bin.aff[a] = take;
             for (int r = 0; r < R; ++r) bin.load[r] += take * d[r];
             // candidate types: template's, feasible for g, limit-ok, fits load
             std::vector<float> worst(R, 0.0f);

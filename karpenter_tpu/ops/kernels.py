@@ -170,6 +170,8 @@ def pack(
     g_match,  # [G,CW] u32: hostname-anti classes whose selector matches it
     g_sown,  # [G,C] i32: per-bin cap where the group owns the spread class
     g_smatch,  # [G,C] bool: the spread class counts this group's pods
+    g_aneed,  # [G,A] bool: hostname-affinity classes the group owns
+    g_amatch,  # [G,A] bool: the affinity-class selector matches this group
     # existing/in-flight nodes as pre-loaded bins (existingnode.go:64)
     ge_ok,  # [G,E] bool: group admissible on node (taints + strict labels)
     e_avail,  # [E,R] f32: fixed available capacity (allocatable - usage)
@@ -177,6 +179,7 @@ def pack(
     e_scnt,  # [E,C] i32: spread-class counts from the nodes' current pods
     e_decl,  # [E,CW] u32: anti classes declared by current pods
     e_match,  # [E,CW] u32: anti classes matching current pods
+    e_aff,  # [E,A] i32: affinity-class matched-pod counts on the node
     # static catalog
     t_alloc,  # [T,R]
     t_cap,  # [T,R]
@@ -208,6 +211,15 @@ def pack(
     bscnt + take <= maxSkew — exact across co-owner groups and
     unconstrained same-label groups. Zone constraints ride the ordinary
     requirement masks as zone-pinned subgroups and need nothing here.
+
+    Hostname pod AFFINITY is per-bin class match counts `baff[b,a]`
+    (topologygroup.go nextDomainAffinity:219): a group OWNING class a may
+    only land on bins whose count is already positive; when the class has
+    no matches anywhere yet, a self-matching owner bootstraps exactly ONE
+    fresh bin (the host bootstrap, topology.py:211) and every later group
+    in the scan sees its count — cross-group chains resolve inside one
+    dispatch because the compiler (ops/waves.py) orders followers after
+    their targets.
     """
     G, R = g_demand.shape
     T = t_alloc.shape[0]
@@ -218,6 +230,7 @@ def pack(
 
     CW = g_decl.shape[1]
     C = g_sown.shape[1]
+    A = g_aneed.shape[1]
     # static per-type check: template overhead fits the type's allocatable
     # on EVERY dim (a group's d=0 dims never re-check it inside the scan)
     ovh_ok = jnp.all(m_overhead[t_tmpl] <= t_alloc + _EPS, axis=-1)  # [T]
@@ -233,6 +246,7 @@ def pack(
         bdecl=jnp.zeros((B, CW), dtype=jnp.uint32),
         bmatch=jnp.zeros((B, CW), dtype=jnp.uint32),
         bscnt=jnp.zeros((B, C), dtype=jnp.int32),
+        baff=jnp.zeros((B, A), dtype=jnp.int32),
     )
     if with_existing:
         state.update(
@@ -241,11 +255,13 @@ def pack(
             escnt=e_scnt.astype(jnp.int32),
             edecl=e_decl,
             ematch=e_match,
+            eaff=e_aff.astype(jnp.int32),
         )
 
     def step(state, xs):
         (d, n, gm, gh, Fg, tfull, cap_g, single, decl_g, match_g,
-         sown_g, smatch_g, ge_g) = xs
+         sown_g, smatch_g, aneed_g, amatch_g, ge_g) = xs
+        any_aneed = jnp.any(aneed_g)
         has_pods = n > 0
         owned = sown_g < SPREAD_OWNED_MIN  # [C]
 
@@ -269,7 +285,11 @@ def pack(
                 smatch_g[None, :], rem_e, jnp.where(rem_e > 0, UNCAPPED, 0)
             )
             q_cls_e = jnp.min(jnp.where(owned[None, :], rem_e_eff, UNCAPPED), axis=-1)
-            q_e = jnp.where(ge_g & anti_e, q_e, 0)
+            # affinity classes: owners land only where matched pods already
+            # sit (batch groups that landed here earlier in the scan, or
+            # cluster pods seeded into e_aff)
+            aff_e = jnp.all(~aneed_g[None, :] | (state["eaff"] > 0), axis=-1)
+            q_e = jnp.where(ge_g & anti_e & aff_e, q_e, 0)
             q_e = jnp.minimum(jnp.minimum(q_e, cap_g), jnp.maximum(q_cls_e, 0))
             # single-bin groups (hostname pod affinity) stay on the claim
             # path: waves routes groups with existing matches to the host
@@ -281,6 +301,7 @@ def pack(
             eload2 = state["eload"] + take_e[:, None].astype(jnp.float32) * d[None, :]
             enpods2 = state["enpods"] + take_e
             escnt2 = state["escnt"] + take_e[:, None] * smatch_g[None, :].astype(jnp.int32)
+            eaff2 = state["eaff"] + take_e[:, None] * amatch_g[None, :].astype(jnp.int32)
             landed_e = (take_e > 0)[:, None]
             edecl2 = jnp.where(landed_e, state["edecl"] | decl_g[None, :], state["edecl"])
             ematch2 = jnp.where(landed_e, state["ematch"] | match_g[None, :], state["ematch"])
@@ -298,6 +319,10 @@ def pack(
             (state["bmatch"] & decl_g[None, :]) == 0, axis=-1
         ) & jnp.all((state["bdecl"] & match_g[None, :]) == 0, axis=-1)
         compat_b = compat_b & anti_ok
+        # hostname-affinity classes: an owner lands only on bins already
+        # holding matched pods (nextDomainAffinity options, topology.py:209)
+        aff_ok = jnp.all(~aneed_g[None, :] | (state["baff"] > 0), axis=-1)
+        compat_b = compat_b & aff_ok
 
         # ---- per-bin capacity for this group (max over remaining types) ----
         # (alloc - load)/d = alloc/d - load/d: hoisting the divisions to
@@ -381,6 +406,18 @@ def pack(
         want_new = jnp.where(
             single, jnp.where((assigned == 0) & any_m & (spill > 0), 1, 0), want_new
         )
+        # affinity owners may open a fresh bin only to BOOTSTRAP: every
+        # owned class must have zero matches anywhere AND be self-matched
+        # (host: matches elsewhere force joining them; a non-self-matching
+        # owner with no matches cannot schedule at all), and the bootstrap
+        # opens exactly ONE bin — the host's sequential pods must join the
+        # first pod's fresh domain (topology.py:211-221)
+        gc = jnp.sum(state["baff"], axis=0)  # [A] global matched counts
+        if with_existing:
+            gc = gc + jnp.sum(state["eaff"], axis=0)
+        boot_ok = jnp.all(~aneed_g | (amatch_g & (gc == 0)))
+        want_new = jnp.where(any_aneed & ~boot_ok, 0, want_new)
+        want_new = jnp.where(any_aneed, jnp.minimum(want_new, 1), want_new)
         want_new = jnp.minimum(want_new, max_new_by_limit)
         free = ~state["used"]
         rank = jnp.cumsum(free.astype(jnp.int32)) - 1
@@ -434,6 +471,9 @@ def pack(
         bscnt3 = state["bscnt"] + total_take[:, None] * smatch_g[None, :].astype(
             jnp.int32
         )
+        baff3 = state["baff"] + total_take[:, None] * amatch_g[None, :].astype(
+            jnp.int32
+        )
 
         new_state = dict(
             used=used3,
@@ -447,16 +487,17 @@ def pack(
             bdecl=bdecl3,
             bmatch=bmatch3,
             bscnt=bscnt3,
+            baff=baff3,
         )
         if with_existing:
             new_state.update(
                 eload=eload2, enpods=enpods2, escnt=escnt2,
-                edecl=edecl2, ematch=ematch2,
+                edecl=edecl2, ematch=ematch2, eaff=eaff2,
             )
         return new_state, (take + pods_new, take_e)
 
     xs = (g_demand, g_count, g_mask, g_has, F, tmpl_full, g_bin_cap, g_single,
-          g_decl, g_match, g_sown, g_smatch, ge_ok)
+          g_decl, g_match, g_sown, g_smatch, g_aneed, g_amatch, ge_ok)
     state, (assign, assign_e) = jax.lax.scan(step, state, xs)
     return dict(
         assign=assign,  # [G,B] (scan stacks per-step [B] outputs)
@@ -498,14 +539,19 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
         args["g_decl"] = jnp.zeros((G, CW), dtype=jnp.uint32)
     if "g_match" not in args:
         args["g_match"] = jnp.zeros((G, args["g_decl"].shape[1]), dtype=jnp.uint32)
-    # g_sown/g_smatch (and g_decl/g_match) are width-paired: default each
-    # from its partner's shape so a caller supplying only one cannot
-    # produce mismatched class axes
+    # g_sown/g_smatch (and g_decl/g_match, g_aneed/g_amatch) are
+    # width-paired: default each from its partner's shape so a caller
+    # supplying only one cannot produce mismatched class axes
     if "g_sown" not in args:
         C = args["g_smatch"].shape[1] if "g_smatch" in args else 1
         args["g_sown"] = jnp.full((G, C), UNCAPPED, dtype=jnp.int32)
     if "g_smatch" not in args:
         args["g_smatch"] = jnp.zeros((G, args["g_sown"].shape[1]), dtype=bool)
+    if "g_aneed" not in args:
+        A = args["g_amatch"].shape[1] if "g_amatch" in args else 1
+        args["g_aneed"] = jnp.zeros((G, A), dtype=bool)
+    if "g_amatch" not in args:
+        args["g_amatch"] = jnp.zeros((G, args["g_aneed"].shape[1]), dtype=bool)
     # existing-node tensors default to one inert node (zero capacity);
     # when the caller supplied none, phase A is compiled out entirely
     C = args["g_sown"].shape[1]
@@ -526,6 +572,8 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
         args["e_decl"] = jnp.zeros((E, CW), dtype=jnp.uint32)
     if "e_match" not in args:
         args["e_match"] = jnp.zeros((E, CW), dtype=jnp.uint32)
+    if "e_aff" not in args:
+        args["e_aff"] = jnp.zeros((E, args["g_aneed"].shape[1]), dtype=jnp.int32)
     if use_pallas is None:
         # NOTE callers that cache jitted wrappers must resolve the flag
         # HOST-side and key their cache on it (models/solver.py does) or
@@ -545,9 +593,9 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
     out = pack(
         args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,
         args["g_bin_cap"], args["g_single"], args["g_decl"], args["g_match"],
-        args["g_sown"], args["g_smatch"],
+        args["g_sown"], args["g_smatch"], args["g_aneed"], args["g_amatch"],
         args["ge_ok"], args["e_avail"], args["e_npods"], args["e_scnt"],
-        args["e_decl"], args["e_match"],
+        args["e_decl"], args["e_match"], args["e_aff"],
         args["t_alloc"], args["t_cap"], args["t_tmpl"], args["m_mask"], args["m_has"],
         args["m_overhead"], args["m_limits"], max_bins=max_bins,
         with_existing=with_existing,

@@ -91,6 +91,9 @@ class DeviceSnapshot:
     g_sown: np.ndarray  # [G,C] i32 per-bin cap where the group owns the
     # hostname-spread class, else UNCAPPED (waves spread classes)
     g_smatch: np.ndarray  # [G,C] bool the class counts this group's pods
+    g_aneed: np.ndarray  # [G,A] bool hostname-affinity classes the group
+    # owns: it may only land on bins whose matched count is positive
+    g_amatch: np.ndarray  # [G,A] bool the class selector matches this group
 
     # flattened (template, type) axis (T)
     type_refs: list  # [(template_idx, InstanceType)]
@@ -196,6 +199,7 @@ class ExistingSnapshot:
     e_scnt: np.ndarray  # [E,C] i32 spread-class counts from current pods
     e_decl: np.ndarray  # [E,CW] u32 anti classes declared by current pods
     e_match: np.ndarray  # [E,CW] u32 anti classes matching current pods
+    e_aff: np.ndarray  # [E,A] i32 affinity-class matched-pod counts
 
     @property
     def E(self):
@@ -216,6 +220,7 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
     K = len(snap.keys)
     CW = snap.g_decl.shape[1]
     C = snap.g_sown.shape[1]
+    A = snap.g_aneed.shape[1]
 
     e_avail = np.zeros((E, R), dtype=np.float32)
     ge_ok = np.zeros((G, E), dtype=bool)
@@ -223,6 +228,7 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
     e_scnt = np.zeros((E, C), dtype=np.int32)
     e_decl = np.zeros((E, CW), dtype=np.uint32)
     e_match = np.zeros((E, CW), dtype=np.uint32)
+    e_aff = np.zeros((E, A), dtype=np.int32)
 
     e_mask = np.zeros((E, K, snap.W), dtype=np.uint32)
     e_has = np.zeros((E, K), dtype=bool)
@@ -243,6 +249,8 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
                     e_decl[e, c // WORD] |= np.uint32(1 << (c % WORD))
             for c, tg in enumerate(device_plan.spread_tgs_by_class):
                 e_scnt[e, c] = tg.domains.get(hostname, 0)
+            for c, tg in enumerate(device_plan.aff_tgs_by_class):
+                e_aff[e, c] = tg.domains.get(hostname, 0)
 
     # strict requirement compatibility over the interned masks: every key
     # the group requires must be defined on the node AND overlap. Values a
@@ -291,6 +299,7 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
         e_scnt=e_scnt,
         e_decl=e_decl,
         e_match=e_match,
+        e_aff=e_aff,
     )
 
 
@@ -633,6 +642,7 @@ def tensorize(
         g_single_list = [dg.single_bin for dg in device_groups]
         g_decl, g_match = device_plan.class_masks()
         g_sown, g_smatch = device_plan.spread_tensors()
+        g_aneed, g_amatch = device_plan.aff_tensors()
     else:
         # ---- group pods by signature, FFD order ----
         # the signature is cached on the pod object: the provisioner
@@ -653,6 +663,8 @@ def tensorize(
         g_match = np.zeros((len(groups), 1), dtype=np.uint32)
         g_sown = np.full((len(groups), 1), UNCAPPED, dtype=np.int32)
         g_smatch = np.zeros((len(groups), 1), dtype=bool)
+        g_aneed = np.zeros((len(groups), 1), dtype=bool)
+        g_amatch = np.zeros((len(groups), 1), dtype=bool)
     group_demand = [g[0].effective_requests() for g in groups]
 
     # ---- resource dimension union ----
@@ -767,6 +779,8 @@ def tensorize(
         g_match=g_match,
         g_sown=g_sown,
         g_smatch=g_smatch,
+        g_aneed=g_aneed,
+        g_amatch=g_amatch,
         templates=list(templates),
         m_mask=m_mask,
         m_has=m_has,

@@ -1,42 +1,52 @@
 """Topology compiler: constraint groups → device group plan.
 
 TPU-native reformulation of the reference's TopologyGroup machinery
-(topologygroup.go:167-265). The host engine resolves topology domain-by-
+(topologygroup.go:167-274). The host engine resolves topology domain-by-
 domain while pods stream through the FFD loop; the device path instead
 compiles each constraint into static group structure the pack kernel
 understands, so the whole batch stays one device call:
 
 - zone topology spread (topologygroup.go nextDomainTopologySpread:167):
-  placing identical pods one-at-a-time into the least-loaded allowed domain
-  is exactly water-filling, so the per-zone pod counts are computed in
-  closed form here and the group splits into zone-pinned SUBGROUPS. The
-  zone pin rides the ordinary requirement mask — bins narrow to one zone
-  exactly like host claims do. Counts from OTHER matching groups are only
-  visible to the host engine when a matched pod lands on an
-  already-pinned claim (Record commits singleton domains only,
-  topology.py:290); the static plan ignores that narrow window.
+  a SELF-SELECTING owner placing identical pods one-at-a-time into the
+  least-loaded allowed domain is exactly water-filling, so per-zone pod
+  counts are computed in closed form and the group splits into zone-pinned
+  SUBGROUPS. A NON-self-selecting owner never moves the counts it is
+  checked against, so every pod lands in the same (sorted-first) min-count
+  domain — one pinned subgroup.
 - hostname topology spread (maxSkew s): every bin is its own hostname
   domain and an empty node is always mintable, so the domain-min is 0 and
-  each bin may hold at most s pods of the group -> per-group BIN CAP.
+  the kernel carries per-bin SPREAD-CLASS counts capped at s.
 - hostname pod anti-affinity (nextDomainAntiAffinity:252) as CONFLICT
-  CLASSES: each distinct required hostname anti-affinity term is a class;
-  a group DECLARING class c cannot share a bin with pods MATCHED by c
-  (the direct TopologyGroup), and a group matched by c cannot share a bin
-  with declarers (the inverse group, topology.go:225). Bins carry
-  declared/matched class bitmasks in kernel state. Cluster-pod domain
-  counts only name EXISTING nodes, which the device never packs onto, so
-  they don't gate the new-bin path.
-- zone pod affinity (nextDomainAffinity:219): pods need a domain with
-  matches. With existing matches the allowed set is the non-empty domains;
-  bootstrap pins the sorted-first allowed domain (the host engine uses the
-  same deterministic tie-break).
-- hostname pod affinity: all matching pods co-locate on one claim ->
-  SINGLE-BIN group flag for the kernel.
+  CLASSES: a group DECLARING class c cannot share a bin with pods MATCHED
+  by c and vice versa (the direct/inverse TopologyGroup pair,
+  topology.go:225); bins carry declared/matched class bitmasks.
+- hostname pod affinity (nextDomainAffinity:219) as AFFINITY CLASSES with
+  per-bin MATCH COUNTS: a group owning class c may only land on bins whose
+  matched count is already positive; when no matches exist anywhere a
+  self-matching group bootstraps exactly ONE fresh bin (the host's
+  bootstrap, topology.py:211). Cross-group chains (A follows B's labels)
+  resolve inside the scan because counts evolve per step — the compiler
+  orders followers after their targets, mirroring the host queue's
+  requeue-to-back of pods that fail a round (queue.go:76).
+- zone pod affinity: resolved at COMPILE time against the same sequential
+  overlay the zone spreads use — allowed zones are the overlay's non-empty
+  domains of the class selector; a unique zone pins the group, multiple
+  matches become a zone IN-set (uncounted, exactly like the host's
+  non-singleton Record), and a selector with no matches yet DEFERS the
+  group to a later compile round (the host requeue).
+
+The compiler runs a sequential OVERLAY simulation in FFD order: every
+group's zone-pinned landings bump the compile-local domain counts of every
+zone-keyed group whose selector matches it (ownership not required —
+topologygroup.go:167 counts by selector), so later groups see earlier
+groups' placements exactly as the host loop would. Groups whose affinity
+targets haven't landed yet retry in later rounds until a fixed point; the
+remainder routes to the host engine, which stays the semantic oracle.
 
 Anything else — zone anti-affinity (the Schrödinger case records every
-candidate domain, topology_test semantics), cross-group zone affinity,
-preferred terms, minDomains, same-selector spreads with different
-parameters — routes to the host engine, which remains the semantic oracle.
+candidate domain), preferred terms, minDomains, same-selector spreads with
+different parameters, hostname affinity onto pre-existing cluster matches —
+routes to the host engine.
 """
 
 from __future__ import annotations
@@ -66,12 +76,13 @@ class DeviceGroup:
     pods: list
     extra_reqs: list = field(default_factory=list)  # e.g. zone pin
     bin_cap: int = UNCAPPED  # max pods of this group per bin
-    single_bin: bool = False  # hostname affinity: whole group in one bin
+    single_bin: bool = False  # retained for direct kernel callers
     decl_classes: frozenset = frozenset()  # hostname-anti classes declared
     match_classes: frozenset = frozenset()  # hostname-anti classes matched
     spread_caps: dict = field(default_factory=dict)  # owned spread class -> maxSkew
     spread_matches: frozenset = frozenset()  # spread classes counting this group
-    zone_tail: bool = False  # scans after zone-spread owners
+    aff_need: frozenset = frozenset()  # hostname-affinity classes owned
+    aff_match: frozenset = frozenset()  # hostname-affinity classes matching it
 
 
 @dataclass
@@ -80,10 +91,12 @@ class WavesPlan:
     host_pods: list
     n_classes: int = 0
     n_spread_classes: int = 0
+    n_aff_classes: int = 0
     # per-class TopologyGroup refs so the existing-node tensorizer can seed
     # per-node counts from the groups' domain maps (hostname-keyed)
     anti_tgs_by_class: list = field(default_factory=list)  # (direct, inverse|None)
     spread_tgs_by_class: list = field(default_factory=list)
+    aff_tgs_by_class: list = field(default_factory=list)
 
     @property
     def device_pod_count(self):
@@ -115,6 +128,21 @@ class WavesPlan:
             for c in dg.spread_matches:
                 smatch[g, c] = True
         return sown, smatch
+
+    def aff_tensors(self):
+        """(g_aneed [G,A] bool, g_amatch [G,A] bool) for the kernel's
+        per-bin affinity-class match counts; bootstrap eligibility is
+        derived in-kernel from amatch ∧ global-count==0."""
+        G = len(self.device_groups)
+        A = max(1, self.n_aff_classes)
+        aneed = np.zeros((G, A), dtype=bool)
+        amatch = np.zeros((G, A), dtype=bool)
+        for g, dg in enumerate(self.device_groups):
+            for c in dg.aff_need:
+                aneed[g, c] = True
+            for c in dg.aff_match:
+                amatch[g, c] = True
+        return aneed, amatch
 
 
 def _group_key(g0):
@@ -170,182 +198,166 @@ def _spread_conflicts(topology) -> set:
     return conflicted
 
 
-def compile_topology(groups: list, topology) -> WavesPlan:
-    """groups: list[list[Pod]] (identical pods per list, any order).
-    Returns the device plan; pods whose constraints the device cannot
-    express are returned in host_pods."""
-    groups = sorted(groups, key=lambda g: _group_key(g[0]))  # FFD order
+_HOST = "host"
+_DEFER = "defer"
 
-    if topology is None or not getattr(topology, "has_groups", False):
-        return WavesPlan([DeviceGroup(list(g)) for g in groups], [])
 
-    reps = [g[0] for g in groups]
-    own_by_gid = [
-        [tg for tg in topology.topologies.values() if rep.uid in tg.owners]
-        for rep in reps
-    ]
-    spread_conflicted = _spread_conflicts(topology)
+class _Compiler:
+    """Sequential overlay compile of one batch (see module docstring)."""
 
-    # ---- hostname anti-affinity conflict classes ----
-    # one class per distinct required hostname anti term owned in the batch
-    anti_classes: dict = {}  # tg hash_key -> class index
-    for gid, own in enumerate(own_by_gid):
-        for tg in own:
-            if tg.type == TYPE_ANTI_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
-                anti_classes.setdefault(tg.hash_key(), len(anti_classes))
-    anti_tgs = {
-        hk: tg for hk, tg in topology.topologies.items() if hk in anti_classes
-    }
+    def __init__(self, groups, topology):
+        self.groups = groups
+        self.topology = topology
+        self.reps = [g[0] for g in groups]
+        self.own_by_gid = [
+            [tg for tg in topology.topologies.values() if rep.uid in tg.owners]
+            for rep in self.reps
+        ]
+        self.spread_conflicted = _spread_conflicts(topology)
+        # inverse anti groups whose declarers are NOT in this batch and whose
+        # key is not hostname constrain allowed domains invisibly → host
+        self.zone_inverse = [
+            tg for tg in topology.inverse_topologies.values()
+            if tg.key != wk.HOSTNAME_LABEL
+        ]
+        # one class per distinct required hostname term owned in the batch
+        self.anti_classes: dict = {}
+        self.aff_classes: dict = {}
+        self.spread_classes: dict = {}
+        for own in self.own_by_gid:
+            for tg in own:
+                if tg.key != wk.HOSTNAME_LABEL:
+                    continue
+                if tg.type == TYPE_ANTI_AFFINITY:
+                    self.anti_classes.setdefault(tg.hash_key(), len(self.anti_classes))
+                elif tg.type == TYPE_SPREAD:
+                    self.spread_classes.setdefault(
+                        tg.hash_key(), len(self.spread_classes))
+                elif tg.type == TYPE_AFFINITY:
+                    self.aff_classes.setdefault(tg.hash_key(), len(self.aff_classes))
+        T = topology.topologies
+        self.anti_tgs = {hk: T[hk] for hk in self.anti_classes}
+        self.spread_tgs = {hk: T[hk] for hk in self.spread_classes}
+        self.aff_tgs = {hk: T[hk] for hk in self.aff_classes}
+        # compile-local domain counts for every ZONE-keyed spread/affinity
+        # group; later groups see earlier groups' pinned landings exactly as
+        # the host loop would
+        self.overlay: dict = {}
+        # in-batch matched-pod counts per hostname-affinity class (scan-order
+        # viability; the kernel re-checks per bin at run time)
+        self.aff_cnt = [0] * len(self.aff_classes)
+        self.device_groups: list = []
+        self.host_pods: list = []
 
-    # inverse groups whose declarers are NOT in this batch and whose key is
-    # not hostname constrain allowed domains in ways the plan can't see
-    zone_inverse = [
-        tg for tg in topology.inverse_topologies.values()
-        if tg.key != wk.HOSTNAME_LABEL
-    ]
+    def _counts(self, tg) -> dict:
+        c = self.overlay.get(id(tg))
+        if c is None:
+            c = self.overlay[id(tg)] = dict(tg.domains)
+        return c
 
-    # spread groups count by SELECTOR MATCH, not ownership
-    # (topologygroup.go:167-217). Hostname spreads become SPREAD CLASSES:
-    # bins carry a per-class pod COUNT contributed by every matched group
-    # (owner or not), and a group OWNING class c may only land on a bin
-    # while count + take <= maxSkew — the exact per-domain accounting of
-    # the host engine, shared across co-owner groups and unconstrained
-    # same-label groups alike. Zone spreads keep the compile-time
-    # water-fill; matched non-owner groups are scanned AFTER the owners
-    # (zone_tail) so every owner placement is legal with the counts it saw.
-    spread_classes: dict = {}  # hostname-spread tg hash_key -> class index
-    for own in own_by_gid:
-        for tg in own:
-            if tg.type == TYPE_SPREAD and tg.key == wk.HOSTNAME_LABEL:
-                spread_classes.setdefault(tg.hash_key(), len(spread_classes))
-    spread_tgs = {
-        hk: tg for hk, tg in topology.topologies.items() if hk in spread_classes
-    }
-    zone_spread_tgs = [
-        tg
-        for tg in topology.topologies.values()
-        if tg.type == TYPE_SPREAD and tg.key == wk.TOPOLOGY_ZONE_LABEL
-        and any(tg in own for own in own_by_gid)
-    ]
-
-    device_groups: list = []
-    host_pods: list = []
-    overlay: dict = {}  # id(tg) -> compile-local domain counts
-
-    for gid, pods in enumerate(groups):
-        rep = reps[gid]
-        own = own_by_gid[gid]
-
-        if any(tg.selects(rep) for tg in zone_inverse):
-            host_pods.extend(pods)
-            continue
-        own_ids = {id(tg) for tg in own}
-        # matched by an in-batch zone spread it doesn't own: its landings
-        # shift the owner's domain counts, so it scans after the owners
-        # (its own zone choice is unconstrained, so the deferral is legal)
-        zone_tail = any(
-            id(tg) not in own_ids and tg.selects(rep) for tg in zone_spread_tgs
+    def run(self) -> WavesPlan:
+        pending = list(range(len(self.groups)))
+        progress = True
+        while progress and pending:
+            progress = False
+            still = []
+            for gid in pending:
+                outcome = self._compile_one(gid)
+                if outcome is _DEFER:
+                    still.append(gid)
+                    continue
+                progress = True
+            pending = still
+        for gid in pending:
+            # affinity targets never materialized: the host queue fails these
+            # the same way after its own retry cycle (queue.go:76 staleness)
+            self.host_pods.extend(self.groups[gid])
+        anti_by_class = [None] * len(self.anti_classes)
+        for hk, c in self.anti_classes.items():
+            anti_by_class[c] = (
+                self.anti_tgs[hk], self.topology.inverse_topologies.get(hk))
+        spread_by_class = [None] * len(self.spread_classes)
+        for hk, c in self.spread_classes.items():
+            spread_by_class[c] = self.spread_tgs[hk]
+        aff_by_class = [None] * len(self.aff_classes)
+        for hk, c in self.aff_classes.items():
+            aff_by_class[c] = self.aff_tgs[hk]
+        return WavesPlan(
+            self.device_groups,
+            self.host_pods,
+            n_classes=len(self.anti_classes),
+            n_spread_classes=len(self.spread_classes),
+            n_aff_classes=len(self.aff_classes),
+            anti_tgs_by_class=anti_by_class,
+            spread_tgs_by_class=spread_by_class,
+            aff_tgs_by_class=aff_by_class,
         )
-        if zone_tail and any(
-            tg.type == TYPE_SPREAD and tg.key == wk.TOPOLOGY_ZONE_LABEL
-            for tg in own
-        ):
-            # owns one zone spread while matched by another: the compile-time
-            # water-fills would need each other's answers — host engine
-            host_pods.extend(pods)
-            continue
+
+    def _compile_one(self, gid):
+        pods = self.groups[gid]
+        rep = self.reps[gid]
+        own = self.own_by_gid[gid]
+
+        if any(tg.selects(rep) for tg in self.zone_inverse):
+            self.host_pods.extend(pods)
+            return _HOST
 
         extra_reqs: list = []
         bin_cap = UNCAPPED
-        single_bin = False
-        zone_split = None  # domain -> count
+        zone_split = None  # domain -> count (pinned landings)
+        # set by ANY zone spread/affinity, pinned or not: composing two
+        # zone constraints needs each other's answers → host engine
+        zone_constrained = False
         decl: set = set()
         spread_caps: dict = {}
-        ok = True
+        aff_need: set = set()
 
         for tg in own:
-            # compile-time domain counts live in an overlay so later
-            # co-owner groups see this group's planned placements without
-            # mutating the Topology object — ACTUAL placements are recorded
-            # by the decoder, so a capacity spill cannot inflate the counts
-            # the host fallback pass reads
-            counts = overlay.setdefault(id(tg), dict(tg.domains))
             if tg.type == TYPE_SPREAD and tg.key == wk.TOPOLOGY_ZONE_LABEL:
-                if (
-                    tg.min_domains is not None
-                    or zone_split is not None
-                    or tg.hash_key() in spread_conflicted
-                ):
-                    ok = False
-                    break
-                pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
-                allowed = {d: c for d, c in counts.items() if pod_zone.has(d)}
-                if not allowed:
-                    ok = False
-                    break
-                zone_split = _water_fill(allowed, len(pods))
-                for d, add in zone_split.items():
-                    counts[d] = counts.get(d, 0) + add
-                zone_split = {d: c for d, c in zone_split.items() if c > 0}
+                split = self._zone_spread(tg, rep, len(pods), zone_constrained)
+                if split is None:
+                    self.host_pods.extend(pods)
+                    return _HOST
+                zone_split, zone_constrained = split, True
             elif tg.type == TYPE_SPREAD and tg.key == wk.HOSTNAME_LABEL:
-                cls = spread_classes[tg.hash_key()]
+                cls = self.spread_classes[tg.hash_key()]
                 cap = max(int(tg.max_skew), 1)
                 spread_caps[cls] = min(spread_caps.get(cls, cap), cap)
             elif tg.type == TYPE_ANTI_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
-                decl.add(anti_classes[tg.hash_key()])
+                decl.add(self.anti_classes[tg.hash_key()])
             elif tg.type == TYPE_AFFINITY and tg.key == wk.TOPOLOGY_ZONE_LABEL:
-                # cross-group zone affinity (followers of an unpinned
-                # in-batch target) stays on the host engine
-                if any(tg.selects(r) for i, r in enumerate(reps) if i != gid):
-                    ok = False
-                    break
-                nonzero = sorted(d for d, c in counts.items() if c > 0)
-                pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
-                if nonzero:
-                    allowed_d = [d for d in nonzero if pod_zone.has(d)]
-                    if not allowed_d:
-                        ok = False
-                        break
-                    extra_reqs.append(Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, allowed_d))
-                else:
-                    # bootstrap is SELF-affinity only: a pod whose required
-                    # affinity selector matches nobody (not even itself)
-                    # cannot schedule (topology_test.go:2126) — the host
-                    # engine produces the error
-                    if not tg.selects(rep):
-                        ok = False
-                        break
-                    # deterministic sorted-first allowed domain (the host
-                    # engine's tie-break, topology.py:207)
-                    first = next(
-                        (d for d in sorted(counts) if pod_zone.has(d)), None
-                    )
-                    if first is None:
-                        ok = False
-                        break
-                    extra_reqs.append(Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [first]))
-                    counts[first] = counts.get(first, 0) + len(pods)
+                res = self._zone_affinity(tg, rep, len(pods), zone_constrained)
+                if res is _HOST:
+                    self.host_pods.extend(pods)
+                    return _HOST
+                if res is _DEFER:
+                    return _DEFER
+                req, pinned = res
+                extra_reqs.append(req)
+                zone_constrained = True
+                if pinned is not None:
+                    zone_split = {pinned: len(pods)}
             elif tg.type == TYPE_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
-                if any(tg.selects(r) for i, r in enumerate(reps) if i != gid) or any(
-                    counts.values()
-                ):
-                    ok = False  # cross-group or existing matches: host
-                    break
-                if not tg.selects(rep):
-                    ok = False  # matches nobody, not even itself: host fails it
-                    break
-                single_bin = True
+                if any(tg.domains.values()):
+                    # pre-existing cluster matches: the host engine's
+                    # exact-domain bootstrap onto registered hostnames is
+                    # not expressible as class counts
+                    self.host_pods.extend(pods)
+                    return _HOST
+                cls = self.aff_classes[tg.hash_key()]
+                aff_need.add(cls)
+                if not tg.selects(rep) and self.aff_cnt[cls] == 0:
+                    # target labels haven't landed yet: retry after the
+                    # rest of the batch (the host requeue-to-back)
+                    return _DEFER
             else:
-                ok = False
-                break
-
-        if not ok:
-            host_pods.extend(pods)
-            continue
+                self.host_pods.extend(pods)
+                return _HOST
 
         # classes whose selector matches this group (the inverse direction)
         match = {
-            c for hk, c in anti_classes.items() if anti_tgs[hk].selects(rep)
+            c for hk, c in self.anti_classes.items()
+            if self.anti_tgs[hk].selects(rep)
         }
         if decl & match:
             # self-matching anti-affinity: at most one pod of the group per
@@ -356,53 +368,124 @@ def compile_topology(groups: list, topology) -> WavesPlan:
         # labels don't match its selector contributes nothing, exactly like
         # the host count)
         smatch = {
-            c for hk, c in spread_classes.items() if spread_tgs[hk].selects(rep)
+            c for hk, c in self.spread_classes.items()
+            if self.spread_tgs[hk].selects(rep)
+        }
+        amatch = {
+            c for hk, c in self.aff_classes.items()
+            if self.aff_tgs[hk].selects(rep)
         }
 
+        self._emit(
+            pods, extra_reqs, bin_cap, zone_split,
+            frozenset(decl), frozenset(match), dict(spread_caps),
+            frozenset(smatch), frozenset(aff_need), frozenset(amatch),
+        )
+        self._bump_landings(rep, pods, zone_split)
+        return "emit"
+
+    # ---- per-constraint compile steps ----------------------------------
+    def _zone_spread(self, tg, rep, n, zone_constrained):
+        """domain -> count, or None for host."""
+        if (
+            tg.min_domains is not None
+            or zone_constrained
+            or tg.hash_key() in self.spread_conflicted
+        ):
+            return None
+        counts = self._counts(tg)
+        pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+        allowed = {d: c for d, c in counts.items() if pod_zone.has(d)}
+        if not allowed:
+            return None
+        if tg.selects(rep):
+            split = _water_fill(allowed, n)
+            return {d: c for d, c in split.items() if c > 0}
+        # non-self-selecting owner: counts never move, so every pod takes
+        # the same min-count domain (sorted tie-break, topology.py:196);
+        # maxSkew holds trivially at the minimum
+        lo = min(allowed.values())
+        d_star = sorted(d for d in allowed if allowed[d] == lo)[0]
+        return {d_star: n}
+
+    def _zone_affinity(self, tg, rep, n, zone_constrained):
+        """(Requirement, pinned_zone|None) | _DEFER | _HOST."""
+        if zone_constrained:
+            return _HOST  # composed zone constraints: host engine
+        counts = self._counts(tg)
+        pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+        nonzero = sorted(d for d, c in counts.items() if c > 0 and pod_zone.has(d))
+        if nonzero:
+            if len(nonzero) == 1:
+                return (Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, nonzero), nonzero[0])
+            # several match domains: the pod may land in any (host records
+            # nothing for non-singleton domains, topology.py:309)
+            return (Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, nonzero), None)
+        if not tg.selects(rep):
+            return _DEFER
+        # self-affinity bootstrap: deterministic sorted-first allowed domain
+        # (the host engine's tie-break, topology.py:211-221)
+        first = next((d for d in sorted(counts) if pod_zone.has(d)), None)
+        if first is None:
+            return _HOST  # no domain universe: host produces the error
+        return (Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [first]), first)
+
+    # ---- landings ------------------------------------------------------
+    def _emit(self, pods, extra_reqs, bin_cap, zone_split, decl, match,
+              spread_caps, smatch, aff_need, amatch):
         if zone_split:
             # zone-pinned subgroups; pods partitioned in order
             cursor = 0
             for d in sorted(zone_split):
                 cnt = zone_split[d]
-                sub = pods[cursor : cursor + cnt]
+                sub = pods[cursor: cursor + cnt]
                 cursor += cnt
-                device_groups.append(
-                    DeviceGroup(
-                        sub,
-                        extra_reqs + [Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [d])],
-                        bin_cap,
-                        single_bin,
-                        frozenset(decl),
-                        frozenset(match),
-                        dict(spread_caps),
-                        frozenset(smatch),
-                        zone_tail,
-                    )
-                )
+                self.device_groups.append(DeviceGroup(
+                    sub,
+                    extra_reqs + [Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [d])],
+                    bin_cap, False, decl, match, dict(spread_caps), smatch,
+                    aff_need, amatch,
+                ))
         else:
-            device_groups.append(
-                DeviceGroup(
-                    list(pods), extra_reqs, bin_cap, single_bin,
-                    frozenset(decl), frozenset(match),
-                    dict(spread_caps), frozenset(smatch), zone_tail,
-                )
-            )
+            self.device_groups.append(DeviceGroup(
+                list(pods), extra_reqs, bin_cap, False, decl, match,
+                dict(spread_caps), smatch, aff_need, amatch,
+            ))
 
-    # zone-spread matched non-owners scan after the owners so each owner
-    # placement is legal with the counts it saw at compile time (the tail's
-    # own zone choice is unconstrained); FFD order preserved within parts
-    device_groups.sort(key=lambda dg: dg.zone_tail)
-    anti_by_class = [None] * len(anti_classes)
-    for hk, c in anti_classes.items():
-        anti_by_class[c] = (anti_tgs[hk], topology.inverse_topologies.get(hk))
-    spread_by_class = [None] * len(spread_classes)
-    for hk, c in spread_classes.items():
-        spread_by_class[c] = spread_tgs[hk]
-    return WavesPlan(
-        device_groups,
-        host_pods,
-        n_classes=len(anti_classes),
-        n_spread_classes=len(spread_classes),
-        anti_tgs_by_class=anti_by_class,
-        spread_tgs_by_class=spread_by_class,
-    )
+    def _bump_landings(self, rep, pods, zone_split):
+        """Commit this group's pinned landings into the overlay so later
+        groups (and later compile rounds) see them — the compile-time
+        mirror of Topology.Record's singleton-domain commit."""
+        pinned = zone_split
+        if pinned is None:
+            # a plain node-selector zone pin also counts (the claim's zone
+            # set is a singleton, so the host records it)
+            pz = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+            if not pz.complement and len(pz.values) == 1:
+                pinned = {next(iter(pz.values)): len(pods)}
+        if pinned:
+            for tg in self.topology.topologies.values():
+                if tg.key != wk.TOPOLOGY_ZONE_LABEL:
+                    continue
+                if tg.type not in (TYPE_SPREAD, TYPE_AFFINITY):
+                    continue
+                if not tg.selects(rep):
+                    continue
+                counts = self._counts(tg)
+                for d, c in pinned.items():
+                    counts[d] = counts.get(d, 0) + c
+        for hk, cls in self.aff_classes.items():
+            if self.aff_tgs[hk].selects(rep):
+                self.aff_cnt[cls] += len(pods)
+
+
+def compile_topology(groups: list, topology) -> WavesPlan:
+    """groups: list[list[Pod]] (identical pods per list, any order).
+    Returns the device plan; pods whose constraints the device cannot
+    express are returned in host_pods."""
+    groups = sorted(groups, key=lambda g: _group_key(g[0]))  # FFD order
+
+    if topology is None or not getattr(topology, "has_groups", False):
+        return WavesPlan([DeviceGroup(list(g)) for g in groups], [])
+
+    return _Compiler(groups, topology).run()

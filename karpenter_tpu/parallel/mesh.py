@@ -111,11 +111,13 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
     args.setdefault("g_match", np.zeros((G, 1), dtype=np.uint32))
     args.setdefault("g_sown", np.full((G, 1), 1 << 30, dtype=np.int32))
     args.setdefault("g_smatch", np.zeros((G, 1), dtype=bool))
+    args.setdefault("g_aneed", np.zeros((G, 1), dtype=bool))
+    args.setdefault("g_amatch", np.zeros((G, 1), dtype=bool))
     # padded group rows are inert everywhere: count 0 means they never take
     # (a zero-filled g_sown row reads as cap 0, which only gates that row)
     G_NAMES = ["g_mask", "g_has", "g_demand", "g_count", "g_zone_allowed",
                "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single",
-               "g_decl", "g_match", "g_sown", "g_smatch"]
+               "g_decl", "g_match", "g_sown", "g_smatch", "g_aneed", "g_amatch"]
     T_NAMES = ["t_mask", "t_has", "t_alloc", "t_cap", "t_tmpl",
                "off_zone", "off_ct", "off_avail", "off_price"]
     if "g_tol" in args:
@@ -129,7 +131,8 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
         REPL_NAMES.append("m_tol")
     if "ge_ok" in args:
         G_NAMES.append("ge_ok")
-    REPL_NAMES += [k for k in ("e_avail", "e_npods", "e_scnt", "e_decl", "e_match")
+    REPL_NAMES += [k for k in ("e_avail", "e_npods", "e_scnt", "e_decl", "e_match",
+                               "e_aff")
                    if k in args]
     for name in G_NAMES:
         args[name] = _pad_to(np.asarray(args[name]), 0, n_data)

@@ -151,54 +151,75 @@ def config4_consolidation_env(n_nodes=300):
     return env
 
 
-def diverse_pods(count: int):
-    """The reference benchmark's 1/6 constraint mix
-    (scheduling_benchmark_test.go makeDiversePods:234-248): generic, zonal
-    spread, hostname spread, pod-affinity (hostname + zone), hostname
-    anti-affinity, remainder generic."""
-    sixth = count // 6
+def diverse_pods(count: int, seed: int = 42):
+    """The reference benchmark's 1/6 constraint mix, faithfully randomized
+    (scheduling_benchmark_test.go makeDiversePods:234-248 + the seeded
+    generators :250-363): per-pod random labels over 7 values, random
+    cpu/memory from the reference's menus, spread selectors drawn
+    independently of the pod's own labels (cross-group counting), affinity
+    selectors likewise (cross-group chains), and a single shared
+    anti-affinity cohort (app=nginx, one pod per hostname)."""
+    import random
+
+    r = random.Random(seed)
+    VALUES = ("a", "b", "c", "d", "e", "f", "g")
+    CPUS = (0.1, 0.25, 0.5, 1.0, 1.5)  # randomCPU():376 (millicores)
+    MEMS = (100, 256, 512, 1024, 2048, 4096)  # randomMemory():371 (Mi)
+
+    def rnd_requests():
+        return r.choice(CPUS), r.choice(MEMS) / 1024.0
+
+    def rnd_labels():
+        return {"my-label": r.choice(VALUES)}
+
+    def rnd_aff_labels():
+        return {"my-affininity": r.choice(VALUES)}  # [sic], the ref's typo
+
     pods = []
 
     def generic(n, tag):
-        return [_pod(f"g{tag}-{i}", 0.5 + (i % 4) * 0.5, 1.0 + (i % 3)) for i in range(n)]
+        for i in range(n):
+            cpu, mem = rnd_requests()
+            pods.append(_pod(f"g{tag}-{i}", cpu, mem, labels=rnd_labels()))
 
     def spread(n, key, tag):
-        labels = {"app": f"spread-{tag}"}
-        return [
-            _pod(f"s{tag}-{i}", 1.0, 2.0, labels=dict(labels),
-                 topology_spread_constraints=[TopologySpreadConstraint(
-                     max_skew=1, topology_key=key, when_unsatisfiable="DoNotSchedule",
-                     label_selector=LabelSelector(match_labels=dict(labels)))])
-            for i in range(n)
-        ]
+        for i in range(n):
+            cpu, mem = rnd_requests()
+            pods.append(_pod(
+                f"s{tag}-{i}", cpu, mem, labels=rnd_labels(),
+                topology_spread_constraints=[TopologySpreadConstraint(
+                    max_skew=1, topology_key=key, when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels=rnd_labels()))]))
 
     def affinity(n, key, tag):
-        labels = {"app": f"aff-{tag}"}
-        return [
-            _pod(f"a{tag}-{i}", 1.0, 2.0, labels=dict(labels),
-                 affinity=Affinity(pod_affinity=PodAffinity(required=[
-                     PodAffinityTerm(topology_key=key,
-                                     label_selector=LabelSelector(match_labels=dict(labels)))])))
-            for i in range(n)
-        ]
+        for i in range(n):
+            cpu, mem = rnd_requests()
+            pods.append(_pod(
+                f"a{tag}-{i}", cpu, mem, labels=rnd_aff_labels(),
+                affinity=Affinity(pod_affinity=PodAffinity(required=[
+                    PodAffinityTerm(topology_key=key,
+                                    label_selector=LabelSelector(
+                                        match_labels=rnd_aff_labels()))]))))
 
     def anti(n, key, tag):
-        labels = {"app": f"anti-{tag}"}
-        return [
-            _pod(f"x{tag}-{i}", 1.0, 2.0, labels=dict(labels),
-                 affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
-                     PodAffinityTerm(topology_key=key,
-                                     label_selector=LabelSelector(match_labels=dict(labels)))])))
-            for i in range(n)
-        ]
+        labels = {"app": "nginx"}
+        for i in range(n):
+            cpu, mem = rnd_requests()
+            pods.append(_pod(
+                f"x{tag}-{i}", cpu, mem, labels=dict(labels),
+                affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                    PodAffinityTerm(topology_key=key,
+                                    label_selector=LabelSelector(
+                                        match_labels=dict(labels)))]))))
 
-    pods += generic(sixth, "0")
-    pods += spread(sixth, wk.TOPOLOGY_ZONE_LABEL, "z")
-    pods += spread(sixth, wk.HOSTNAME_LABEL, "h")
-    pods += affinity(sixth, wk.HOSTNAME_LABEL, "h")
-    pods += affinity(sixth, wk.TOPOLOGY_ZONE_LABEL, "z")
-    pods += anti(sixth, wk.HOSTNAME_LABEL, "h")
-    pods += generic(count - len(pods), "fill")
+    sixth = count // 6
+    generic(sixth, "0")
+    spread(sixth, wk.TOPOLOGY_ZONE_LABEL, "z")
+    spread(sixth, wk.HOSTNAME_LABEL, "h")
+    affinity(sixth, wk.HOSTNAME_LABEL, "h")
+    affinity(sixth, wk.TOPOLOGY_ZONE_LABEL, "z")
+    anti(sixth, wk.HOSTNAME_LABEL, "h")
+    generic(count - len(pods), "fill")
     return pods
 
 

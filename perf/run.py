@@ -24,15 +24,29 @@ from perf import configs as C  # noqa: E402
 
 
 def _solve_timed(solver, pods, pools, catalog, **solver_kw):
+    """Time one solve with the SAME scheduler inputs the product path
+    assembles (provisioner.NewScheduler): the topology domain universe from
+    the catalog and a real Topology over the batch. The reference benchmark
+    passes an EMPTY domain map (scheduling_benchmark_test.go:173), which
+    makes its zonal cohorts unsatisfiable; we supply the provisioner's
+    domain universe instead — strictly harder (every constraint is live)
+    and it is what our deployed solve path always sees."""
+    from karpenter_tpu.controllers.provisioning.provisioner import collect_domains
     from karpenter_tpu.models import ClaimTemplate
+    from karpenter_tpu.models.topology import Topology
 
     templates = [ClaimTemplate(p) for p in pools]
     its = {p.name: catalog for p in pools}
-    # fresh clones OUTSIDE the timer: harness isolation cost, not solver
-    # work (the reference benchmark also pre-builds pods, then times Solve)
+    # clones + topology assembly OUTSIDE the timer: the reference builds
+    # NewTopology/NewScheduler before b.ResetTimer and times Solve only
+    # (scheduling_benchmark_test.go:168-186)
     fresh = [p.clone() for p in pods]
+    domains: dict = {}
+    for t in templates:
+        collect_domains(domains, t, catalog)
+    topology = Topology(domains=domains, pods=fresh)
     t0 = time.perf_counter()
-    res = solver.solve(fresh, templates, its, **solver_kw)
+    res = solver.solve(fresh, templates, its, topology=topology, **solver_kw)
     return res, time.perf_counter() - t0
 
 
