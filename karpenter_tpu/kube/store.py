@@ -8,9 +8,13 @@ same role envtest (pkg/test/environment.go) plays for the reference's tier-1
 suites and kwok for its e2e tier.
 
 Semantics implemented:
-- resourceVersion bump per mutation (no optimistic-concurrency: callers
-  alias the stored instances, so controllers coordinate through the
-  synchronous reconcile loop rather than conflict retries)
+- resourceVersion bump per mutation, with optimistic concurrency on
+  update: a caller writing from a detached copy whose resourceVersion is
+  stale gets ConflictError (apiserver 409). The synchronous controller
+  ring aliases the stored instances — those writes always carry the
+  current version — so today's controllers never conflict; the check
+  guards any future concurrent worker or remote client
+  (kube/client.py retry_on_conflict is the retry pattern)
 - deletion with finalizers: delete stamps deletion_timestamp; the object
   disappears only when its finalizer list empties
 - watch events queued per mutation, drained by the controller manager
@@ -24,6 +28,7 @@ import threading
 from dataclasses import dataclass, field
 
 from karpenter_tpu.api.objects import ObjectMeta, PodDisruptionBudget
+from karpenter_tpu.kube.client import KubeClient
 
 
 class NotFoundError(Exception):
@@ -32,6 +37,12 @@ class NotFoundError(Exception):
 
 class ConflictError(Exception):
     pass
+
+
+class StaleVersionError(ConflictError):
+    """Optimistic-concurrency conflict (apiserver 409 on a stale
+    resourceVersion) — the only ConflictError a re-read can cure, and the
+    only one retry_on_conflict retries (client-go retry.RetryOnConflict)."""
 
 
 class TooManyRequests(Exception):
@@ -72,7 +83,7 @@ def _key(kind: str, obj) -> str:
     return f"{meta.namespace}/{meta.name}" if kind in _NAMESPACED else meta.name
 
 
-class KubeStore:
+class KubeStore(KubeClient):
     def __init__(self, clock=None):
         from karpenter_tpu.utils.clock import Clock
 
@@ -119,8 +130,19 @@ class KubeStore:
         admit(kind, obj)
         with self._lock:
             key = _key(kind, obj)
-            if key not in self._objects[kind]:
+            stored = self._objects[kind].get(key)
+            if stored is None:
                 raise NotFoundError(f"{kind}/{key}")
+            # optimistic concurrency (apiserver 409): a DETACHED copy must
+            # carry the stored resourceVersion; the aliased instance is by
+            # definition current
+            if stored is not obj and obj.metadata.resource_version != (
+                stored.metadata.resource_version
+            ):
+                raise StaleVersionError(
+                    f"{kind}/{key}: stale resourceVersion "
+                    f"{obj.metadata.resource_version} != {stored.metadata.resource_version}"
+                )
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._objects[kind][key] = obj

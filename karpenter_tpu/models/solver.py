@@ -70,7 +70,8 @@ class HostSolver(Solver):
 SHARD_MIN_WORK = 1 << 21
 
 
-def _packed_kernel(max_bins: int, use_pallas: bool = False):
+def _packed_kernel(max_bins: int, use_pallas: bool = False, level_bits: int = 20,
+                   max_minv: int = 0):
     """Jitted solve kernel with all outputs flattened into ONE int32
     buffer: over a tunneled chip every separate device->host array pays a
     full ~64ms round trip, which dominates these small tensors.
@@ -79,7 +80,7 @@ def _packed_kernel(max_bins: int, use_pallas: bool = False):
     builds one), but the jit wrapper must be shared or each instance
     re-traces the scan — the dominant cost of a test suite with hundreds
     of environments."""
-    cached = _PACKED_KERNELS.get((max_bins, use_pallas))
+    cached = _PACKED_KERNELS.get((max_bins, use_pallas, level_bits, max_minv))
     if cached is not None:
         return cached
 
@@ -89,7 +90,8 @@ def _packed_kernel(max_bins: int, use_pallas: bool = False):
     from karpenter_tpu.ops import kernels
 
     def packed(args):
-        out = kernels.solve_step(args, max_bins=max_bins, use_pallas=use_pallas)
+        out = kernels.solve_step(args, max_bins=max_bins, use_pallas=use_pallas,
+                                 level_bits=level_bits, max_minv=max_minv)
         return jnp.concatenate([
             out["assign"].ravel(),
             out["assign_e"].ravel(),
@@ -99,11 +101,19 @@ def _packed_kernel(max_bins: int, use_pallas: bool = False):
         ])
 
     fn = jax.jit(packed)
-    _PACKED_KERNELS[(max_bins, use_pallas)] = fn
+    _PACKED_KERNELS[(max_bins, use_pallas, level_bits, max_minv)] = fn
     return fn
 
 
 _PACKED_KERNELS: dict = {}
+
+
+# pods-per-solve below which the C++ engine beats the accelerator: the
+# tunneled chip pays a fixed ~64 ms round trip per dispatch while the native
+# engine finishes small instances in single-digit ms (measured on the grid:
+# native grid-100 ≈ 5 ms vs 100+ ms through the tunnel). Override with
+# KARPENTER_NATIVE_CUTOFF (0 disables routing).
+NATIVE_CUTOFF_PODS = 192
 
 
 class TPUSolver(Solver):
@@ -112,6 +122,7 @@ class TPUSolver(Solver):
         self.last_device_stats: dict = {}
         self._mesh = None
         self._mesh_checked = False
+        self._last_engine = "device"
 
     def _maybe_mesh(self):
         """The device mesh when >1 accelerator is attached (ICI on real
@@ -136,7 +147,8 @@ class TPUSolver(Solver):
         # the module-lifetime jit wrapper
         from karpenter_tpu.ops.kernels import pallas_enabled
 
-        return _packed_kernel(key[-1], pallas_enabled())
+        return _packed_kernel(key[-3], pallas_enabled(), level_bits=key[-2],
+                              max_minv=key[-1])
 
     def solve(
         self,
@@ -255,6 +267,7 @@ class TPUSolver(Solver):
             retry_pods=len(retry),
             host_pods=len(rest),
             existing_pods=sum(len(e[1]) for e in ecommits),
+            engine=self._last_engine,
         )
         # commit device placements onto the existing nodes (deferred so a
         # doubled re-run cannot double-apply); the host pass then sees the
@@ -333,6 +346,18 @@ class TPUSolver(Solver):
             # (different capped groups may share bins, so max not sum)
             caps = np.maximum(snap.g_bin_cap.astype(np.int64), 1)
             cap_lb = int(np.ceil(snap.g_count / caps).max()) if G else 0
+            # self-conflicting anti classes force one pod per bin ACROSS
+            # groups (a decl∩match group conflicts with every other group
+            # of its class): class c needs >= sum of those groups' counts
+            both = snap.g_decl & snap.g_match  # [G,CW]
+            if both.any():
+                for w in range(both.shape[1]):
+                    live = np.bitwise_or.reduce(both[:, w])
+                    for bit in range(32):
+                        if not (live >> bit) & 1:
+                            continue
+                        sel = ((both[:, w] >> bit) & 1).astype(bool)
+                        cap_lb = max(cap_lb, int(snap.g_count[sel].sum()))
             # spread classes share the per-bin cap ACROSS groups: class c
             # needs >= ceil(sum of owner counts / cap) distinct bins
             owned = snap.g_sown < SPREAD_OWNED_MIN
@@ -382,6 +407,7 @@ class TPUSolver(Solver):
             m_tol=snap.m_tol,
             m_overhead=snap.m_overhead,
             m_limits=snap.m_limits,
+            m_minv=snap.m_minv,
         )
         # padded types must be infeasible: zero alloc fails fits (pods>=1),
         # and their offerings carry the -1 "no domain" sentinel
@@ -399,9 +425,24 @@ class TPUSolver(Solver):
                 e_aff=pad(esnap.e_aff, (Ep, esnap.e_aff.shape[1])),
             )
 
+        # the level-fill search range shrinks when every type caps its pod
+        # count (the kubelet max-pods resource): levels never exceed
+        # npods + take <= 2*cap, so ~8 bits replace the generic 20 — the
+        # fill is the scan step's dominant op chain
+        level_bits = 20
+        if resutil.PODS in snap.resources:
+            pcap = float(snap.t_alloc[:, snap.resources.index(resutil.PODS)].max())
+            # existing nodes may already hold more pods than this solve's
+            # catalog caps (deprecated type, another pool): the search range
+            # must reach their npods or the fill silently skips them
+            if esnap is not None and esnap.e_npods.size:
+                pcap = max(pcap, float(esnap.e_npods.max()))
+            if 0 < pcap < 1 << 18:
+                level_bits = max(4, int(np.ceil(np.log2(2 * pcap + 4))))
+        max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
         key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1], snap.g_decl.shape[1],
                snap.g_sown.shape[1], snap.g_aneed.shape[1],
-               Ep if esnap is not None else 0, Bp)
+               Ep if esnap is not None else 0, Bp, level_bits, max_minv)
         host = self._invoke(args, key, Bp)
         assign = host["assign"][:G, :Bp]
         used = host["used"]
@@ -429,6 +470,33 @@ class TPUSolver(Solver):
 
         import jax
 
+        # small batches route to the C++ engine: below the crossover the
+        # fixed dispatch/tunnel latency dominates anything the accelerator
+        # saves (the reference's stance that small batches are cheap,
+        # batcher.go:52). Same tensors, same decode — only the kernel swaps.
+        cutoff = int(os.environ.get("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS))
+        total = int(np.asarray(args["g_count"]).sum())
+        if 0 < total <= cutoff:
+            native_ok = False
+            try:
+                from karpenter_tpu import native
+
+                native_ok = native.available()
+            except Exception:
+                native_ok = False
+            if native_ok:
+                try:
+                    self._last_engine = "native"
+                    return native.solve_step(args, max_bins)
+                except Exception:
+                    # a real native-engine failure (rc!=0, shape mismatch)
+                    # must be visible, not silently eaten by the fallback
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "native engine failed on a small batch; "
+                        "falling back to the device kernel", exc_info=True)
+        self._last_engine = "device"
         profile_dir = os.environ.get("KARPENTER_PROFILE_DIR")
         if profile_dir:
             with jax.profiler.trace(profile_dir):
@@ -721,6 +789,7 @@ class NativeSolver(TPUSolver):
     def _invoke(self, args, key, max_bins):
         from karpenter_tpu import native
 
+        self._last_engine = "native"
         return native.solve_step(args, max_bins)
 
 

@@ -83,7 +83,7 @@ def load():
             + [ctypes.c_int, _f32p, _u8p, _i32p, _i32p, _u32p, _u32p, _i32p]  # existing nodes
             + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]            # type side
             + [_i32p, _i32p, _u8p]                                # offerings
-            + [_u32p, _u8p, _u8p, _f32p, _f32p]                   # templates
+            + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]            # templates
             + [_i32p, _i32p, _u8p, _i32p, _u8p]                   # outputs
         )
         _lib = lib
@@ -215,6 +215,9 @@ def solve_step(args: dict, max_bins: int) -> dict:
         ),
         np.ascontiguousarray(args["m_overhead"], dtype=np.float32),
         np.ascontiguousarray(args["m_limits"], dtype=np.float32),
+        np.ascontiguousarray(
+            args.get("m_minv", np.zeros(M, dtype=np.int32)), dtype=np.int32
+        ),
         assign, assign_e, used, tmpl, F,
     )
     if rc != 0:

@@ -118,6 +118,16 @@ inline void combine_masks(std::vector<uint32_t>& mask, std::vector<uint8_t>& has
     }
 }
 
+// minValues floor (cloudprovider/types.go:165-199): a take of t keeps
+// >= minv instance types alive iff at least minv candidate capacities are
+// >= t, i.e. t <= the minv-th largest capacity. caps is clobbered.
+inline int minv_cap(std::vector<int>& caps, int minv) {
+    if ((int)caps.size() < minv) return 0;
+    std::nth_element(caps.begin(), caps.begin() + (minv - 1), caps.end(),
+                     std::greater<int>());
+    return caps[minv - 1];
+}
+
 // pods of demand d that fit into remaining space (alloc - load)
 inline int cap_for(const float* alloc, const float* load, const float* d, int R) {
     float cap = std::numeric_limits<float>::infinity();
@@ -158,7 +168,7 @@ int karpenter_solve(
     const float* t_cap, const int32_t* t_tmpl,
     const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
     const uint32_t* m_mask, const uint8_t* m_has, const uint8_t* m_tol,
-    const float* m_overhead, const float* m_limits,
+    const float* m_overhead, const float* m_limits, const int32_t* m_minv,
     int32_t* assign, int32_t* assign_e, uint8_t* used, int32_t* tmpl_out,
     uint8_t* F_out) {
 
@@ -308,10 +318,15 @@ int karpenter_solve(
                 if (!masks_compatible(bin.mask.data(), bin.has.data(), gm, gh, K, W))
                     continue;
                 int q = 0;
+                int minv = m_minv[bin.tmpl];
+                std::vector<int> caps;
                 for (int t : bin.types) {
                     if (!Fg[t]) continue;
-                    q = std::max(q, cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R));
+                    int c = cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R);
+                    if (minv > 0) caps.push_back(c);
+                    q = std::max(q, c);
                 }
+                if (minv > 0) q = std::min(q, minv_cap(caps, minv));
                 q = std::min(q, spread_cap(bin, sown_g, smatch_g, C));
                 if (q > best_q) { best_q = q; best_bi = bi; }
             }
@@ -328,10 +343,15 @@ int karpenter_solve(
                 continue;
             // capacity = max over surviving types still feasible for g
             int q = 0;
+            int minv = m_minv[bin.tmpl];
+            std::vector<int> caps;
             for (int t : bin.types) {
                 if (!Fg[t]) continue;
-                q = std::max(q, cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R));
+                int c = cap_for(t_alloc + (size_t)t * R, bin.load.data(), d, R);
+                if (minv > 0) caps.push_back(c);
+                q = std::max(q, c);
             }
+            if (minv > 0) q = std::min(q, minv_cap(caps, minv));
             q = std::min(q, cap_g);  // per-bin topology cap (waves)
             q = std::min(q, spread_cap(bin, sown_g, smatch_g, C));
             if (q <= 0) continue;
@@ -389,6 +409,8 @@ int karpenter_solve(
             for (int m = 0; m < M && m_star < 0; ++m) {
                 if (!tmpl_full[(size_t)g * M + m]) continue;
                 int best = 0;
+                int minv_m = m_minv[m];
+                std::vector<int> caps;
                 for (int t = 0; t < T; ++t) {
                     if (t_tmpl[t] != m || !Fg[t]) continue;
                     // nodepool limits: worst-case capacity must fit rem
@@ -401,8 +423,11 @@ int karpenter_solve(
                     std::vector<float> ovh(m_overhead + (size_t)m * R,
                                            m_overhead + (size_t)m * R + R);
                     int c = cap_for(t_alloc + (size_t)t * R, ovh.data(), d, R);
+                    if (minv_m > 0) caps.push_back(c);
                     best = std::max(best, c);
                 }
+                // a fresh claim must open with >= minv viable types
+                if (minv_m > 0) best = std::min(best, minv_cap(caps, minv_m));
                 if (best > 0) { m_star = m; per_node = best; }
             }
             if (m_star < 0) break;  // nothing can host this group

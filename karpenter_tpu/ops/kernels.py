@@ -126,10 +126,15 @@ def _combine_masks(a_mask, a_has, b_mask, b_has):
     return out_mask, a_has | b_has
 
 
-def _level_fill(q, npods, n):
+def _level_fill(q, npods, n, level_bits: int = _LEVEL_SEARCH_ITERS):
     """Distribute n pods across bins filling emptiest-first up to per-bin
     caps q — the batched equivalent of the reference's ascending-pod-count
-    claim ordering (scheduler.go:258). Returns per-bin take."""
+    claim ordering (scheduler.go:258). Returns per-bin take.
+
+    `level_bits` bounds the search range at 2^bits pods per bin: when the
+    catalog carries a pods-resource cap (kubelet max-pods, 110 by default)
+    the caller shrinks it to ~8, cutting the scan step's dominant op count
+    by >2x."""
     total_cap = jnp.sum(q)
     n_eff = jnp.minimum(n, total_cap)
 
@@ -137,12 +142,12 @@ def _level_fill(q, npods, n):
         return jnp.sum(jnp.minimum(q, jnp.maximum(level - npods, 0)))
 
     lo = jnp.int32(0)
-    hi = jnp.int32(1) << _LEVEL_SEARCH_ITERS
+    hi = jnp.int32(1) << level_bits
 
     # unrolled at trace time: a lax loop pays per-iteration dispatch
     # overhead ~L times per scan step, which dominated the scan's device
     # time; inlined, the search is pure dataflow XLA fuses freely
-    for _ in range(_LEVEL_SEARCH_ITERS):
+    for _ in range(level_bits):
         mid = (lo + hi) // 2
         enough = fill(mid) >= n_eff
         lo = jnp.where(enough, lo, mid)
@@ -188,9 +193,12 @@ def pack(
     m_has,  # [M,K]
     m_overhead,  # [M,R]
     m_limits,  # [M,R]
+    m_minv,  # [M] i32: required distinct instance types per claim
     *,
     max_bins: int,
     with_existing: bool = True,
+    level_bits: int = _LEVEL_SEARCH_ITERS,
+    max_minv: int = 0,
 ):
     """Grouped greedy pack. Returns dict with:
     assign [G,B] i32, used [B] bool, npods [B] i32, types [B,T] bool,
@@ -295,7 +303,7 @@ def pack(
             # path: waves routes groups with existing matches to the host
             # engine, so a device single group always bootstraps a fresh claim
             q_e = jnp.where(single | ~has_pods, 0, q_e)
-            take_e = _level_fill(q_e, state["enpods"], n)
+            take_e = _level_fill(q_e, state["enpods"], n, level_bits)
             n = n - jnp.sum(take_e)
 
             eload2 = state["eload"] + take_e[:, None].astype(jnp.float32) * d[None, :]
@@ -353,8 +361,21 @@ def pack(
             jnp.where(owned[None, :], rem_eff, UNCAPPED), axis=-1
         )  # [B]
         q = jnp.minimum(q, jnp.maximum(q_cls, 0))
+        if max_minv > 0:
+            # minValues floor (types.go:165-199 compiled onto the device):
+            # a take of t keeps >= minv instance types alive iff at least
+            # minv types have capacity >= t, i.e. t <= the minv-th largest
+            # per-type capacity — compiled out entirely when no template
+            # carries minValues (max_minv is a static trace arg)
+            minv_b = jnp.take(m_minv, state["btmpl"])  # [B]
+            k_eff = min(max_minv, T)
+            top = jax.lax.top_k(cap_bt, k_eff)[0]  # [B,k_eff] desc
+            idx = jnp.clip(minv_b - 1, 0, k_eff - 1)
+            kth = jnp.take_along_axis(top, idx[:, None], axis=1)[:, 0]
+            kth = jnp.where(minv_b > T, 0, kth)  # fewer types than required
+            q = jnp.where(minv_b > 0, jnp.minimum(q, jnp.maximum(kth, 0)), q)
 
-        take = _level_fill(q, state["npods"], n)
+        take = _level_fill(q, state["npods"], n, level_bits)
         # single-bin group: everything lands on the single highest-capacity
         # bin (any bin with matches works — the whole group commits at once)
         b_star = jnp.argmax(q)
@@ -375,6 +396,19 @@ def pack(
         per_node_m = jnp.max(
             jnp.where(new_ok[:, None] & t_is_m, fresh_cap[:, None], 0), axis=0
         )  # [M]
+        if max_minv > 0:
+            # a fresh claim must also open with >= minv viable types: cap
+            # its fill at the minv-th largest per-type fresh capacity
+            fc = jnp.where(new_ok[:, None] & t_is_m, fresh_cap[:, None], 0)  # [T,M]
+            k_eff = min(max_minv, T)
+            topm = jax.lax.top_k(fc.T, k_eff)[0]  # [M,k_eff]
+            idx_m = jnp.clip(m_minv - 1, 0, k_eff - 1)
+            kth_m = jnp.take_along_axis(topm, idx_m[:, None], axis=1)[:, 0]
+            kth_m = jnp.where(m_minv > T, 0, kth_m)
+            per_node_m = jnp.where(
+                m_minv > 0, jnp.minimum(per_node_m, jnp.maximum(kth_m, 0)),
+                per_node_m,
+            )
         feasible_m = per_node_m > 0
         # templates are pre-sorted by weight: first feasible wins
         m_star = jnp.argmax(feasible_m)
@@ -522,10 +556,19 @@ def pallas_enabled() -> bool:
 
 
 def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
-               use_pallas: bool | None = None) -> dict:
+               use_pallas: bool | None = None,
+               level_bits: int = _LEVEL_SEARCH_ITERS,
+               max_minv: int | None = None) -> dict:
     """The full single-call solve: feasibility + pack over one snapshot's
     arg dict (the canonical invocation shared by the solver, the sharded
     path, and the graft entry)."""
+    # the static minValues width must resolve HOST-side (it shapes the
+    # trace); jitted callers pass it explicitly
+    if max_minv is None:
+        import numpy as _np
+
+        mv = args.get("m_minv")
+        max_minv = int(_np.asarray(mv).max()) if mv is not None else 0
     # device arrays throughout: the scan body indexes these with traced
     # values, which numpy inputs cannot satisfy when called outside jit
     args = {k: jnp.asarray(v) for k, v in args.items()}
@@ -574,6 +617,8 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
         args["e_match"] = jnp.zeros((E, CW), dtype=jnp.uint32)
     if "e_aff" not in args:
         args["e_aff"] = jnp.zeros((E, args["g_aneed"].shape[1]), dtype=jnp.int32)
+    if "m_minv" not in args:
+        args["m_minv"] = jnp.zeros(args["m_overhead"].shape[0], dtype=jnp.int32)
     if use_pallas is None:
         # NOTE callers that cache jitted wrappers must resolve the flag
         # HOST-side and key their cache on it (models/solver.py does) or
@@ -597,8 +642,8 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
         args["ge_ok"], args["e_avail"], args["e_npods"], args["e_scnt"],
         args["e_decl"], args["e_match"], args["e_aff"],
         args["t_alloc"], args["t_cap"], args["t_tmpl"], args["m_mask"], args["m_has"],
-        args["m_overhead"], args["m_limits"], max_bins=max_bins,
-        with_existing=with_existing,
+        args["m_overhead"], args["m_limits"], args["m_minv"], max_bins=max_bins,
+        with_existing=with_existing, level_bits=level_bits, max_minv=max_minv,
     )
     out["F"] = F
     out["price"] = price

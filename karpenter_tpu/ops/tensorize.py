@@ -120,6 +120,7 @@ class DeviceSnapshot:
     m_tol: np.ndarray  # [M,K] bool (NotIn/DoesNotExist operators)
     m_overhead: np.ndarray  # [M,R] f32
     m_limits: np.ndarray  # [M,R] f32 (inf where unconstrained)
+    m_minv: np.ndarray  # [M] i32 required distinct instance types (minValues)
 
     ineligible_pods: list = field(default_factory=list)
 
@@ -535,11 +536,18 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     m_mask = np.zeros((M, K, W), dtype=np.uint32)
     m_has = np.zeros((M, K), dtype=bool)
     m_tol = np.zeros((M, K), dtype=bool)
+    # kernel-enforced minValues floor: required distinct instance types per
+    # claim (cloudprovider/types.go:165-199). Only the instance-type key is
+    # modeled on device — minValues on other keys stays a decode-time exact
+    # check that kicks violating bins to the host loop.
+    m_minv = np.zeros(M, dtype=np.int32)
     for m, tpl in enumerate(templates):
         m_mask[m], m_has[m] = build_mask_set(tpl.requirements)
         for r in tpl.requirements.values():
             if r.key in key_index:
                 m_tol[m, key_index[r.key]] = r.operator in (NOT_IN, DOES_NOT_EXIST)
+            if r.key == wk.INSTANCE_TYPE_LABEL and r.min_values:
+                m_minv[m] = int(r.min_values)
 
     # ---- flattened (template, type) axis; pre-filter type vs template ----
     type_refs = []
@@ -589,7 +597,7 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     cached = dict(
         vocab=vocab, keys=keys, key_index=key_index, W=W,
         build_mask_set=build_mask_set,
-        m_mask=m_mask, m_has=m_has, m_tol=m_tol,
+        m_mask=m_mask, m_has=m_has, m_tol=m_tol, m_minv=m_minv,
         type_refs=type_refs, t_mask=t_mask, t_has=t_has, t_tol=t_tol,
         t_alloc=t_alloc, t_cap=t_cap, t_tmpl=t_tmpl,
         off_zone=off_zone, off_ct=off_ct, off_avail=off_avail,
@@ -685,6 +693,7 @@ def tensorize(
 
     # ---- per-solve template tensors (overhead/limits change per round) ----
     m_mask, m_has, m_tol = ts["m_mask"], ts["m_has"], ts["m_tol"]
+    m_minv = ts["m_minv"]
     m_overhead = np.zeros((M, len(resources)), dtype=np.float32)
     m_limits = np.full((M, len(resources)), np.inf, dtype=np.float32)
     for m, tpl in enumerate(templates):
@@ -785,6 +794,7 @@ def tensorize(
         m_mask=m_mask,
         m_has=m_has,
         m_tol=m_tol,
+        m_minv=m_minv,
         m_overhead=m_overhead,
         m_limits=m_limits,
     )

@@ -26,11 +26,11 @@ MODEL_AXIS = "model"
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted_solve_step(max_bins: int):
-    """One jitted executable per max_bins; jax.jit's own cache handles the
-    per-shape/per-sharding specializations under it."""
+def _jitted_solve_step(max_bins: int, max_minv: int = 0):
+    """One jitted executable per (max_bins, minValues width); jax.jit's own
+    cache handles the per-shape/per-sharding specializations under it."""
     return jax.jit(functools.partial(kernels.solve_step, max_bins=max_bins,
-                                     use_pallas=False))
+                                     use_pallas=False, max_minv=max_minv))
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -127,6 +127,8 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
     # existing-node tensors: ge_ok rides the group axis; the per-node state
     # is scan-carried and stays replicated
     REPL_NAMES = ["m_mask", "m_has", "m_overhead", "m_limits"]
+    if "m_minv" in args:
+        REPL_NAMES.append("m_minv")
     if "m_tol" in args:
         REPL_NAMES.append("m_tol")
     if "ge_ok" in args:
@@ -147,5 +149,6 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
     for name in REPL_NAMES:
         placed[name] = shard(np.asarray(args[name]), P())
 
+    max_minv = int(np.asarray(args["m_minv"]).max()) if "m_minv" in args else 0
     with mesh:
-        return _jitted_solve_step(max_bins)(placed)
+        return _jitted_solve_step(max_bins, max_minv)(placed)

@@ -119,27 +119,39 @@ def run_consolidation_config(n_nodes=None):
     }))
 
 
-def run_grid():
+def run_grid(min_values: int | None = None):
     """The reference benchmark grid: pods x 400 types, diverse 1/6 mix
     (scheduling_benchmark_test.go:77-97, :234-248); its enforced floor is
-    100 pods/sec on batches over 100 pods."""
+    100 pods/sec on batches over 100 pods. `min_values` re-runs the grid
+    with the benchmark's minValues nodepool variant — instance-type Exists
+    with minValues=50 (scheduling_benchmark_test.go:145-163)."""
+    from karpenter_tpu.api import labels as wk
     from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
     from karpenter_tpu.api.nodepool import NodePool
-    from karpenter_tpu.api.objects import ObjectMeta
+    from karpenter_tpu.api.objects import NodeSelectorRequirement, ObjectMeta
 
     catalog = benchmark_catalog(400)
-    pools = [NodePool(metadata=ObjectMeta(name="default"))]
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    prefix = "grid"
+    if min_values:
+        pool.spec.template.requirements = [NodeSelectorRequirement(
+            wk.INSTANCE_TYPE_LABEL, "Exists", [], min_values=min_values)]
+        prefix = "grid-mv"
+    pools = [pool]
     for n in (1, 50, 100, 500, 1000, 2000, 5000):
-        # pin the bin axis so every grid size shares one compiled kernel
-        # (per-size shapes would each pay a fresh XLA compile on the chip)
-        run_solve_config(f"grid-{n}", C.diverse_pods(n), pools, catalog,
-                         max_bins=1024)
+        # the solver estimates the bin axis per shape (anti-class lower
+        # bound included); buckets keep the compile count small and the
+        # warm-up solve pays it
+        run_solve_config(f"{prefix}-{n}", C.diverse_pods(n), pools, catalog)
 
 
 def main():
     args = sys.argv[1:]
     if args == ["grid"]:
         run_grid()
+        return
+    if args == ["grid-mv"]:
+        run_grid(min_values=50)
         return
     picks = {int(a) for a in args} if args else {1, 2, 3, 4, 5}
     if 1 in picks:

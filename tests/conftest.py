@@ -13,6 +13,11 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# keep the XLA kernel under test: without this, every small-batch device
+# test would silently route to the C++ engine (models/solver.py small-batch
+# crossover) and the jax path would lose its coverage. The routing itself
+# is covered explicitly in test_native_solver.py::TestSmallBatchRouting.
+os.environ.setdefault("KARPENTER_NATIVE_CUTOFF", "0")
 # This image's sitecustomize imports jax and registers a PJRT plugin for the
 # tunneled TPU in every interpreter, so jax's config has already latched
 # JAX_PLATFORMS=axon by the time conftest runs — and initializing that

@@ -1,0 +1,129 @@
+"""The client seam + optimistic concurrency.
+
+Scenario sources: client-go's client.Client seam (the reference's
+controllers never touch etcd; operator.go:141), apiserver 409 semantics,
+and retry.RetryOnConflict.
+"""
+
+from dataclasses import replace
+
+import pytest
+
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.kube.client import KubeClient, retry_on_conflict
+from karpenter_tpu.kube.store import ConflictError, KubeStore
+
+
+def pod(name="p1"):
+    return Pod(metadata=ObjectMeta(name=name), requests={"cpu": 1.0})
+
+
+def detached_copy(obj):
+    """A snapshot another actor took earlier (same resourceVersion)."""
+    return replace(
+        obj,
+        metadata=replace(
+            obj.metadata,
+            labels=dict(obj.metadata.labels),
+            annotations=dict(obj.metadata.annotations),
+        ),
+    )
+
+
+class TestClientSeam:
+    def test_store_implements_the_full_surface(self):
+        """Every operation controllers perform is declared on KubeClient —
+        the store is swappable for anything speaking the same contract."""
+        assert isinstance(KubeStore(), KubeClient)
+        for op in ("create", "get", "try_get", "update", "delete", "list",
+                   "drain_events", "bind", "evict", "get_pvc",
+                   "get_storage_class", "get_pv"):
+            assert callable(getattr(KubeStore, op))
+            assert callable(getattr(KubeClient, op))
+
+
+class TestOptimisticConcurrency:
+    def test_stale_write_conflicts(self):
+        store = KubeStore()
+        p = store.create("pods", pod())
+        stale = detached_copy(p)
+        p.metadata.labels["x"] = "1"
+        store.update("pods", p)  # bumps resourceVersion
+        stale.metadata.labels["x"] = "2"
+        with pytest.raises(ConflictError):
+            store.update("pods", stale)
+        # the racing write never landed
+        assert store.get("pods", "p1").metadata.labels["x"] == "1"
+
+    def test_aliased_write_never_conflicts(self):
+        """The synchronous ring mutates the stored instance in place; those
+        writes are by definition current."""
+        store = KubeStore()
+        p = store.create("pods", pod())
+        for i in range(3):
+            p.metadata.labels["x"] = str(i)
+            store.update("pods", p)
+        assert store.get("pods", "p1").metadata.labels["x"] == "2"
+
+    def test_fresh_detached_copy_updates_once(self):
+        store = KubeStore()
+        p = store.create("pods", pod())
+        snap = detached_copy(p)
+        store.update("pods", snap)  # current version: accepted
+        with pytest.raises(ConflictError):
+            store.update("pods", detached_copy(p))  # p's version is now stale
+
+    def test_retry_on_conflict_rereads_and_lands(self):
+        store = KubeStore()
+        store.create("pods", pod())
+        stale = detached_copy(store.get("pods", "p1"))
+        p = store.get("pods", "p1")
+        p.metadata.labels["other"] = "writer"
+        store.update("pods", p)
+
+        attempts = []
+
+        def write():
+            attempts.append(1)
+            if len(attempts) == 1:
+                target = stale  # first try uses the stale snapshot
+            else:
+                target = detached_copy(store.get("pods", "p1"))  # re-read
+            target.metadata.labels["mine"] = "yes"
+            store.update("pods", target)
+
+        retry_on_conflict(write)
+        got = store.get("pods", "p1")
+        assert got.metadata.labels["mine"] == "yes"
+        assert got.metadata.labels["other"] == "writer"
+        assert len(attempts) == 2
+
+    def test_retry_exhaustion_raises(self):
+        store = KubeStore()
+        store.create("pods", pod())
+        stale = detached_copy(store.get("pods", "p1"))
+        p = store.get("pods", "p1")
+        store.update("pods", p)
+
+        def always_stale():
+            store.update("pods", stale)
+
+        with pytest.raises(ConflictError):
+            retry_on_conflict(always_stale, attempts=3)
+
+
+class TestNonRetryableConflicts:
+    def test_create_conflict_not_retried(self):
+        """'already exists' is not curable by re-reading: retry_on_conflict
+        must fail fast instead of repeating fn's side effects 5 times."""
+        store = KubeStore()
+        store.create("pods", pod())
+        attempts = []
+
+        def recreate():
+            attempts.append(1)
+            store.create("pods", pod())
+
+        with pytest.raises(ConflictError):
+            retry_on_conflict(recreate)
+        assert len(attempts) == 1

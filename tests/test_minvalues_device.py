@@ -1,0 +1,113 @@
+"""minValues on the device path: the kernel's per-bin distinct-type floor.
+
+Scenario sources: InstanceTypes.SatisfiesMinValues
+(pkg/cloudprovider/types.go:165-199) and the reference benchmark's
+minValues variant (scheduling_benchmark_test.go:145-163 — instance-type
+Exists with minValues=50).
+"""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import NodeSelectorRequirement, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import benchmark_catalog, make_instance_type
+from karpenter_tpu.models import ClaimTemplate, HostSolver, NativeSolver, TPUSolver
+
+GIB = 2**30
+
+
+def mv_pool(min_values=10):
+    np_ = NodePool(metadata=ObjectMeta(name="default"))
+    np_.spec.template.requirements = [NodeSelectorRequirement(
+        wk.INSTANCE_TYPE_LABEL, "Exists", [], min_values=min_values)]
+    return np_
+
+
+def pods(n, cpu=1.0):
+    return [Pod(metadata=ObjectMeta(name=f"p{i}"),
+                requests={"cpu": cpu, "memory": 1 * GIB}) for i in range(n)]
+
+
+@pytest.fixture(params=["tpu", "native"])
+def solver_cls(request):
+    if request.param == "native":
+        from karpenter_tpu import native
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        return NativeSolver
+    return TPUSolver
+
+
+def ladder_catalog(n=16):
+    """Types with strictly increasing capacity: a full bin shrinks its
+    surviving set from the bottom, making the minValues floor bite."""
+    return [make_instance_type(f"t{i:02d}", 2 + 2 * i, 8 + 8 * i) for i in range(n)]
+
+
+class TestKernelMinValuesFloor:
+    def test_claims_keep_min_distinct_types_on_device(self, solver_cls):
+        pool = mv_pool(min_values=10)
+        cat = ladder_catalog(16)
+        s = solver_cls()
+        res = s.solve(pods(40), [ClaimTemplate(pool)], {pool.name: cat})
+        assert res.scheduled_pod_count() == 40
+        # the kernel floor held: nothing was kicked to the host retry loop
+        assert s.last_device_stats["retry_pods"] == 0
+        for claim in res.new_claims:
+            assert len({it.name for it in claim.instance_types}) >= 10
+
+    def test_parity_with_host(self, solver_cls):
+        pool = mv_pool(min_values=10)
+        cat = ladder_catalog(16)
+        host = HostSolver().solve(
+            [p.clone() for p in pods(40)], [ClaimTemplate(mv_pool(10))],
+            {pool.name: cat})
+        dev = solver_cls().solve(
+            [p.clone() for p in pods(40)], [ClaimTemplate(mv_pool(10))],
+            {pool.name: cat})
+        assert dev.node_count() == host.node_count()
+        assert dev.scheduled_pod_count() == host.scheduled_pod_count()
+
+    def test_floor_packs_looser_than_no_floor(self, solver_cls):
+        """With the floor, a bin stops filling once the next pod would drop
+        its surviving set below minValues — more bins than unconstrained."""
+        cat = ladder_catalog(16)
+        pool_plain = NodePool(metadata=ObjectMeta(name="default"))
+        s = solver_cls()
+        plain = s.solve(pods(40), [ClaimTemplate(pool_plain)],
+                        {"default": cat})
+        constrained = solver_cls().solve(
+            pods(40), [ClaimTemplate(mv_pool(14))], {"default": cat})
+        assert constrained.node_count() >= plain.node_count()
+        for claim in constrained.new_claims:
+            assert len({it.name for it in claim.instance_types}) >= 14
+
+    def test_unsatisfiable_min_values_fails_both(self, solver_cls):
+        """minValues above the catalog size: no claim can open on either
+        engine (types.go:165's set can never be satisfied)."""
+        pool = mv_pool(min_values=20)
+        cat = ladder_catalog(8)
+        host = HostSolver().solve(
+            [p.clone() for p in pods(5)], [ClaimTemplate(mv_pool(20))],
+            {pool.name: cat})
+        dev = solver_cls().solve(
+            [p.clone() for p in pods(5)], [ClaimTemplate(mv_pool(20))],
+            {pool.name: cat})
+        assert host.node_count() == 0 and dev.node_count() == 0
+        assert len(dev.pod_errors) == 5
+
+    def test_benchmark_variant_rides_device(self):
+        """The reference's minValues=50 x 400-type benchmark shape: the
+        whole batch stays on the device with the floor enforced."""
+        pool = mv_pool(min_values=50)
+        cat = benchmark_catalog(400)
+        s = TPUSolver()
+        res = s.solve(pods(200, cpu=0.5), [ClaimTemplate(pool)],
+                      {pool.name: cat})
+        assert res.scheduled_pod_count() == 200
+        assert s.last_device_stats["retry_pods"] == 0
+        assert s.last_device_stats["host_pods"] == 0
+        for claim in res.new_claims:
+            assert len({it.name for it in claim.instance_types}) >= 50

@@ -162,3 +162,60 @@ class TestNativeParityRandom:
         assert nat.scheduled_pod_count() == 300
         # BASELINE parity gate: ≤2% node-count overhead vs the FFD oracle
         assert nat.node_count() <= max(host.node_count() * 1.02, host.node_count() + 1)
+
+
+class TestSmallBatchRouting:
+    """Below the measured crossover the TPUSolver swaps its kernel for the
+    C++ engine — the fixed dispatch/tunnel latency dominates small solves
+    (models/solver.py NATIVE_CUTOFF_PODS); large batches keep the device."""
+
+    def test_small_batch_routes_native(self, catalog, monkeypatch):
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        s = TPUSolver()
+        pool = nodepool()
+        s.solve([pod(f"p{i}") for i in range(10)], [ClaimTemplate(pool)],
+                {pool.name: catalog})
+        assert s.last_device_stats["engine"] == "native"
+
+    def test_large_batch_keeps_device(self, catalog, monkeypatch):
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        s = TPUSolver()
+        pool = nodepool()
+        s.solve([pod(f"p{i}") for i in range(300)], [ClaimTemplate(pool)],
+                {pool.name: catalog})
+        assert s.last_device_stats["engine"] == "device"
+
+    def test_cutoff_zero_disables_routing(self, catalog, monkeypatch):
+        from karpenter_tpu.models import TPUSolver
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", "0")
+        s = TPUSolver()
+        pool = nodepool()
+        s.solve([pod(f"p{i}") for i in range(10)], [ClaimTemplate(pool)],
+                {pool.name: catalog})
+        assert s.last_device_stats["engine"] == "device"
+
+    def test_small_batch_parity_native_vs_device(self, catalog, monkeypatch):
+        """The routed engine must give the same answer the device would."""
+        from karpenter_tpu.models import TPUSolver
+
+        pool = nodepool()
+        pods = [pod(f"p{i}", cpu=0.5 + (i % 3) * 0.5) for i in range(40)]
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", "192")
+        routed = TPUSolver()
+        r1 = routed.solve([p.clone() for p in pods], [ClaimTemplate(pool)],
+                          {pool.name: catalog})
+        assert routed.last_device_stats["engine"] == "native"
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", "0")
+        direct = TPUSolver()
+        r2 = direct.solve([p.clone() for p in pods], [ClaimTemplate(pool)],
+                          {pool.name: catalog})
+        assert direct.last_device_stats["engine"] == "device"
+        assert r1.node_count() == r2.node_count()
+        assert r1.scheduled_pod_count() == r2.scheduled_pod_count()
