@@ -111,6 +111,32 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
         res = solver.solve(pods, templates, its)
         elapsed = min(elapsed, time.perf_counter() - t0)
 
+    # pallas A/B on the real chip: the Mosaic compat kernel is kept as a
+    # measured reference (ops/pallas_kernels.py STATUS); record both sides
+    # so every round carries the evidence for the off-by-default choice
+    pallas = None
+    prior_pallas = os.environ.get("KARPENTER_PALLAS")
+    if engine == "axon" and prior_pallas is None:
+        os.environ["KARPENTER_PALLAS"] = "1"
+        try:
+            solver.solve(pods, templates, its)  # compile the pallas bucket
+            on_ms = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                solver.solve(pods, templates, its)
+                on_ms = min(on_ms, time.perf_counter() - t0)
+            pallas = {"on_ms": round(on_ms * 1000, 2),
+                      "off_ms": round(elapsed * 1000, 2),
+                      "default": "off (XLA fusion wins; see ops/pallas_kernels.py)"}
+        except Exception as e:
+            pallas = {"error": str(e)[:200]}
+        finally:
+            del os.environ["KARPENTER_PALLAS"]
+    elif engine == "axon":
+        # the user forced pallas for the whole run: the headline number IS
+        # the pallas path; no A/B (their environment is not ours to clear)
+        pallas = {"forced": prior_pallas}
+
     assert res.scheduled_pod_count() + len(res.pod_errors) == n_pods
     pods_per_sec = n_pods / elapsed
     return {
@@ -132,6 +158,7 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
             # host-side work alone.
             **({"harness_note": "wall clock includes one ~64ms tunnel round trip"}
                if engine == "axon" else {}),
+            **({"pallas": pallas} if pallas is not None else {}),
         },
     }
 

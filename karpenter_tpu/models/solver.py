@@ -431,12 +431,15 @@ class TPUSolver(Solver):
         # fill is the scan step's dominant op chain
         level_bits = 20
         if resutil.PODS in snap.resources:
-            pcap = float(snap.t_alloc[:, snap.resources.index(resutil.PODS)].max())
-            # existing nodes may already hold more pods than this solve's
+            pods_idx = snap.resources.index(resutil.PODS)
+            pcap = float(snap.t_alloc[:, pods_idx].max())
+            # existing nodes may hold AND absorb more pods than this solve's
             # catalog caps (deprecated type, another pool): the search range
-            # must reach their npods or the fill silently skips them
+            # must reach npods + remaining pods capacity or the fill
+            # silently under-places on them
             if esnap is not None and esnap.e_npods.size:
-                pcap = max(pcap, float(esnap.e_npods.max()))
+                e_need = esnap.e_npods + esnap.e_avail[:, pods_idx]
+                pcap = max(pcap, float(e_need.max()))
             if 0 < pcap < 1 << 18:
                 level_bits = max(4, int(np.ceil(np.log2(2 * pcap + 4))))
         max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
@@ -512,7 +515,7 @@ class TPUSolver(Solver):
         if mesh is not None and G * T * K * W >= SHARD_MIN_WORK:
             from karpenter_tpu.parallel import sharded_solve
 
-            out = sharded_solve(mesh, args, max_bins)
+            out = sharded_solve(mesh, args, max_bins, level_bits=key[-2])
             return jax.device_get(
                 {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}
             )

@@ -17,10 +17,19 @@ pods unassigned and is infeasible. The largest feasible prefix then gets
 the one real simulation (price filter, validation) — ≤2 device dispatches
 replacing the sequential ladder.
 
+Topology-bearing clusters ride the probe too: the waves compiler
+(ops/waves.py) turns the batch's spread/affinity/anti constraints into the
+same class tensors the solve path uses, with one counterfactual
+approximation — EVERY candidate's pods are excluded from the cluster domain
+counts (each prefix rebinds them), so prefixes that keep some candidates
+alive see slightly lower counts than the exact simulation. That direction
+only loosens the probe, and every answer is re-validated.
+
 The probe is a sound PREFILTER, not the decision: anything it cannot
-express (topology constraints, non-device-eligible pods, volume limits)
-returns None and the caller falls back to the sequential search; a probe
-hit is always re-validated by the full simulation before a command ships.
+express (waves-inexpressible shapes, non-basic-eligible pods, volume
+limits) returns None and the caller falls back to the sequential search; a
+probe hit is always re-validated by the full simulation before a command
+ships.
 """
 
 from __future__ import annotations
@@ -29,10 +38,10 @@ import functools
 
 import numpy as np
 
-from karpenter_tpu.models.scheduler import NullTopology
 from karpenter_tpu.ops.tensorize import (
     bucket as _bucket,
-    device_eligible,
+    device_basic_eligible,
+    group_by_signature,
     pad_to as pad,
     tensorize,
     tensorize_existing,
@@ -40,13 +49,16 @@ from karpenter_tpu.ops.tensorize import (
 
 
 @functools.lru_cache(maxsize=8)
-def _batched_kernel(max_bins: int):
+def _batched_kernel(max_bins: int, max_minv: int = 0):
     import jax
 
     from karpenter_tpu.ops import kernels
 
     def probe(args):
-        out = kernels.solve_step(args, max_bins=max_bins, use_pallas=False)
+        # max_minv is threaded statically: solve_step's host-side read of
+        # m_minv cannot run on a tracer under this jit/vmap
+        out = kernels.solve_step(args, max_bins=max_bins, use_pallas=False,
+                                 max_minv=max_minv)
         placed = out["assign"].sum() + out["assign_e"].sum()
         return placed, out["used"].sum()
 
@@ -76,15 +88,25 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
     all_pods = pending + [p for ps in cand_pods for p in ps]
     if not all_pods:
         return None
-    if any(not device_eligible(p) for p in all_pods):
+    if any(not device_basic_eligible(p) for p in all_pods):
         return None
 
-    templates, its_by_pool, overhead, limits, _domains = provisioner.solver_inputs()
+    templates, its_by_pool, overhead, limits, domains = provisioner.solver_inputs()
     if not templates:
         return None
 
+    # counterfactual topology: all candidate pods excluded from the cluster
+    # domain counts (helpers.go:51's excluded-pod stance, applied across
+    # every prefix at once)
+    from karpenter_tpu.controllers.provisioning.provisioner import ClusterStateView
+    from karpenter_tpu.models.topology import Topology
+    from karpenter_tpu.ops import waves
+
+    view = ClusterStateView(cluster, store)
+    topology = Topology(cluster=view, domains=domains, pods=all_pods)
+
     state_nodes = list(cluster.nodes())
-    enodes = provisioner._existing_nodes(state_nodes, NullTopology())
+    enodes = provisioner._existing_nodes(state_nodes, topology)
     by_pid = {e.state_node.provider_id: i for i, e in enumerate(enodes)}
     cand_cols = []
     for c in candidates:
@@ -93,13 +115,19 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
             return None  # candidate invisible to the probe: stay sequential
         cand_cols.append(i)
 
+    plan = None
+    if topology.has_groups:
+        plan = waves.compile_topology(group_by_signature(all_pods), topology)
+        if plan.host_pods:
+            return None  # waves-inexpressible shape: stay sequential
+
     snap = tensorize(
-        all_pods, templates, its_by_pool, daemon_overhead=overhead,
-        limits=limits or None,
+        all_pods if plan is None else None, templates, its_by_pool,
+        daemon_overhead=overhead, limits=limits or None, device_plan=plan,
     )
     if snap.G == 0:
         return None
-    esnap = tensorize_existing(snap, enodes)
+    esnap = tensorize_existing(snap, enodes, plan)
 
     # per-group pod counts: pending base + per-candidate contributions.
     # Row 0 is the PREFIX-0 BASELINE (pending pods only, every node alive):
@@ -145,8 +173,20 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
         g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
         g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
         g_tmpl_ok=pad(snap.g_tmpl_ok, (Gp, M)),
+        g_bin_cap=pad(snap.g_bin_cap, (Gp,)),
+        g_single=pad(snap.g_single, (Gp,)),
+        g_decl=pad(snap.g_decl, (Gp, snap.g_decl.shape[1])),
+        g_match=pad(snap.g_match, (Gp, snap.g_match.shape[1])),
+        g_sown=pad(snap.g_sown, (Gp, snap.g_sown.shape[1])),
+        g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
+        g_aneed=pad(snap.g_aneed, (Gp, snap.g_aneed.shape[1])),
+        g_amatch=pad(snap.g_amatch, (Gp, snap.g_amatch.shape[1])),
         ge_ok=pad(esnap.ge_ok, (Gp, Ep)),
         e_npods=pad(esnap.e_npods, (Ep,)),
+        e_scnt=pad(esnap.e_scnt, (Ep, esnap.e_scnt.shape[1])),
+        e_decl=pad(esnap.e_decl, (Ep, esnap.e_decl.shape[1])),
+        e_match=pad(esnap.e_match, (Ep, esnap.e_match.shape[1])),
+        e_aff=pad(esnap.e_aff, (Ep, esnap.e_aff.shape[1])),
         t_mask=pad(snap.t_mask, (Tp,) + snap.t_mask.shape[1:]),
         t_has=pad(snap.t_has, (Tp,) + snap.t_has.shape[1:]),
         t_alloc=pad(snap.t_alloc, (Tp, R)),
@@ -160,13 +200,15 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
         m_has=snap.m_has,
         m_overhead=snap.m_overhead,
         m_limits=snap.m_limits,
+        m_minv=snap.m_minv,
     )
     varying = dict(
         g_count=pad(g_count_k, (Np, Gp)),
         e_avail=pad(e_avail_k, (Np, Ep, R)),
     )
 
-    placed, _used = _batched_kernel(1)(varying, shared)
+    max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
+    placed, _used = _batched_kernel(1, max_minv)(varying, shared)
     placed = np.asarray(placed)[: N + 1]
     need = g_count_k.sum(axis=1)
     # prefix k feasible iff its displaced pods ALL land on top of whatever

@@ -26,11 +26,13 @@ MODEL_AXIS = "model"
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted_solve_step(max_bins: int, max_minv: int = 0):
-    """One jitted executable per (max_bins, minValues width); jax.jit's own
-    cache handles the per-shape/per-sharding specializations under it."""
+def _jitted_solve_step(max_bins: int, max_minv: int = 0, level_bits: int = 20):
+    """One jitted executable per (max_bins, minValues width, level bits);
+    jax.jit's own cache handles the per-shape/per-sharding specializations
+    under it."""
     return jax.jit(functools.partial(kernels.solve_step, max_bins=max_bins,
-                                     use_pallas=False, max_minv=max_minv))
+                                     use_pallas=False, max_minv=max_minv,
+                                     level_bits=level_bits))
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -88,7 +90,7 @@ def _pad_to(a: np.ndarray, axis: int, mult: int) -> np.ndarray:
     return np.pad(a, pad)
 
 
-def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
+def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
     """Full solve step (feasibility + pack) with the feasibility inputs
     sharded over the mesh. Returns the same outputs as the unsharded path.
 
@@ -151,4 +153,4 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int):
 
     max_minv = int(np.asarray(args["m_minv"]).max()) if "m_minv" in args else 0
     with mesh:
-        return _jitted_solve_step(max_bins, max_minv)(placed)
+        return _jitted_solve_step(max_bins, max_minv, level_bits)(placed)

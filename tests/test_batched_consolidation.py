@@ -95,9 +95,9 @@ class TestBatchedConsolidation:
         assert end_nodes < 8
         assert mnc(env).last_probe == "device"
 
-    def test_probe_falls_back_on_topology_pods(self):
-        # topology-bearing pods aren't probe-expressible: the method must
-        # still answer via the sequential path
+    def test_topology_cluster_rides_device_probe(self):
+        # topology-bearing pods compile through the waves plan: the probe
+        # stays on the device AND agrees with the sequential search
         from karpenter_tpu.api import labels as wk
         from karpenter_tpu.api.objects import LabelSelector, TopologySpreadConstraint
 
@@ -111,5 +111,36 @@ class TestBatchedConsolidation:
                 label_selector=LabelSelector(match_labels={"app": "x"}))]
             p.metadata.labels["app"] = "x"
             env.store.update("pods", p)
+        cmd_dev, probe_dev = compute(env)
+        assert probe_dev == "device"
+        cmd_seq, probe_seq = compute(env, force_sequential=True)
+        assert probe_seq == "sequential"
+        assert (cmd_dev is None) == (cmd_seq is None)
+        if cmd_dev is not None:
+            assert {c.name for c in cmd_dev.candidates} == {
+                c.name for c in cmd_seq.candidates
+            }
+
+    def test_probe_falls_back_on_preferred_affinity(self):
+        # preferred terms need the host relaxation ladder — not
+        # waves-expressible, so the method answers sequentially
+        from karpenter_tpu.api.objects import (
+            Affinity,
+            LabelSelector,
+            PodAffinity,
+            PodAffinityTerm,
+            WeightedPodAffinityTerm,
+        )
+        from karpenter_tpu.api import labels as wk
+
+        env = build_env(n_nodes=4)
+        pods = [p for p in env.store.list("pods") if p.node_name]
+        assert pods
+        p = pods[0]
+        p.affinity = Affinity(pod_affinity=PodAffinity(preferred=[
+            WeightedPodAffinityTerm(weight=1, pod_affinity_term=PodAffinityTerm(
+                topology_key=wk.HOSTNAME_LABEL,
+                label_selector=LabelSelector(match_labels={"app": "y"})))]))
+        env.store.update("pods", p)
         cmd, probe = compute(env)
         assert probe == "sequential"

@@ -38,8 +38,9 @@ class TestClientSeam:
         for op in ("create", "get", "try_get", "update", "delete", "list",
                    "drain_events", "bind", "evict", "get_pvc",
                    "get_storage_class", "get_pv"):
-            assert callable(getattr(KubeStore, op))
             assert callable(getattr(KubeClient, op))
+            # the store must OVERRIDE the stub, not inherit its raise
+            assert op in KubeStore.__dict__, f"KubeStore missing {op}"
 
 
 class TestOptimisticConcurrency:

@@ -73,9 +73,9 @@ class TestShardedSolve:
 
         orig = parallel.sharded_solve
 
-        def spy(mesh, args, max_bins):
+        def spy(mesh, args, max_bins, level_bits=20):
             calls["used"] = True
-            return orig(mesh, args, max_bins)
+            return orig(mesh, args, max_bins, level_bits=level_bits)
 
         from karpenter_tpu.api.nodepool import NodePool
         from karpenter_tpu.api.objects import ObjectMeta, Pod
