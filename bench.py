@@ -116,12 +116,14 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
     # so every round carries the evidence for the off-by-default choice
     pallas = None
     prior_pallas = os.environ.get("KARPENTER_PALLAS")
-    if engine == "axon" and prior_pallas is None:
+    if engine == "axon" and prior_pallas != "1":
         os.environ["KARPENTER_PALLAS"] = "1"
         try:
             solver.solve(pods, templates, its)  # compile the pallas bucket
+            # same rep count as the headline loop: tunnel jitter is tens of
+            # ms, so an unequal best-of would bias the A/B by itself
             on_ms = float("inf")
-            for _ in range(2):
+            for _ in range(5):
                 t0 = time.perf_counter()
                 solver.solve(pods, templates, its)
                 on_ms = min(on_ms, time.perf_counter() - t0)
@@ -131,10 +133,14 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
         except Exception as e:
             pallas = {"error": str(e)[:200]}
         finally:
-            del os.environ["KARPENTER_PALLAS"]
+            if prior_pallas is None:
+                del os.environ["KARPENTER_PALLAS"]
+            else:
+                os.environ["KARPENTER_PALLAS"] = prior_pallas
     elif engine == "axon":
-        # the user forced pallas for the whole run: the headline number IS
-        # the pallas path; no A/B (their environment is not ours to clear)
+        # pallas_enabled() honors only "1": the user forced the pallas path
+        # for the whole run, so the headline number IS pallas-on; no A/B
+        # (their environment is not ours to clear)
         pallas = {"forced": prior_pallas}
 
     assert res.scheduled_pod_count() + len(res.pod_errors) == n_pods

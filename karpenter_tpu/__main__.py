@@ -1,0 +1,152 @@
+"""Deployable operator entrypoint: `python -m karpenter_tpu`.
+
+The kwok/main.go analog (kwok/main.go:33-48 + operator.go:111-220): wire
+the operator runtime — store, kwok cloud provider, the full controller
+ring, disruption — and run it against wall-clock time until SIGINT/SIGTERM.
+The backing store is the in-memory KubeStore by default (the hermetic
+kwok-style deployment this image supports); anything implementing the
+KubeClient seam (kube/client.py) can be injected in its place to front a
+real apiserver.
+
+    python -m karpenter_tpu --manifest cluster.json [--tick 1.0] [--metrics]
+
+Manifests are JSON documents (a single object or a list) in EITHER
+karpenter.sh API version — NodePool/NodeClaim wire docs run through the
+conversion layer (api/conversion.py), the kwok catalog backs instance
+types, and a `pods` shorthand ({"kind": "Pod", "name", "cpu", "memory",
+"replicas"}) seeds workload. The /metrics endpoint serves the Prometheus
+registry on KARPENTER_METRICS_PORT (operator.go:160's mux analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+
+GIB = 2**30
+
+
+def load_manifest(env, path: str) -> int:
+    """Apply a JSON manifest file (v1 or v1beta1 docs) to the store."""
+    from karpenter_tpu.api.conversion import decode
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+
+    with open(path) as f:
+        docs = json.load(f)
+    if isinstance(docs, dict):
+        docs = [docs]
+    n = 0
+    for doc in docs:
+        kind = doc.get("kind", "")
+        if kind == "NodePool":
+            env.store.create("nodepools", decode(doc))
+            n += 1
+        elif kind == "NodeClaim":
+            env.store.create("nodeclaims", decode(doc))
+            n += 1
+        elif kind == "Pod":
+            replicas = int(doc.get("replicas", 1))
+            for i in range(replicas):
+                name = doc.get("name", "pod")
+                env.store.create("pods", Pod(
+                    metadata=ObjectMeta(
+                        name=f"{name}-{i}" if replicas > 1 else name,
+                        labels=dict(doc.get("labels", {})),
+                    ),
+                    requests={
+                        "cpu": float(doc.get("cpu", 1.0)),
+                        "memory": float(doc.get("memory", 1.0)) * GIB,
+                    },
+                ))
+                n += 1
+        else:
+            raise SystemExit(f"unsupported manifest kind {kind!r}")
+    return n
+
+
+def serve_metrics(registry, port: int):
+    """Prometheus text endpoint (the operator.go:160 metrics mux analog)."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path not in ("/metrics", "/healthz"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = (registry.expose() if self.path == "/metrics" else "ok").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = HTTPServer(("127.0.0.1", port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="karpenter_tpu")
+    ap.add_argument("--manifest", action="append", default=[],
+                    help="JSON manifest file(s) applied at startup")
+    ap.add_argument("--tick", type=float, default=1.0,
+                    help="reconcile tick seconds (controller poll cadence)")
+    ap.add_argument("--metrics", action="store_true",
+                    help="serve /metrics + /healthz on KARPENTER_METRICS_PORT")
+    ap.add_argument("--max-ticks", type=int, default=0,
+                    help="exit after N ticks (0 = run until signal)")
+    args = ap.parse_args(argv)
+
+    from karpenter_tpu.operator import Environment
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.utils.clock import Clock
+
+    options = Options.from_env()
+    env = Environment(
+        clock=Clock(),  # wall-clock: budgets/TTLs run in real time
+        sync=False,  # production batching window (1s idle / 10s max)
+        enable_disruption=True,
+        options=options,
+    )
+
+    applied = sum(load_manifest(env, m) for m in args.manifest)
+    print(f"karpenter-tpu operator: {applied} manifest objects applied, "
+          f"tick={args.tick}s", file=sys.stderr)
+
+    server = serve_metrics(env.registry, options.metrics_port) if args.metrics else None
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # non-main thread (tests)
+
+    ticks = 0
+    try:
+        while not stop.is_set():
+            env.run_until_idle()
+            ticks += 1
+            if args.max_ticks and ticks >= args.max_ticks:
+                break
+            stop.wait(args.tick)
+    finally:
+        if server is not None:
+            server.shutdown()
+    nodes = len(env.store.list("nodes"))
+    bound = sum(1 for p in env.store.list("pods") if p.node_name)
+    print(f"karpenter-tpu operator: stopped after {ticks} ticks "
+          f"({nodes} nodes, {bound} bound pods)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,8 +22,12 @@ Topology-bearing clusters ride the probe too: the waves compiler
 same class tensors the solve path uses, with one counterfactual
 approximation — EVERY candidate's pods are excluded from the cluster domain
 counts (each prefix rebinds them), so prefixes that keep some candidates
-alive see slightly lower counts than the exact simulation. That direction
-only loosens the probe, and every answer is re-validated.
+alive see slightly lower counts than the exact simulation. The error runs
+in BOTH directions (lower anti/spread counts loosen the probe; lower
+affinity match counts tighten it, so an affinity-dependent prefix can read
+infeasible), which is why every probe answer is only a SEED: the winner is
+confirmed by the real simulation and a mis-estimate degenerates into the
+sequential binary search around k, never a skipped consolidation.
 
 The probe is a sound PREFILTER, not the decision: anything it cannot
 express (waves-inexpressible shapes, non-basic-eligible pods, volume
@@ -166,9 +170,14 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
 
     R = len(snap.resources)
     M = len(snap.templates)
+    K = len(snap.keys)
+    # NOTE: keep this assembly in lockstep with models/solver.py
+    # _run_and_decode's args dict — a field missed here silently weakens
+    # the probe (it under- or over-estimates and burns the dispatch)
     shared = dict(
         g_mask=pad(snap.g_mask, (Gp,) + snap.g_mask.shape[1:]),
         g_has=pad(snap.g_has, (Gp,) + snap.g_has.shape[1:]),
+        g_tol=pad(snap.g_tol, (Gp, K)),
         g_demand=pad(snap.g_demand, (Gp, R)),
         g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
         g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
@@ -189,6 +198,7 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
         e_aff=pad(esnap.e_aff, (Ep, esnap.e_aff.shape[1])),
         t_mask=pad(snap.t_mask, (Tp,) + snap.t_mask.shape[1:]),
         t_has=pad(snap.t_has, (Tp,) + snap.t_has.shape[1:]),
+        t_tol=pad(snap.t_tol, (Tp, K)),
         t_alloc=pad(snap.t_alloc, (Tp, R)),
         t_cap=pad(snap.t_cap, (Tp, R)),
         t_tmpl=pad(snap.t_tmpl, (Tp,)),
@@ -198,6 +208,7 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
         off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
         m_mask=snap.m_mask,
         m_has=snap.m_has,
+        m_tol=snap.m_tol,
         m_overhead=snap.m_overhead,
         m_limits=snap.m_limits,
         m_minv=snap.m_minv,

@@ -146,9 +146,24 @@ class WavesPlan:
 
 
 def _group_key(g0):
+    # FFD order (queue.go:37) with a most-constrained-first tie-break:
+    # groups that will carry per-bin caps (required anti-affinity, hostname
+    # spread) scan before unconstrained equals, so the bins their caps force
+    # open are still fillable by the flexible groups behind them. Measured
+    # on the anti+spread 5k config: 84 → 82 bins vs the host oracle's 81
+    # (the host interleaves pod-at-a-time, which achieves the same effect).
+    a = g0.affinity
+    capped = bool(
+        (a and a.pod_anti_affinity and a.pod_anti_affinity.required)
+        or any(
+            c.topology_key == wk.HOSTNAME_LABEL
+            for c in g0.topology_spread_constraints
+        )
+    )
     return (
         -g0.effective_requests().get(resutil.CPU, 0.0),
         -g0.effective_requests().get(resutil.MEMORY, 0.0),
+        0 if capped else 1,
     )
 
 

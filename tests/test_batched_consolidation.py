@@ -144,3 +144,39 @@ class TestBatchedConsolidation:
         env.store.update("pods", p)
         cmd, probe = compute(env)
         assert probe == "sequential"
+
+    def test_probe_args_stay_in_lockstep_with_solver(self, monkeypatch):
+        """Drift guard: the probe must feed the kernel every tensor family
+        the solve path does (a missed field silently weakens the probe —
+        g_tol/t_tol/m_tol were once dropped and tainted pools read as
+        intolerable)."""
+        from karpenter_tpu.ops import consolidate as cons
+
+        captured = {}
+        orig = cons._batched_kernel
+
+        def spy(max_bins, max_minv=0):
+            fn = orig(max_bins, max_minv)
+
+            def wrapped(varying, shared):
+                captured["keys"] = set(shared) | set(varying)
+                return fn(varying, shared)
+
+            return wrapped
+
+        monkeypatch.setattr(cons, "_batched_kernel", spy)
+        env = build_env(n_nodes=4)
+        cmd, probe = compute(env)
+        assert probe == "device" and "keys" in captured
+        expected = {
+            "g_mask", "g_has", "g_tol", "g_demand", "g_count",
+            "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok", "g_bin_cap",
+            "g_single", "g_decl", "g_match", "g_sown", "g_smatch",
+            "g_aneed", "g_amatch", "ge_ok", "e_avail", "e_npods", "e_scnt",
+            "e_decl", "e_match", "e_aff", "t_mask", "t_has", "t_tol",
+            "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail",
+            "off_price", "m_mask", "m_has", "m_tol", "m_overhead",
+            "m_limits", "m_minv",
+        }
+        missing = expected - captured["keys"]
+        assert not missing, f"probe no longer feeds the kernel: {missing}"

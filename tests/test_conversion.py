@@ -1,0 +1,158 @@
+"""Dual API version conversion (karpenter.sh/v1beta1 ↔ v1).
+
+Scenario sources: the reference's staged-version registry
+(pkg/apis/apis.go:33-43), the conversion webhooks (webhooks.go:82-125), and
+the real v1 migration's renames (consolidationPolicy, expireAfter move,
+kubelet compatibility annotation).
+"""
+
+import pytest
+
+from karpenter_tpu.api.conversion import (
+    KUBELET_COMPAT_ANNOTATION,
+    V1,
+    V1BETA1,
+    ConversionError,
+    decode,
+    encode,
+    format_duration,
+    parse_duration,
+)
+
+
+class TestDurations:
+    @pytest.mark.parametrize("wire,seconds", [
+        ("720h", 720 * 3600.0),
+        ("30m", 1800.0),
+        ("1h30m", 5400.0),
+        ("45s", 45.0),
+        ("Never", None),
+        (None, None),
+    ])
+    def test_parse(self, wire, seconds):
+        assert parse_duration(wire) == seconds
+
+    def test_round_trip(self):
+        for wire in ("720h", "1h30m", "45s", "Never"):
+            assert format_duration(parse_duration(wire)) == wire
+
+    def test_invalid_rejected(self):
+        with pytest.raises(ConversionError):
+            parse_duration("3 hours")
+
+
+V1BETA1_NODEPOOL = {
+    "apiVersion": V1BETA1,
+    "kind": "NodePool",
+    "metadata": {"name": "default"},
+    "spec": {
+        "weight": 5,
+        "limits": {"cpu": "100"},
+        "template": {
+            "metadata": {"labels": {"team": "infra"}},
+            "spec": {
+                "taints": [{"key": "dedicated", "value": "gpu",
+                            "effect": "NoSchedule"}],
+                "requirements": [
+                    {"key": "karpenter.sh/capacity-type", "operator": "In",
+                     "values": ["spot"]},
+                    {"key": "node.kubernetes.io/instance-type",
+                     "operator": "Exists", "minValues": 50},
+                ],
+                "kubelet": {"maxPods": 42},
+            },
+        },
+        "disruption": {
+            "consolidationPolicy": "WhenUnderutilized",
+            "consolidateAfter": "30s",
+            "expireAfter": "720h",
+            "budgets": [{"nodes": "10%"},
+                        {"nodes": "0", "schedule": "0 9 * * 1-5",
+                         "duration": "8h", "reasons": ["Underutilized"]}],
+        },
+    },
+}
+
+
+class TestNodePoolConversion:
+    def test_v1beta1_decode(self):
+        np_ = decode(V1BETA1_NODEPOOL)
+        assert np_.name == "default"
+        assert np_.spec.weight == 5
+        assert np_.spec.disruption.consolidation_policy == "WhenUnderutilized"
+        assert np_.spec.disruption.expire_after == 720 * 3600.0
+        assert np_.spec.disruption.consolidate_after == 30.0
+        assert np_.spec.template.kubelet == {"maxPods": 42}
+        assert np_.spec.template.requirements[1].min_values == 50
+        assert np_.spec.disruption.budgets[1].duration == 8 * 3600.0
+
+    def test_v1_encode_applies_the_migration(self):
+        np_ = decode(V1BETA1_NODEPOOL)
+        v1 = encode(np_, V1)
+        # policy renamed
+        assert v1["spec"]["disruption"]["consolidationPolicy"] == (
+            "WhenEmptyOrUnderutilized")
+        # expireAfter moved to the claim template
+        assert v1["spec"]["template"]["spec"]["expireAfter"] == "720h"
+        assert "expireAfter" not in v1["spec"]["disruption"]
+        # kubelet left the NodePool, preserved in the compat annotation
+        assert "kubelet" not in v1["spec"]["template"]["spec"]
+        assert KUBELET_COMPAT_ANNOTATION in v1["metadata"]["annotations"]
+
+    def test_v1_round_trip_preserves_everything(self):
+        hub = decode(V1BETA1_NODEPOOL)
+        again = decode(encode(hub, V1))
+        assert again.spec.disruption.consolidation_policy == "WhenUnderutilized"
+        assert again.spec.disruption.expire_after == 720 * 3600.0
+        assert again.spec.template.kubelet == {"maxPods": 42}
+        assert again.static_hash() == hub.static_hash()
+
+    def test_v1beta1_round_trip_identity(self):
+        hub = decode(V1BETA1_NODEPOOL)
+        wire = encode(hub, V1BETA1)
+        assert decode(wire).static_hash() == hub.static_hash()
+        assert wire["spec"]["disruption"]["consolidationPolicy"] == (
+            "WhenUnderutilized")
+        assert wire["spec"]["template"]["spec"]["kubelet"] == {"maxPods": 42}
+
+    def test_cross_version_clients_share_one_object(self):
+        """A v1beta1 write read back as v1 (and vice versa) is the SAME
+        semantic object — the point of hub-spoke conversion."""
+        hub = decode(V1BETA1_NODEPOOL)
+        as_v1 = encode(hub, V1)
+        hub2 = decode(as_v1)
+        as_beta = encode(hub2, V1BETA1)
+        assert as_beta["spec"]["disruption"]["expireAfter"] == "720h"
+        assert as_beta["spec"]["template"]["spec"]["kubelet"] == {"maxPods": 42}
+
+
+class TestNodeClaimConversion:
+    def test_round_trip(self):
+        doc = {
+            "apiVersion": V1,
+            "kind": "NodeClaim",
+            "metadata": {"name": "claim-1"},
+            "spec": {
+                "requirements": [{"key": "topology.kubernetes.io/zone",
+                                  "operator": "In", "values": ["zone-1"]}],
+                "resources": {"requests": {"cpu": "2"}},
+                "expireAfter": "24h",
+            },
+            "status": {"providerID": "pid-1", "nodeName": "n1"},
+        }
+        nc = decode(doc)
+        assert nc.spec.terminate_after == 24 * 3600.0
+        assert nc.status.provider_id == "pid-1"
+        v1b = encode(nc, V1BETA1)
+        assert v1b["spec"]["terminateAfter"] == "24h"
+        assert decode(v1b).spec.terminate_after == 24 * 3600.0
+
+
+class TestErrors:
+    def test_unknown_version(self):
+        with pytest.raises(ConversionError):
+            decode({"apiVersion": "karpenter.sh/v2", "kind": "NodePool"})
+
+    def test_unknown_kind(self):
+        with pytest.raises(ConversionError):
+            decode({"apiVersion": V1, "kind": "Widget"})

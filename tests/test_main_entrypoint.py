@@ -1,0 +1,70 @@
+"""The deployable entrypoint (`python -m karpenter_tpu`): manifests in via
+the conversion layer, a real-time reconcile loop, and the metrics endpoint
+(the kwok/main.go:33-48 + operator.go:111-220 analog)."""
+
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture
+def manifest(tmp_path):
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps([
+        {"apiVersion": "karpenter.sh/v1", "kind": "NodePool",
+         "metadata": {"name": "default"},
+         "spec": {"template": {"spec": {"expireAfter": "720h"}},
+                  "disruption": {
+                      "consolidationPolicy": "WhenEmptyOrUnderutilized",
+                      "budgets": [{"nodes": "10%"}]}}},
+        {"kind": "Pod", "name": "web", "cpu": 1.0, "memory": 2.0,
+         "replicas": 4},
+    ]))
+    return str(p)
+
+
+class TestOperatorMain:
+    def test_provisions_from_v1_manifest(self, manifest, monkeypatch, capsys):
+        # collapse the production batch window so the test finishes fast
+        monkeypatch.setenv("KARPENTER_BATCH_IDLE_DURATION", "0")
+        monkeypatch.setenv("KARPENTER_BATCH_MAX_DURATION", "0")
+        from karpenter_tpu.__main__ import main
+
+        assert main(["--manifest", manifest, "--tick", "0.01",
+                     "--max-ticks", "30"]) == 0
+        err = capsys.readouterr().err
+        assert "5 manifest objects applied" in err
+        assert "0 nodes" not in err and "0 bound" not in err
+
+    def test_metrics_endpoint_serves_registry(self, manifest, monkeypatch):
+        monkeypatch.setenv("KARPENTER_BATCH_IDLE_DURATION", "0")
+        monkeypatch.setenv("KARPENTER_BATCH_MAX_DURATION", "0")
+        monkeypatch.setenv("KARPENTER_METRICS_PORT", "18765")
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.__main__ import load_manifest, serve_metrics
+        from karpenter_tpu.utils.clock import Clock
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(clock=Clock(), sync=True, options=Options.from_env())
+        load_manifest(env, manifest)
+        env.run_until_idle()
+        server = serve_metrics(env.registry, 18765)
+        try:
+            body = urllib.request.urlopen(
+                "http://127.0.0.1:18765/metrics", timeout=5).read().decode()
+            assert "karpenter_" in body
+            health = urllib.request.urlopen(
+                "http://127.0.0.1:18765/healthz", timeout=5).read().decode()
+            assert health == "ok"
+        finally:
+            server.shutdown()
+
+    def test_unknown_kind_rejected(self, tmp_path):
+        from karpenter_tpu.__main__ import load_manifest
+        from karpenter_tpu.operator import Environment
+
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"kind": "Widget"}))
+        with pytest.raises(SystemExit):
+            load_manifest(Environment(), str(p))
