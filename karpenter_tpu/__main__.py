@@ -43,11 +43,9 @@ def load_manifest(env, path: str) -> int:
     for doc in docs:
         kind = doc.get("kind", "")
         if kind == "NodePool":
-            env.store.create("nodepools", decode(doc))
-            n += 1
+            n += _apply(env, "nodepools", doc)
         elif kind == "NodeClaim":
-            env.store.create("nodeclaims", decode(doc))
-            n += 1
+            n += _apply(env, "nodeclaims", doc)
         elif kind == "Pod":
             replicas = int(doc.get("replicas", 1))
             for i in range(replicas):
@@ -66,6 +64,18 @@ def load_manifest(env, path: str) -> int:
         else:
             raise SystemExit(f"unsupported manifest kind {kind!r}")
     return n
+
+
+def _apply(env, plural: str, doc: dict) -> int:
+    from karpenter_tpu.api.conversion import ConversionError, decode
+
+    try:
+        env.store.create(plural, decode(doc))
+    except ConversionError as e:
+        raise SystemExit(
+            f"manifest {doc.get('kind')}/{doc.get('metadata', {}).get('name')}: {e}"
+        ) from e
+    return 1
 
 
 def serve_metrics(registry, port: int):
@@ -87,7 +97,10 @@ def serve_metrics(registry, port: int):
         def log_message(self, *a):  # quiet
             pass
 
-    server = HTTPServer(("127.0.0.1", port), Handler)
+    # all interfaces: a container's Prometheus scrape arrives on the pod IP
+    # (operator.go's mux binds the same way); loopback would be dead in the
+    # deployment this entrypoint exists for
+    server = HTTPServer(("", port), Handler)
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server

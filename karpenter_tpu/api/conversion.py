@@ -167,6 +167,9 @@ def _nodepool_from(doc: dict, version: str) -> NodePool:
         stash = meta.annotations.get(KUBELET_COMPAT_ANNOTATION)
         if stash:
             kubelet = json.loads(stash)
+    # the stash is an encode-time artifact, not hub state: leaving it on the
+    # hub object would resurrect a later-cleared kubelet on the next encode
+    meta.annotations.pop(KUBELET_COMPAT_ANNOTATION, None)
 
     return NodePool(
         metadata=meta,
@@ -272,14 +275,21 @@ def _nodeclaim_from(doc: dict, version: str) -> NodeClaim:
     else:
         expire = parse_duration(spec.get("terminateAfter") or spec.get("expireAfter"))
     status = doc.get("status", {})
+    meta = _meta_from(doc)
+    kubelet = dict(spec.get("kubelet", {}))
+    if version == V1 and not kubelet:
+        stash = meta.annotations.get(KUBELET_COMPAT_ANNOTATION)
+        if stash:
+            kubelet = json.loads(stash)
+    meta.annotations.pop(KUBELET_COMPAT_ANNOTATION, None)
     return NodeClaim(
-        metadata=_meta_from(doc),
+        metadata=meta,
         spec=NodeClaimSpec(
             taints=_taints_from(spec.get("taints")),
             startup_taints=_taints_from(spec.get("startupTaints")),
             requirements=_reqs_from(spec.get("requirements")),
             resource_requests=dict(spec.get("resources", {}).get("requests", {})),
-            kubelet=dict(spec.get("kubelet", {})),
+            kubelet=kubelet,
             node_class_ref=dict(spec.get("nodeClassRef", {})),
             terminate_after=expire,
         ),
@@ -294,6 +304,7 @@ def _nodeclaim_from(doc: dict, version: str) -> NodeClaim:
 
 
 def _nodeclaim_to(nc: NodeClaim, version: str) -> dict:
+    meta = _meta_to(nc.metadata)
     spec: dict = {}
     if nc.spec.taints:
         spec["taints"] = _taints_to(nc.spec.taints)
@@ -307,6 +318,12 @@ def _nodeclaim_to(nc: NodeClaim, version: str) -> dict:
         spec["nodeClassRef"] = dict(nc.spec.node_class_ref)
     if version == V1:
         spec["expireAfter"] = format_duration(nc.spec.terminate_after)
+        if nc.spec.kubelet:
+            # same compatibility stash as the NodePool path: kubelet left
+            # the v1 NodeClaim spec but must survive the round trip
+            meta.setdefault("annotations", {})[KUBELET_COMPAT_ANNOTATION] = (
+                json.dumps(nc.spec.kubelet, sort_keys=True)
+            )
     else:
         if nc.spec.kubelet:
             spec["kubelet"] = dict(nc.spec.kubelet)
@@ -324,7 +341,7 @@ def _nodeclaim_to(nc: NodeClaim, version: str) -> dict:
     out = {
         "apiVersion": version,
         "kind": "NodeClaim",
-        "metadata": _meta_to(nc.metadata),
+        "metadata": meta,
         "spec": spec,
     }
     if status:

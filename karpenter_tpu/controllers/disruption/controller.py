@@ -157,9 +157,18 @@ class DisruptionController:
         )
         self.registry.gauge(m.DISRUPTION_ELIGIBLE_NODES, "disruptable candidates").set(
             len(candidates))
+        budgets = build_disruption_budgets(self.cluster, self.store, self.clock)
+        # allowed-disruptions gauge per (nodepool, reason), refreshed every
+        # round — including candidate-free ones, so closed budget windows
+        # and deleted pools never serve stale values
+        # (disruption/helpers.go:242's budget gauge)
+        bg = self.registry.gauge(m.DISRUPTION_BUDGETS, "allowed disruptions")
+        bg.clear()
+        for pool, by_reason in budgets.items():
+            for reason, allowed in by_reason.items():
+                bg.set(allowed, nodepool=pool, reason=reason)
         if not candidates:
             return False
-        budgets = build_disruption_budgets(self.cluster, self.store, self.clock)
         fence = self.cluster.consolidation_state()
         for method in self.methods:
             if method.is_consolidation and fence == self._noop_fence:

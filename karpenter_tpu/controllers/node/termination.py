@@ -18,12 +18,14 @@ from karpenter_tpu.utils import pod as pod_util
 
 
 class NodeTerminationController:
-    def __init__(self, store, clock=None, recorder=None):
+    def __init__(self, store, clock=None, recorder=None, registry=None):
+        from karpenter_tpu.operator import metrics as _m
         from karpenter_tpu.utils.clock import Clock
 
         self.store = store
         self.clock = clock or Clock()
         self.recorder = recorder
+        self.registry = registry or _m.REGISTRY
 
     def on_event(self, event):
         pass
@@ -80,6 +82,20 @@ class NodeTerminationController:
             f for f in node.metadata.finalizers if f != wk.TERMINATION_FINALIZER
         ]
         self.store.update("nodes", node)
+        # lifecycle counters + graceful-drain latency (the reference's
+        # NodesTerminatedCounter + TerminationSummary, termination
+        # controller removeFinalizer)
+        from karpenter_tpu.operator import metrics as m
+
+        pool = node.labels.get(wk.NODEPOOL_LABEL, "")
+        self.registry.counter(m.NODES_TERMINATED, "nodes terminated").inc(
+            nodepool=pool)
+        if node.metadata.deletion_timestamp is not None:
+            self.registry.histogram(
+                m.NODE_TERMINATION_DURATION,
+                "seconds from node deletion to finalizer release",
+            ).observe(self.clock.now() - node.metadata.deletion_timestamp,
+                      nodepool=pool)
         return True
 
     def _blocking_volume_attachments(self, node) -> list:

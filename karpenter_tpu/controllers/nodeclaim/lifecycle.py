@@ -107,6 +107,7 @@ class NodeClaimLifecycleController:
         claim.set_condition(COND_REGISTERED, now=self.clock.now())
         self.store.update("nodeclaims", claim)
         self._count(m.NODECLAIMS_REGISTERED, claim)
+        self._count(m.NODES_CREATED, claim)  # node joined the cluster
         return True
 
     # -- initialization (lifecycle/initialization.go:49) -----------------
@@ -159,6 +160,14 @@ class NodeClaimLifecycleController:
         ]
         self.store.update("nodeclaims", claim)
         self._count(m.NODECLAIMS_TERMINATED, claim)
+        if claim.metadata.deletion_timestamp is not None:
+            # delete-request → instance-gone latency (the reference's
+            # NodeClaimTerminationDuration summary)
+            self.registry.histogram(
+                m.NODECLAIM_TERMINATION_DURATION,
+                "seconds from nodeclaim deletion to finalizer release",
+            ).observe(self.clock.now() - claim.metadata.deletion_timestamp,
+                      nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""))
         return True
 
     def _node_for(self, claim):

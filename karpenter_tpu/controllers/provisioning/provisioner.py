@@ -163,10 +163,16 @@ class Provisioner:
         if not self.batcher.ready():
             return False
         self.batcher.reset()
-        if self.cluster is not None and not self.cluster.synced():
-            self.batcher.trigger()  # retry next round
-            return False
         from karpenter_tpu.operator import metrics as m
+
+        if self.cluster is not None:
+            synced = self.cluster.synced()
+            self.registry.gauge(
+                m.CLUSTER_STATE_SYNCED, "cluster state mirror in sync"
+            ).set(1.0 if synced else 0.0)
+            if not synced:
+                self.batcher.trigger()  # retry next round
+                return False
 
         with self.registry.measure(m.SCHEDULING_DURATION):
             results = self.schedule()
@@ -201,6 +207,7 @@ class Provisioner:
         # over-provisions (safe); the reverse order would under-provision
         # (provisioner.go:318-329). The disruption simulation passes its own
         # candidate-free snapshot (disruption/helpers.go:51).
+        live_batch = pods is None  # explicit pods = a disruption simulation
         if state_nodes is None:
             state_nodes = self.cluster.nodes() if self.cluster is not None else []
         if pods is None:
@@ -212,6 +219,7 @@ class Provisioner:
 
         # pods with unresolvable PVCs can't schedule: report and drop from
         # the batch (ValidatePersistentVolumeClaims, volumetopology.go:155)
+        from karpenter_tpu.operator import metrics as m
         from karpenter_tpu.scheduling.volumetopology import PVCError, VolumeTopology
 
         vt = VolumeTopology(self.store)
@@ -223,6 +231,17 @@ class Provisioner:
             except PVCError as e:
                 if self.recorder is not None:
                     self.recorder.publish("FailedScheduling", str(e), obj=p)
+        # provisioning/metrics.go: queue depth at solve entry + pods the
+        # batch dropped as unresolvable. Only the LIVE batch reports —
+        # disruption counterfactuals must not clobber the gauges (the
+        # reference mutes its simulations the same way, helpers.go:84)
+        if live_batch:
+            self.registry.gauge(
+                m.SCHEDULING_QUEUE_DEPTH, "pods entering the solve"
+            ).set(len(valid_pods))
+            self.registry.gauge(
+                m.IGNORED_PODS, "pods ignored this batch (unresolvable PVCs)"
+            ).set(len(pods) - len(valid_pods))
         pods = valid_pods
         if not pods:
             # explicit-pods callers (disruption simulation) expect a results
@@ -341,10 +360,14 @@ class Provisioner:
 
     # -- claim creation (provisioner.go CreateNodeClaims:149) ------------
     def create_node_claims(self, results) -> bool:
+        from karpenter_tpu.operator import metrics as m
+
         created = False
         for claim in results.new_claims:
             nc = claim.to_node_claim()
             self.store.create("nodeclaims", nc)
+            self.registry.counter(m.NODECLAIMS_CREATED, "nodeclaims created").inc(
+                nodepool=claim.template.nodepool_name)
             created = True
             for p in claim.pods:
                 if p.node_name:

@@ -18,8 +18,13 @@ from karpenter_tpu.utils import resources as resutil
 
 
 class Binder:
-    def __init__(self, store):
+    def __init__(self, store, clock=None, registry=None):
+        from karpenter_tpu.operator import metrics as _m
+        from karpenter_tpu.utils.clock import Clock
+
         self.store = store
+        self.clock = clock or Clock()
+        self.registry = registry or _m.REGISTRY
 
     def _fits(self, pod, node, available: dict) -> bool:
         if not node.ready or node.unschedulable or node.metadata.deletion_timestamp:
@@ -63,6 +68,15 @@ class Binder:
                     available[node.name] = resutil.subtract(
                         available[node.name], pod.effective_requests()
                     )
+                    # creation → bound latency (the reference's pod startup
+                    # duration summary, controllers/metrics/pod)
+                    if pod.metadata.creation_timestamp:
+                        from karpenter_tpu.operator import metrics as m
+
+                        self.registry.histogram(
+                            m.PODS_STARTUP_DURATION,
+                            "seconds from pod creation to binding",
+                        ).observe(self.clock.now() - pod.metadata.creation_timestamp)
                     progressed += 1
                     placed = True
                     break

@@ -49,7 +49,7 @@ class Environment:
         self.cloud = cloud or KwokCloudProvider(self.store, instance_types)
         if provider_metrics and not isinstance(self.cloud, MetricsCloudProvider):
             self.cloud = MetricsCloudProvider(self.cloud, registry=self.registry)
-        self.binder = Binder(self.store)
+        self.binder = Binder(self.store, clock=self.clock, registry=self.registry)
         self.cluster = Cluster(self.store, clock=self.clock)
         # leader election gates every reconcile round (operator.go
         # LeaderElection): a single-instance environment always holds the
@@ -131,7 +131,10 @@ class Environment:
             NodeClaimConsistencyController(
                 self.store, clock=self.clock, recorder=self.recorder
             ),
-            NodeTerminationController(self.store, clock=self.clock, recorder=self.recorder),
+            NodeTerminationController(
+                self.store, clock=self.clock, recorder=self.recorder,
+                registry=self.registry,
+            ),
             LeaseGarbageCollectionController(self.store, recorder=self.recorder),
             DaemonSetController(self.store),
             WorkloadController(self.store),

@@ -196,6 +196,13 @@ CLUSTER_STATE_SYNCED = f"{NAMESPACE}_cluster_state_synced"
 CLOUDPROVIDER_DURATION = f"{NAMESPACE}_cloudprovider_duration_seconds"
 CLOUDPROVIDER_ERRORS = f"{NAMESPACE}_cloudprovider_errors_total"
 PODS_STATE = f"{NAMESPACE}_pods_state"
+PODS_STARTUP_DURATION = f"{NAMESPACE}_pods_startup_duration_seconds"
+NODES_CREATED = f"{NAMESPACE}_nodes_created_total"
+NODES_TERMINATED = f"{NAMESPACE}_nodes_terminated_total"
+NODE_TERMINATION_DURATION = f"{NAMESPACE}_nodes_termination_duration_seconds"
+NODECLAIM_TERMINATION_DURATION = (
+    f"{NAMESPACE}_nodeclaims_termination_duration_seconds"
+)
 NODES_ALLOCATABLE = f"{NAMESPACE}_nodes_allocatable"
 NODES_TOTAL = f"{NAMESPACE}_nodes_count"
 NODEPOOL_USAGE = f"{NAMESPACE}_nodepool_usage"

@@ -156,3 +156,30 @@ class TestErrors:
     def test_unknown_kind(self):
         with pytest.raises(ConversionError):
             decode({"apiVersion": V1, "kind": "Widget"})
+
+
+class TestKubeletCompatStash:
+    def test_nodeclaim_kubelet_survives_v1_round_trip(self):
+        doc = {
+            "apiVersion": V1BETA1, "kind": "NodeClaim",
+            "metadata": {"name": "c"},
+            "spec": {"kubelet": {"maxPods": 42}},
+        }
+        hub = decode(doc)
+        v1 = encode(hub, V1)
+        assert "kubelet" not in v1["spec"]
+        assert KUBELET_COMPAT_ANNOTATION in v1["metadata"]["annotations"]
+        assert decode(v1).spec.kubelet == {"maxPods": 42}
+
+    def test_cleared_kubelet_does_not_resurrect(self):
+        """Decode a v1 doc carrying the stash, clear kubelet on the hub,
+        re-encode: the stale annotation must not bring the config back."""
+        hub = decode(V1BETA1_NODEPOOL)
+        v1 = encode(hub, V1)
+        hub2 = decode(v1)  # stash restored into spec, stripped from metadata
+        assert KUBELET_COMPAT_ANNOTATION not in hub2.metadata.annotations
+        hub2.spec.template.kubelet = {}
+        v1_again = encode(hub2, V1)
+        anns = v1_again["metadata"].get("annotations", {})
+        assert KUBELET_COMPAT_ANNOTATION not in anns
+        assert decode(v1_again).spec.template.kubelet == {}
