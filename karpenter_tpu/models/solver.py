@@ -112,8 +112,15 @@ _PACKED_KERNELS: dict = {}
 # tunneled chip pays a fixed ~64 ms round trip per dispatch while the native
 # engine finishes small instances in single-digit ms (measured on the grid:
 # native grid-100 ≈ 5 ms vs 100+ ms through the tunnel). Override with
-# KARPENTER_NATIVE_CUTOFF (0 disables routing).
+# KARPENTER_NATIVE_CUTOFF (0 disables ALL engine routing).
 NATIVE_CUTOFF_PODS = 192
+# feasibility-work floor (G×T cells) for the device: the kernel's advantage
+# is parallelism over groups×types, so a batch with FEW DISTINCT GROUPS is
+# a short sequential loop the C++ engine finishes in single-digit ms no
+# matter how many pods ride each group (measured: 1k homogeneous pods ×
+# 10 types = 5 ms native vs 45 ms device; 10k pods × 200 types with 8
+# signatures = 60 ms vs 135 ms). Override with KARPENTER_DEVICE_MIN_WORK.
+DEVICE_MIN_WORK = 8192
 
 
 class TPUSolver(Solver):
@@ -478,8 +485,10 @@ class TPUSolver(Solver):
         # saves (the reference's stance that small batches are cheap,
         # batcher.go:52). Same tensors, same decode — only the kernel swaps.
         cutoff = int(os.environ.get("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS))
+        min_work = int(os.environ.get("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK))
         total = int(np.asarray(args["g_count"]).sum())
-        if 0 < total <= cutoff:
+        work = int((np.asarray(args["g_count"]) > 0).sum()) * args["t_mask"].shape[0]
+        if cutoff > 0 and total > 0 and (total <= cutoff or work < min_work):
             native_ok = False
             try:
                 from karpenter_tpu import native

@@ -180,7 +180,9 @@ class TestSmallBatchRouting:
                 {pool.name: catalog})
         assert s.last_device_stats["engine"] == "native"
 
-    def test_large_batch_keeps_device(self, catalog, monkeypatch):
+    def test_large_batch_tiny_catalog_routes_native(self, catalog, monkeypatch):
+        """300 pods over a 3-type catalog is still tiny feasibility work
+        (few groups × few types): the C++ loop beats the dispatch cost."""
         from karpenter_tpu.models import TPUSolver
         from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
 
@@ -189,7 +191,7 @@ class TestSmallBatchRouting:
         pool = nodepool()
         s.solve([pod(f"p{i}") for i in range(300)], [ClaimTemplate(pool)],
                 {pool.name: catalog})
-        assert s.last_device_stats["engine"] == "device"
+        assert s.last_device_stats["engine"] == "native"
 
     def test_cutoff_zero_disables_routing(self, catalog, monkeypatch):
         from karpenter_tpu.models import TPUSolver
@@ -219,3 +221,31 @@ class TestSmallBatchRouting:
         assert direct.last_device_stats["engine"] == "device"
         assert r1.node_count() == r2.node_count()
         assert r1.scheduled_pod_count() == r2.scheduled_pod_count()
+
+    def test_few_groups_route_native_regardless_of_pod_count(self, catalog, monkeypatch):
+        """1000 homogeneous pods = ONE group: a short sequential loop the
+        C++ engine wins no matter the pod count (work-based routing)."""
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        s = TPUSolver()
+        pool = nodepool()
+        s.solve([pod(f"p{i}") for i in range(1000)], [ClaimTemplate(pool)],
+                {pool.name: catalog})
+        assert s.last_device_stats["engine"] == "native"
+
+    def test_many_groups_keep_device(self, monkeypatch):
+        """Hundreds of distinct signatures × a wide catalog exceed the work
+        floor: the batch stays on the accelerator."""
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        cat = benchmark_catalog(64)
+        s = TPUSolver()
+        pool = nodepool()
+        pods = [pod(f"p{i}", cpu=0.1 + (i % 200) * 0.01) for i in range(400)]
+        s.solve(pods, [ClaimTemplate(pool)], {pool.name: cat})
+        assert s.last_device_stats["engine"] == "device"
