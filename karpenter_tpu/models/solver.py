@@ -114,12 +114,14 @@ _PACKED_KERNELS: dict = {}
 # native grid-100 ≈ 5 ms vs 100+ ms through the tunnel). Override with
 # KARPENTER_NATIVE_CUTOFF (0 disables ALL engine routing).
 NATIVE_CUTOFF_PODS = 192
-# feasibility-work floor (G×T cells) for the device: the kernel's advantage
-# is parallelism over groups×types, so a batch with FEW DISTINCT GROUPS is
-# a short sequential loop the C++ engine finishes in single-digit ms no
-# matter how many pods ride each group (measured: 1k homogeneous pods ×
-# 10 types = 5 ms native vs 45 ms device; 10k pods × 200 types with 8
-# signatures = 60 ms vs 135 ms). Override with KARPENTER_DEVICE_MIN_WORK.
+# feasibility-work floor (real G×T cells, padding excluded) for the device:
+# the kernel's advantage is parallelism over groups×types, so a batch with
+# FEW DISTINCT GROUPS is a short sequential loop the C++ engine finishes in
+# single-digit ms no matter how many pods ride each group (measured: 1k
+# homogeneous pods × 10 types = 5 ms native vs 45 ms device; 10k pods ×
+# 200 types with 8 signatures = 60 ms vs 135 ms). Override with
+# KARPENTER_DEVICE_MIN_WORK (0 disables the work gate, leaving only the
+# pods cutoff above).
 DEVICE_MIN_WORK = 8192
 
 
@@ -487,7 +489,12 @@ class TPUSolver(Solver):
         cutoff = int(os.environ.get("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS))
         min_work = int(os.environ.get("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK))
         total = int(np.asarray(args["g_count"]).sum())
-        work = int((np.asarray(args["g_count"]) > 0).sum()) * args["t_mask"].shape[0]
+        # REAL counts, not the bucket-padded axes: padded groups have count
+        # 0 and padded types zero allocatable, so routing flips at the
+        # calibrated work level, not at shape-bucket boundaries
+        real_g = int((np.asarray(args["g_count"]) > 0).sum())
+        real_t = int((np.asarray(args["t_alloc"]).max(axis=1) > 0).sum())
+        work = real_g * real_t
         if cutoff > 0 and total > 0 and (total <= cutoff or work < min_work):
             native_ok = False
             try:

@@ -222,18 +222,34 @@ class TestSmallBatchRouting:
         assert r1.node_count() == r2.node_count()
         assert r1.scheduled_pod_count() == r2.scheduled_pod_count()
 
-    def test_few_groups_route_native_regardless_of_pod_count(self, catalog, monkeypatch):
-        """1000 homogeneous pods = ONE group: a short sequential loop the
-        C++ engine wins no matter the pod count (work-based routing)."""
+    def test_moderate_groups_under_work_floor_route_native(self, monkeypatch):
+        """50 signatures × a 100-type catalog = 5000 REAL cells (< 8192
+        floor), but the bucketed axes (64 × 128 = 8192) would clear the
+        floor — routing must use real counts, not padded shapes."""
         from karpenter_tpu.models import TPUSolver
         from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
 
         monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        cat = benchmark_catalog(100)
+        s = TPUSolver()
+        pool = nodepool()
+        pods = [pod(f"p{i}", cpu=0.1 + (i % 50) * 0.05) for i in range(1000)]
+        s.solve(pods, [ClaimTemplate(pool)], {pool.name: cat})
+        assert s.last_device_stats["engine"] == "native"
+
+    def test_work_gate_zero_disables_it(self, catalog, monkeypatch):
+        """KARPENTER_DEVICE_MIN_WORK=0 restores the pods-only contract:
+        a big batch stays on the device no matter how few groups."""
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        monkeypatch.setenv("KARPENTER_DEVICE_MIN_WORK", "0")
         s = TPUSolver()
         pool = nodepool()
         s.solve([pod(f"p{i}") for i in range(1000)], [ClaimTemplate(pool)],
                 {pool.name: catalog})
-        assert s.last_device_stats["engine"] == "native"
+        assert s.last_device_stats["engine"] == "device"
 
     def test_many_groups_keep_device(self, monkeypatch):
         """Hundreds of distinct signatures × a wide catalog exceed the work
