@@ -119,6 +119,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from karpenter_tpu.operator import Environment
+    from karpenter_tpu.operator.logging import make_logger
     from karpenter_tpu.operator.options import Options
     from karpenter_tpu.utils.clock import Clock
 
@@ -128,6 +129,7 @@ def main(argv=None) -> int:
         sync=False,  # production batching window (1s idle / 10s max)
         enable_disruption=True,
         options=options,
+        log=make_logger(options.log_level),
     )
 
     applied = sum(load_manifest(env, m) for m in args.manifest)

@@ -60,10 +60,13 @@ class DisruptionController:
         poll_period: float = POLL_PERIOD,
         validation_ttl: float = VALIDATION_TTL,
         registry=None,
+        log=None,
     ):
         from karpenter_tpu.operator import metrics as _m
+        from karpenter_tpu.operator.logging import NOP
         from karpenter_tpu.utils.clock import Clock
 
+        self.log = log if log is not None else NOP
         self.registry = registry or _m.REGISTRY
         self.store = store
         self.cluster = cluster
@@ -255,6 +258,13 @@ class DisruptionController:
         self.queue.add(cmd)
         from karpenter_tpu.operator import metrics as m
 
+        self.log.info(
+            "disrupting nodes",
+            reason=cmd.reason,
+            action=cmd.action,
+            nodes=",".join(c.name for c in cmd.candidates),
+            replacements=len(cmd.replacements),
+        )
         self.registry.counter(m.DISRUPTION_ACTIONS, "disruption commands executed").inc(
             action=cmd.action, reason=cmd.reason)
         self.registry.counter(m.DISRUPTION_PODS, "pods displaced by disruption").inc(

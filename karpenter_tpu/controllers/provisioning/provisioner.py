@@ -122,11 +122,13 @@ def nodepool_ready(np) -> bool:
 
 
 class Provisioner:
-    def __init__(self, store, cloud, solver=None, clock=None, batcher=None, recorder=None, cluster=None, registry=None):
+    def __init__(self, store, cloud, solver=None, clock=None, batcher=None, recorder=None, cluster=None, registry=None, log=None):
+        from karpenter_tpu.operator.logging import NOP
         from karpenter_tpu.utils.pretty import ChangeMonitor
         from karpenter_tpu.operator import metrics as m
         from karpenter_tpu.utils.clock import Clock
 
+        self.log = log if log is not None else NOP
         self.store = store
         self.cloud = cloud
         self.clock = clock or Clock()
@@ -385,6 +387,15 @@ class Provisioner:
                 self.store.update("pods", p)
             if pods and self.cluster is not None:
                 self.cluster.nominate(node.name)
+        if results.new_claims:
+            # provisioner.go:149's "created nodeclaim" log line, one per round
+            self.log.info(
+                "launched nodeclaims",
+                claims=len(results.new_claims),
+                pods=sum(len(c.pods) for c in results.new_claims),
+                pools=",".join(sorted({
+                    c.template.nodepool_name for c in results.new_claims})),
+            )
         for pod_key, err in results.pod_errors.items():
             if self.recorder is not None and self._change_monitor.has_changed(
                 pod_key, err

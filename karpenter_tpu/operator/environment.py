@@ -32,14 +32,17 @@ class Environment:
         provider_metrics: bool = True,
         options=None,
         store=None,  # share an apiserver across instances (HA/standby)
+        log=None,  # structured Logger; tests default to NOP (quiet)
     ):
         from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
         from karpenter_tpu.controllers.provisioning.batcher import Batcher
         from karpenter_tpu.operator.events import Recorder
+        from karpenter_tpu.operator.logging import NOP
         from karpenter_tpu.operator.metrics import Registry
         from karpenter_tpu.operator.options import Options
 
         self.options = options or Options.from_env()
+        self.log = log if log is not None else NOP
         self.clock = clock or FakeClock()
         self.store = store or KubeStore(self.clock)
         self.recorder = Recorder(clock=self.clock)
@@ -84,6 +87,7 @@ class Environment:
             cluster=self.cluster,
             recorder=self.recorder,
             registry=self.registry,
+            log=self.log.with_values(controller="provisioner"),
         )
         from karpenter_tpu.controllers.disruption import DisruptionController
         from karpenter_tpu.controllers.node.leasegc import LeaseGarbageCollectionController
@@ -159,6 +163,7 @@ class Environment:
                     validation_ttl if validation_ttl is not None else (0.0 if sync else 15.0)
                 ),
                 registry=self.registry,
+                log=self.log.with_values(controller="disruption"),
             )
             self.controllers.append(self.disruption)
 

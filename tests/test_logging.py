@@ -1,0 +1,123 @@
+"""The structured logging plane (operator/logging.py): leveled key=value
+lines, child-context loggers, the NopLogger mute, and the live wiring
+through the provisioner/disruption controllers.
+
+Reference semantics: pkg/operator/logging (zapr config, NopLogger used to
+mute the disruption simulations, helpers.go:84,93)."""
+
+import pytest
+
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import Deployment, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.logging import NOP, Logger, NopLogger, make_logger
+
+GIB = 2**30
+
+
+class TestLogger:
+    def test_structured_line_format(self):
+        lines = []
+        log = Logger(level="info", sink=lines.append)
+        log.info("solved batch", pods=12, pools="default")
+        assert len(lines) == 1
+        assert "level=info" in lines[0]
+        assert "pods=12" in lines[0]
+        assert 'msg="solved batch"' in lines[0]
+
+    def test_level_filtering(self):
+        lines = []
+        log = Logger(level="warn", sink=lines.append)
+        log.debug("noise")
+        log.info("noise")
+        log.warn("matters")
+        log.error("matters")
+        assert len(lines) == 2
+
+    def test_with_values_child_context(self):
+        lines = []
+        log = Logger(level="info", sink=lines.append)
+        child = log.with_values(controller="provisioner")
+        child.info("hello")
+        assert "controller=provisioner" in lines[0]
+        # the parent is untouched
+        log.info("bare")
+        assert "controller" not in lines[1]
+
+    def test_values_with_spaces_quoted(self):
+        lines = []
+        Logger(level="info", sink=lines.append).info("x", nodes="a b c")
+        assert 'nodes="a b c"' in lines[0]
+
+    def test_nop_discards_everything(self):
+        assert not NOP.enabled
+        NOP.info("dropped", x=1)  # must not raise or print
+        assert isinstance(NOP.with_values(controller="x"), NopLogger)
+
+    def test_make_logger_honors_env(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_LOG_LEVEL", "error")
+        lines = []
+        log = make_logger(sink=lines.append)
+        log.warn("dropped")
+        log.error("kept")
+        assert len(lines) == 1
+
+
+class TestLiveWiring:
+    def test_provision_and_disrupt_emit_lines(self):
+        lines = []
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+            log=Logger(level="info", sink=lines.append),
+        )
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        d = Deployment(
+            metadata=ObjectMeta(name="a"), replicas=2,
+            template=Pod(metadata=ObjectMeta(name="a", labels={"app": "a"}),
+                         requests={"cpu": 0.7, "memory": 0.25 * GIB}))
+        env.create("deployments", d)
+        env.run_until_idle()
+        launched = [ln for ln in lines if 'msg="launched nodeclaims"' in ln]
+        assert launched and "controller=provisioner" in launched[0]
+        # retire the workload: the emptiness path logs the disruption
+        d.replicas = 0
+        env.store.update("deployments", d)
+        for p in list(env.store.list("pods")):
+            env.store.delete("pods", p)
+        env.clock.step(30.0)
+        env.run_until_idle()
+        disrupted = [ln for ln in lines if 'msg="disrupting nodes"' in ln]
+        assert disrupted and "controller=disruption" in disrupted[0]
+
+    def test_default_environment_is_quiet(self, capsys):
+        env = Environment(instance_types=[make_instance_type("small", 2, 8)])
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.provision(Pod(metadata=ObjectMeta(name="p"),
+                          requests={"cpu": 0.5, "memory": 0.25 * GIB}))
+        assert "launched nodeclaims" not in capsys.readouterr().err
+
+
+class TestRobustness:
+    def test_level_aliases_and_case(self):
+        lines = []
+        log = Logger(level="WARNING", sink=lines.append)
+        log.info("dropped")
+        log.warn("kept")
+        assert len(lines) == 1
+
+    def test_unknown_level_falls_back_loudly_to_info(self, capsys):
+        lines = []
+        log = Logger(level="verbose", sink=lines.append)
+        assert "unknown log level" in capsys.readouterr().err
+        log.info("kept")
+        assert len(lines) == 1
+
+    def test_quotes_and_newlines_stay_one_line(self):
+        lines = []
+        log = Logger(level="info", sink=lines.append)
+        log.info('pod said "no"\nand left', node='a"b')
+        assert len(lines) == 1
+        assert "\n" not in lines[0]
+        assert '\\"no\\"' in lines[0]
