@@ -1,0 +1,16 @@
+"""The two-plane boundary: a gRPC Solver service (SURVEY.md §2.11/§7).
+
+The reference isolates the outside world behind the CloudProvider SPI; our
+build adds one more seam in the same spirit — the HOST plane (controllers,
+store, state) and the DEVICE plane (the accelerator kernel) may live in
+different processes. `serve()` runs the device plane as a gRPC server;
+`RemoteSolver` is a drop-in `Solver` whose kernel dispatch crosses the
+wire. Everything else — tensorize, decode, validation, the host fallback —
+stays host-side, so the payload is exactly the kernel's tensor snapshot
+and the reply is its packed outputs (the same seam `TPUSolver._invoke`
+already is in-process).
+"""
+
+from karpenter_tpu.service.solver_service import RemoteSolver, serve
+
+__all__ = ["RemoteSolver", "serve"]

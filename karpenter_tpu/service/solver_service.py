@@ -1,0 +1,161 @@
+"""gRPC solver service: the device plane as a standalone process.
+
+Wire contract (raw-bytes unary RPC, no generated stubs — the method is
+`/karpenter.Solver/Solve`):
+
+- request: an .npz archive of the kernel's tensor snapshot (the exact args
+  dict `TPUSolver._invoke` builds) plus a `__meta__` JSON entry carrying
+  the static solve parameters (max_bins, level_bits, max_minv).
+- response: an .npz archive of the kernel outputs
+  (assign/assign_e/used/tmpl/F).
+
+The server executes on whatever backend its process sees — the tunneled
+TPU in production (`python -m karpenter_tpu.service.solver_service`), CPU
+or the C++ engine elsewhere — while the client process needs no jax at
+dispatch time. The latency budget for the hop rides inside the solve
+target the same way the tunnel round trip does (BASELINE.md <200 ms
+includes it).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+
+_METHOD = "/karpenter.Solver/Solve"
+_MAX_MSG = 256 * 1024 * 1024  # the 50k snapshot is ~tens of MB uncompressed
+_GRPC_OPTS = [
+    ("grpc.max_send_message_length", _MAX_MSG),
+    ("grpc.max_receive_message_length", _MAX_MSG),
+]
+
+
+def _pack(arrays: dict, meta: dict) -> bytes:
+    buf = io.BytesIO()
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    payload["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    np.savez(buf, **payload)
+    return buf.getvalue()
+
+
+def _unpack(blob: bytes) -> tuple:
+    with np.load(io.BytesIO(blob)) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta = json.loads(bytes(z["__meta__"]).decode()) if "__meta__" in z.files else {}
+    return arrays, meta
+
+
+class _SolverHandler:
+    """Server-side execution through the solver's own `_invoke` stack: the
+    shared jitted packed kernel (one compile per shape bucket, one
+    device→host pull) and the calibrated small-batch native routing both
+    apply on the serving side exactly as in-process."""
+
+    def __init__(self, use_native: bool = False):
+        from karpenter_tpu.models.solver import NativeSolver, TPUSolver
+
+        self._solver = NativeSolver() if use_native else TPUSolver()
+
+    def solve(self, request: bytes, context) -> bytes:
+        args, meta = _unpack(request)
+        max_bins = int(meta["max_bins"])
+        # _invoke reads only the key's tail: (..., max_bins, level_bits,
+        # max_minv) — the same layout models/solver.py builds
+        key = (max_bins, int(meta.get("level_bits", 20)),
+               int(meta.get("max_minv", 0)))
+        out = self._solver._invoke(args, key, max_bins)
+        return _pack(
+            {k: np.asarray(out[k]) for k in ("assign", "assign_e", "used", "tmpl", "F")},
+            {},
+        )
+
+
+def serve(port: int = 0, use_native: bool = False, max_workers: int = 4):
+    """Start the device-plane server; returns (grpc.Server, bound_port)."""
+    from concurrent import futures
+
+    import grpc
+
+    handler = _SolverHandler(use_native=use_native)
+
+    class _Generic(grpc.GenericRpcHandler):
+        def service(self, call_details):
+            if call_details.method == _METHOD:
+                return grpc.unary_unary_rpc_method_handler(
+                    handler.solve,
+                    request_deserializer=None,  # raw bytes both ways
+                    response_serializer=None,
+                )
+            return None
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), options=_GRPC_OPTS
+    )
+    server.add_generic_rpc_handlers((_Generic(),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    if bound == 0:
+        raise RuntimeError(f"solver service: failed to bind 127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+from karpenter_tpu.models.solver import TPUSolver  # noqa: E402 (jax stays lazy)
+
+
+class RemoteSolver(TPUSolver):
+    """Drop-in Solver whose kernel dispatch crosses the gRPC boundary:
+    tensorize/decode/validation stay host-side, exactly one round trip per
+    solve (the in-process `_invoke` seam, served remotely)."""
+
+    def __init__(self, target: str):
+        import grpc
+
+        super().__init__()
+        self._channel = grpc.insecure_channel(target, options=_GRPC_OPTS)
+        self._call = self._channel.unary_unary(
+            _METHOD, request_serializer=None, response_deserializer=None
+        )
+
+    def _invoke(self, args, key, max_bins):
+        self._last_engine = "remote"
+        meta = {"max_bins": int(max_bins), "level_bits": int(key[-2]),
+                "max_minv": int(key[-1])}
+        arrays, _ = _unpack(self._call(_pack(dict(args), meta)))
+        arrays["used"] = arrays["used"].astype(bool)
+        arrays["F"] = arrays["F"].astype(bool)
+        return arrays
+
+
+def main(argv=None) -> int:
+    """`python -m karpenter_tpu.service.solver_service [--port N] [--native]`
+    — run the device plane standalone (the gRPC analog of kwok/main.go for
+    the solver half of the two-plane split)."""
+    import argparse
+    import signal
+    import threading
+
+    ap = argparse.ArgumentParser(prog="karpenter_tpu.service.solver_service")
+    ap.add_argument("--port", type=int, default=8400)
+    ap.add_argument("--native", action="store_true",
+                    help="serve the C++ engine instead of the accelerator")
+    args = ap.parse_args(argv)
+    server, bound = serve(port=args.port, use_native=args.native)
+    print(f"solver service: listening on 127.0.0.1:{bound} "
+          f"({'native' if args.native else 'device'} engine)", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass
+    stop.wait()
+    server.stop(grace=2.0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

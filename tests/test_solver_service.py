@@ -1,0 +1,85 @@
+"""The gRPC solver boundary (service/solver_service.py): the device plane
+as a separate server, the host plane dispatching its kernel calls over the
+wire — results bit-identical to the in-process seam, end-to-end through the
+full controller ring.
+
+Reference stance: SURVEY.md §2.11/§7 two-plane architecture (the gRPC
+Solver boundary as the new process crossing, mirroring how the reference
+isolates the cloud behind CloudProvider, types.go:46)."""
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from karpenter_tpu.api.nodepool import NodePool  # noqa: E402
+from karpenter_tpu.api.objects import ObjectMeta, Pod  # noqa: E402
+from karpenter_tpu.cloudprovider.catalog import (  # noqa: E402
+    benchmark_catalog,
+    make_instance_type,
+)
+from karpenter_tpu.models import ClaimTemplate, TPUSolver  # noqa: E402
+from karpenter_tpu.service import RemoteSolver, serve  # noqa: E402
+
+GIB = 2**30
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv, port = serve(port=0)
+    yield f"127.0.0.1:{port}"
+    srv.stop(grace=None)
+
+
+def pods(n):
+    return [Pod(metadata=ObjectMeta(name=f"p{i}"),
+                requests={"cpu": 0.5 + (i % 4) * 0.5, "memory": 1 * GIB})
+            for i in range(n)]
+
+
+class TestRemoteSolver:
+    def test_wire_solve_matches_in_process(self, server):
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        its = {pool.name: benchmark_catalog(40)}
+        local = TPUSolver().solve(
+            [p.clone() for p in pods(60)], [ClaimTemplate(pool)], its)
+        remote_solver = RemoteSolver(server)
+        remote = remote_solver.solve(
+            [p.clone() for p in pods(60)], [ClaimTemplate(pool)], its)
+        assert remote_solver.last_device_stats["engine"] == "remote"
+        assert remote.node_count() == local.node_count()
+        assert remote.scheduled_pod_count() == local.scheduled_pod_count() == 60
+        # claim compositions identical: the wire hop changes nothing
+        local_sizes = sorted(len(c.pods) for c in local.new_claims)
+        remote_sizes = sorted(len(c.pods) for c in remote.new_claims)
+        assert local_sizes == remote_sizes
+
+    def test_end_to_end_ring_over_the_wire(self, server):
+        """The full hermetic operator provisioning through the remote
+        device plane: pods pending → wire solve → kwok nodes → bound."""
+        from karpenter_tpu.operator import Environment
+
+        env = Environment(
+            instance_types=[make_instance_type("small", 4, 16)],
+            solver=RemoteSolver(server),
+        )
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.provision(*pods(5))
+        assert all(p.node_name for p in env.store.list("pods"))
+        assert env.provisioner.solver.last_device_stats["engine"] == "remote"
+
+    def test_minvalues_ride_the_wire(self, server):
+        """Static solve params (minValues floor, level bits) cross in the
+        meta payload, not as tensors."""
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+        from karpenter_tpu.api import labels as wk
+
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pool.spec.template.requirements = [NodeSelectorRequirement(
+            wk.INSTANCE_TYPE_LABEL, "Exists", [], min_values=10)]
+        its = {pool.name: benchmark_catalog(40)}
+        s = RemoteSolver(server)
+        res = s.solve([p.clone() for p in pods(30)], [ClaimTemplate(pool)], its)
+        assert res.scheduled_pod_count() == 30
+        assert s.last_device_stats["retry_pods"] == 0
+        for claim in res.new_claims:
+            assert len({it.name for it in claim.instance_types}) >= 10
