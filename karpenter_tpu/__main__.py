@@ -116,7 +116,12 @@ def main(argv=None) -> int:
                     help="serve /metrics + /healthz on KARPENTER_METRICS_PORT")
     ap.add_argument("--max-ticks", type=int, default=0,
                     help="exit after N ticks (0 = run until signal)")
+    ap.add_argument("--solver", default=None,
+                    help="host:port of a solver service (the two-plane "
+                         "split); also KARPENTER_SOLVER_TARGET")
     args = ap.parse_args(argv)
+
+    import os
 
     from karpenter_tpu.operator import Environment
     from karpenter_tpu.operator.logging import make_logger
@@ -124,13 +129,22 @@ def main(argv=None) -> int:
     from karpenter_tpu.utils.clock import Clock
 
     options = Options.from_env()
+    solver = None
+    target = args.solver or os.environ.get("KARPENTER_SOLVER_TARGET")
+    if target:
+        from karpenter_tpu.service import RemoteSolver
+
+        solver = RemoteSolver(target)
     env = Environment(
         clock=Clock(),  # wall-clock: budgets/TTLs run in real time
         sync=False,  # production batching window (1s idle / 10s max)
         enable_disruption=True,
         options=options,
+        solver=solver,
         log=make_logger(options.log_level),
     )
+    if target:
+        print(f"karpenter-tpu operator: solver plane at {target}", file=sys.stderr)
 
     applied = sum(load_manifest(env, m) for m in args.manifest)
     print(f"karpenter-tpu operator: {applied} manifest objects applied, "

@@ -380,3 +380,43 @@ class TestCombinedConstraints:
         for claim in res.new_claims:
             zr = claim.requirements.get_req(wk.TOPOLOGY_ZONE_LABEL)
             assert not zr.has("zone-1"), "web claim allows the declarer zone"
+
+
+class TestMinDomains:
+    """minDomains semantics (topologygroup.go domainMinCount:196-216 +
+    topology_test.go minDomains scenarios): while fewer pod-supported
+    domains exist than minDomains, the global minimum reads as ZERO, so
+    every domain caps at maxSkew pods."""
+
+    def test_min_domains_above_universe_caps_each_domain(self, solver_cls):
+        # 3 zones < minDomains=5: min stays 0 forever, so maxSkew=1 allows
+        # at most one matched pod per zone — 3 schedule, 2 fail
+        pods = make_pods(
+            5, labels={"app": "web"},
+            topology_spread_constraints=[spread(
+                wk.TOPOLOGY_ZONE_LABEL, max_skew=1, min_domains=5)])
+        res = solve(solver_cls, pods)
+        assert res.scheduled_pod_count() == 3
+        assert len(res.pod_errors) == 2
+        assert set(key_skew(res, wk.TOPOLOGY_ZONE_LABEL).values()) == {1}
+
+    def test_min_domains_satisfied_behaves_like_plain_spread(self, solver_cls):
+        pods = make_pods(
+            6, labels={"app": "web"},
+            topology_spread_constraints=[spread(
+                wk.TOPOLOGY_ZONE_LABEL, max_skew=1, min_domains=3)])
+        res = solve(solver_cls, pods)
+        assert res.scheduled_pod_count() == 6
+        counts = key_skew(res, wk.TOPOLOGY_ZONE_LABEL)
+        assert max(counts.values()) - min(counts.values()) <= 1
+        assert len(counts) == 3
+
+    def test_min_domains_with_larger_skew(self, solver_cls):
+        # minDomains=5 > 3 zones with maxSkew=2: each zone caps at 2
+        pods = make_pods(
+            8, labels={"app": "web"},
+            topology_spread_constraints=[spread(
+                wk.TOPOLOGY_ZONE_LABEL, max_skew=2, min_domains=5)])
+        res = solve(solver_cls, pods)
+        assert res.scheduled_pod_count() == 6
+        assert set(key_skew(res, wk.TOPOLOGY_ZONE_LABEL).values()) == {2}
