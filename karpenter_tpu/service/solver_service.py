@@ -74,8 +74,11 @@ class _SolverHandler:
         )
 
 
-def serve(port: int = 0, use_native: bool = False, max_workers: int = 4):
-    """Start the device-plane server; returns (grpc.Server, bound_port)."""
+def serve(port: int = 0, use_native: bool = False, max_workers: int = 4,
+          host: str = "127.0.0.1"):
+    """Start the device-plane server; returns (grpc.Server, bound_port).
+    Default bind is loopback (tests, local splits); containerized deploys
+    pass host="0.0.0.0" so the pod IP is reachable (deploy/operator.yaml)."""
     from concurrent import futures
 
     import grpc
@@ -96,9 +99,9 @@ def serve(port: int = 0, use_native: bool = False, max_workers: int = 4):
         futures.ThreadPoolExecutor(max_workers=max_workers), options=_GRPC_OPTS
     )
     server.add_generic_rpc_handlers((_Generic(),))
-    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
-        raise RuntimeError(f"solver service: failed to bind 127.0.0.1:{port}")
+        raise RuntimeError(f"solver service: failed to bind {host}:{port}")
     server.start()
     return server, bound
 
@@ -121,10 +124,24 @@ class RemoteSolver(TPUSolver):
         )
 
     def _invoke(self, args, key, max_bins):
-        self._last_engine = "remote"
+        import grpc
+
         meta = {"max_bins": int(max_bins), "level_bits": int(key[-2]),
                 "max_minv": int(key[-1])}
-        arrays, _ = _unpack(self._call(_pack(dict(args), meta)))
+        try:
+            blob = self._call(_pack(dict(args), meta))
+        except grpc.RpcError as e:
+            # device plane unreachable: solve in-process rather than
+            # failing the provisioning round (the Solver seam's fallback
+            # stance — same philosophy as the engine ladder in bench.py)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "solver service unavailable (%s); solving in-process",
+                getattr(e, "code", lambda: e)())
+            return super()._invoke(args, key, max_bins)
+        self._last_engine = "remote"
+        arrays, _ = _unpack(blob)
         arrays["used"] = arrays["used"].astype(bool)
         arrays["F"] = arrays["F"].astype(bool)
         return arrays
@@ -140,18 +157,21 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(prog="karpenter_tpu.service.solver_service")
     ap.add_argument("--port", type=int, default=8400)
+    ap.add_argument("--host", default="0.0.0.0",
+                    help="bind address (containers need the pod IP "
+                         "reachable; use 127.0.0.1 for local-only)")
     ap.add_argument("--native", action="store_true",
                     help="serve the C++ engine instead of the accelerator")
     args = ap.parse_args(argv)
-    server, bound = serve(port=args.port, use_native=args.native)
-    print(f"solver service: listening on 127.0.0.1:{bound} "
-          f"({'native' if args.native else 'device'} engine)", flush=True)
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
             signal.signal(sig, lambda *_: stop.set())
         except ValueError:
-            pass
+            pass  # non-main thread (tests)
+    server, bound = serve(port=args.port, use_native=args.native, host=args.host)
+    print(f"solver service: listening on {args.host}:{bound} "
+          f"({'native' if args.native else 'device'} engine)", flush=True)
     stop.wait()
     server.stop(grace=2.0)
     return 0

@@ -1,0 +1,159 @@
+"""Instance-selection parity specs: the cheapest-compatible economics and
+minValues across operators, end-to-end through the hermetic ring.
+
+Scenario sources: the reference's instance_selection_test.go ("should
+schedule on one of the cheapest instances" family :87-460, minValues with
+Gt/Lt/multiple operators :646-1468) — the launch must always land on the
+cheapest offering compatible with every constraint in play."""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import NodeSelectorRequirement, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import (
+    INSTANCE_CPU_LABEL,
+    make_instance_type,
+)
+from karpenter_tpu.models import ClaimTemplate, HostSolver, NativeSolver, TPUSolver
+from karpenter_tpu.operator import Environment
+
+GIB = 2**30
+
+
+def catalog():
+    # strictly increasing price with size (catalog pricing is linear)
+    return [
+        make_instance_type("xs", 2, 4),
+        make_instance_type("sm", 4, 8),
+        make_instance_type("md", 8, 16),
+        make_instance_type("lg", 16, 32),
+    ]
+
+
+def nodepool(requirements=()):
+    np_ = NodePool(metadata=ObjectMeta(name="default"))
+    np_.spec.template.requirements = list(requirements)
+    return np_
+
+
+def pod(name="p", cpu=1.0, **kw):
+    return Pod(metadata=ObjectMeta(name=name),
+               requests={"cpu": cpu, "memory": 0.5 * GIB}, **kw)
+
+
+class TestCheapestInstance:
+    def test_launch_lands_on_cheapest_that_fits(self):
+        env = Environment(instance_types=catalog())
+        env.create("nodepools", nodepool())
+        env.provision(pod(cpu=1.0))
+        (node,) = env.store.list("nodes")
+        assert node.labels[wk.INSTANCE_TYPE_LABEL] == "xs"
+        # spot is the cheaper capacity type in the synthetic pricing
+        assert node.labels[wk.CAPACITY_TYPE_LABEL] == wk.CAPACITY_TYPE_SPOT
+
+    def test_resource_pressure_moves_up_the_ladder(self):
+        env = Environment(instance_types=catalog())
+        env.create("nodepools", nodepool())
+        env.provision(pod(cpu=6.0))  # xs/sm can't host it
+        (node,) = env.store.list("nodes")
+        assert node.labels[wk.INSTANCE_TYPE_LABEL] == "md"
+
+    def test_pool_capacity_type_constraint_respected(self):
+        env = Environment(instance_types=catalog())
+        env.create("nodepools", nodepool(requirements=[NodeSelectorRequirement(
+            wk.CAPACITY_TYPE_LABEL, "In", [wk.CAPACITY_TYPE_ON_DEMAND])]))
+        env.provision(pod())
+        (node,) = env.store.list("nodes")
+        assert node.labels[wk.CAPACITY_TYPE_LABEL] == wk.CAPACITY_TYPE_ON_DEMAND
+        assert node.labels[wk.INSTANCE_TYPE_LABEL] == "xs"
+
+    def test_pod_zone_constraint_prices_within_zone(self):
+        cat = [
+            make_instance_type("cheap-z1", 2, 4, zones=("zone-1",)),
+            make_instance_type("pricier", 4, 8),
+        ]
+        env = Environment(instance_types=cat)
+        env.create("nodepools", nodepool())
+        env.provision(pod(node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"}))
+        (node,) = env.store.list("nodes")
+        # the cheaper type exists only in zone-1: the launch must pick the
+        # cheapest COMPATIBLE offering, not the global cheapest
+        assert node.labels[wk.INSTANCE_TYPE_LABEL] == "pricier"
+        assert node.labels[wk.TOPOLOGY_ZONE_LABEL] == "zone-2"
+
+
+@pytest.fixture(params=["host", "tpu", "native"])
+def solver_cls(request):
+    if request.param == "native":
+        from karpenter_tpu import native
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        return NativeSolver
+    return {"host": HostSolver, "tpu": TPUSolver}[request.param]
+
+
+def solve(solver_cls, pods, requirements=()):
+    pool = nodepool(requirements)
+    return solver_cls().solve(
+        [p.clone() for p in pods], [ClaimTemplate(pool)],
+        {pool.name: catalog()})
+
+
+class TestMinValuesOperators:
+    def test_gt_with_min_values(self, solver_cls):
+        """minValues on a Gt-keyed requirement: the kept set must span the
+        floor of distinct values ABOVE the bound
+        (instance_selection_test.go:723)."""
+        res = solve(solver_cls, [pod()], requirements=[NodeSelectorRequirement(
+            INSTANCE_CPU_LABEL, "Gt", ["2"], min_values=2)])
+        assert res.scheduled_pod_count() == 1
+        (claim,) = res.new_claims
+        names = {it.name for it in claim.instance_types}
+        assert names <= {"sm", "md", "lg"}  # cpu > 2 only
+        cpus = {next(iter(it.requirements.get_req(INSTANCE_CPU_LABEL).values))
+                for it in claim.instance_types}
+        assert len(cpus) >= 2
+
+    def test_gt_min_values_unsatisfiable_fails(self, solver_cls):
+        """Only one distinct cpu value above the bound: minValues=2 cannot
+        hold (instance_selection_test.go:819)."""
+        res = solve(solver_cls, [pod()], requirements=[NodeSelectorRequirement(
+            INSTANCE_CPU_LABEL, "Gt", ["8"], min_values=2)])
+        assert res.scheduled_pod_count() == 0
+        assert res.pod_errors
+
+    def test_lt_with_min_values(self, solver_cls):
+        res = solve(solver_cls, [pod()], requirements=[NodeSelectorRequirement(
+            INSTANCE_CPU_LABEL, "Lt", ["8"], min_values=2)])
+        assert res.scheduled_pod_count() == 1
+        (claim,) = res.new_claims
+        assert {it.name for it in claim.instance_types} <= {"xs", "sm"}
+        assert len(claim.instance_types) >= 2
+
+    def test_max_of_min_values_across_operators(self, solver_cls):
+        """Two requirements on the SAME key: each minValues floor must hold
+        on the kept set (instance_selection_test.go:1061 takes the max)."""
+        res = solve(solver_cls, [pod()], requirements=[
+            NodeSelectorRequirement(INSTANCE_CPU_LABEL, "Gt", ["2"], min_values=1),
+            NodeSelectorRequirement(INSTANCE_CPU_LABEL, "Lt", ["16"], min_values=2),
+        ])
+        assert res.scheduled_pod_count() == 1
+        (claim,) = res.new_claims
+        cpus = {next(iter(it.requirements.get_req(INSTANCE_CPU_LABEL).values))
+                for it in claim.instance_types}
+        assert cpus <= {"4", "8"} and len(cpus) >= 2
+
+    def test_multiple_keys_with_min_values(self, solver_cls):
+        """Independent minValues floors on different keys must hold
+        simultaneously (instance_selection_test.go:1468)."""
+        res = solve(solver_cls, [pod()], requirements=[
+            NodeSelectorRequirement(wk.INSTANCE_TYPE_LABEL, "Exists", [],
+                                    min_values=3),
+            NodeSelectorRequirement(INSTANCE_CPU_LABEL, "Exists", [],
+                                    min_values=3),
+        ])
+        assert res.scheduled_pod_count() == 1
+        (claim,) = res.new_claims
+        assert len({it.name for it in claim.instance_types}) >= 3

@@ -83,3 +83,15 @@ class TestRemoteSolver:
         assert s.last_device_stats["retry_pods"] == 0
         for claim in res.new_claims:
             assert len({it.name for it in claim.instance_types}) >= 10
+
+
+class TestRemoteFallback:
+    def test_unreachable_service_falls_back_in_process(self):
+        """A dead device plane must not fail the provisioning round: the
+        solve completes in-process with a warning."""
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        its = {pool.name: benchmark_catalog(20)}
+        s = RemoteSolver("127.0.0.1:1")  # nothing listens there
+        res = s.solve([p.clone() for p in pods(10)], [ClaimTemplate(pool)], its)
+        assert res.scheduled_pod_count() == 10
+        assert s.last_device_stats["engine"] != "remote"
