@@ -24,13 +24,15 @@ from karpenter_tpu.scheduling import label_requirements, node_selector_requireme
 
 
 class NodeClaimDisruptionController:
-    def __init__(self, store, cloud, cluster, clock=None):
+    def __init__(self, store, cloud, cluster, clock=None, registry=None):
+        from karpenter_tpu.operator import metrics as _m
         from karpenter_tpu.utils.clock import Clock
 
         self.store = store
         self.cloud = cloud
         self.cluster = cluster
         self.clock = clock or Clock()
+        self.registry = registry or _m.REGISTRY
 
     def on_event(self, event):
         pass
@@ -113,7 +115,7 @@ class NodeClaimDisruptionController:
             return True
         return False
 
-    # -- expiration (nodeclaim/disruption/expiration.go:38) --------------
+    # -- expiration (nodeclaim/disruption/expiration.go:38-58) -----------
     def _reconcile_expired(self, claim, np) -> bool:
         expire_after = np.spec.disruption.expire_after
         if not expire_after:
@@ -123,8 +125,22 @@ class NodeClaimDisruptionController:
                 return True
             return False
         age = self.clock.now() - claim.metadata.creation_timestamp
-        if age >= expire_after and not claim.is_true(COND_EXPIRED):
+        if age < expire_after:
+            return False
+        if not claim.is_true(COND_EXPIRED):
             claim.set_condition(COND_EXPIRED, now=self.clock.now())
             self.store.update("nodeclaims", claim)
-            return True
-        return False
+        # the reference FORCEFULLY expires: the claim is deleted outright —
+        # no simulation, no budget, no pre-provisioned replacement
+        # (expiration.go:52 "we can forcefully expire the nodeclaim");
+        # the termination finalizer ring still drains the node gracefully,
+        # and displaced pods re-provision through the normal pending path.
+        # (poll() already skips terminating claims, so delete runs once.)
+        from karpenter_tpu.operator import metrics as m
+
+        self.store.delete("nodeclaims", claim)
+        self.registry.counter(
+            m.NODECLAIMS_DISRUPTED, "nodeclaims disrupted by reason"
+        ).inc(type="expiration",
+              nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""))
+        return True

@@ -127,7 +127,8 @@ class Environment:
                 registry=self.registry,
             ),
             NodeClaimDisruptionController(
-                self.store, self.cloud, self.cluster, clock=self.clock
+                self.store, self.cloud, self.cluster, clock=self.clock,
+                registry=self.registry,
             ),
             NodeClaimGarbageCollectionController(
                 self.store, self.cloud, clock=self.clock, recorder=self.recorder

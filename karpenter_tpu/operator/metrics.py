@@ -192,6 +192,7 @@ DISRUPTION_PODS = f"{NAMESPACE}_disruption_pods_disrupted_total"
 DISRUPTION_BUDGETS = f"{NAMESPACE}_disruption_allowed_disruptions"
 CONSOLIDATION_TIMEOUTS = f"{NAMESPACE}_disruption_consolidation_timeouts_total"
 DISRUPTION_ABNORMAL_RUNS = f"{NAMESPACE}_disruption_abnormal_runs_total"
+NODECLAIMS_DISRUPTED = f"{NAMESPACE}_nodeclaims_disrupted_total"
 CLUSTER_STATE_SYNCED = f"{NAMESPACE}_cluster_state_synced"
 CLOUDPROVIDER_DURATION = f"{NAMESPACE}_cloudprovider_duration_seconds"
 CLOUDPROVIDER_ERRORS = f"{NAMESPACE}_cloudprovider_errors_total"

@@ -188,17 +188,33 @@ class TestDriftAndExpiration:
         (claim,) = env.store.list("nodeclaims")
         assert claim.is_true(COND_DRIFTED)
 
-    def test_expire_after_sets_expired(self, env):
+    def test_expire_after_forcefully_replaces_claim(self, env):
+        """Expiration is FORCEFUL in this reference snapshot: the expired
+        claim is deleted outright (expiration.go:52), the node drains, and
+        the displaced workload re-provisions onto a fresh claim — no
+        budget, no pre-provisioned replacement."""
+        from karpenter_tpu.api.objects import Deployment
+
         np_ = nodepool()
         np_.spec.disruption.expire_after = 3600.0
         env.create("nodepools", np_)
-        env.provision(pod("p0"))
-        (claim,) = env.store.list("nodeclaims")
-        assert not claim.is_true(COND_EXPIRED)
-        env.clock.step(3601.0)
+        env.create("deployments", Deployment(
+            metadata=ObjectMeta(name="a"), replicas=1,
+            template=pod("a", labels={"app": "a"})))
         env.run_until_idle()
         (claim,) = env.store.list("nodeclaims")
-        assert claim.is_true(COND_EXPIRED)
+        first = claim.name
+        assert not claim.is_true(COND_EXPIRED)
+        env.clock.step(3601.0)
+        env.run_until_idle(max_rounds=200)
+        claims = env.store.list("nodeclaims")
+        assert [c.name for c in claims] != [first], "expired claim survived"
+        # workload landed on the replacement
+        pods = env.store.list("pods")
+        assert pods and all(p.node_name for p in pods)
+        c = env.registry.counter(
+            "karpenter_nodeclaims_disrupted_total", "")
+        assert c.value(type="expiration", nodepool="default") >= 1
 
     def test_cloud_provider_drift_reason(self, env):
         env.create("nodepools", nodepool())
