@@ -33,6 +33,8 @@ class NodeClaimDisruptionController:
         self.cluster = cluster
         self.clock = clock or Clock()
         self.registry = registry or _m.REGISTRY
+        self._disrupted = self.registry.counter(
+            _m.NODECLAIMS_DISRUPTED, "nodeclaims disrupted by reason")
 
     def on_event(self, event):
         pass
@@ -136,11 +138,8 @@ class NodeClaimDisruptionController:
         # the termination finalizer ring still drains the node gracefully,
         # and displaced pods re-provision through the normal pending path.
         # (poll() already skips terminating claims, so delete runs once.)
-        from karpenter_tpu.operator import metrics as m
-
         self.store.delete("nodeclaims", claim)
-        self.registry.counter(
-            m.NODECLAIMS_DISRUPTED, "nodeclaims disrupted by reason"
-        ).inc(type="expiration",
-              nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""))
+        self._disrupted.inc(
+            type="expiration",
+            nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""))
         return True

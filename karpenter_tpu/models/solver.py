@@ -114,6 +114,21 @@ _PACKED_KERNELS: dict = {}
 # native grid-100 ≈ 5 ms vs 100+ ms through the tunnel). Override with
 # KARPENTER_NATIVE_CUTOFF (0 disables ALL engine routing).
 NATIVE_CUTOFF_PODS = 192
+
+
+def _native_cutoff() -> int:
+    """The routing master switch: 0 disables ALL engine routing (tests pin
+    this to keep the XLA path under test)."""
+    import os
+
+    return int(os.environ.get("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS))
+# batches at or below this many pods skip tensorization entirely and run
+# the pure-Python FFD loop (the oracle): at single-pod scale even the C++
+# engine's tensorize/decode overhead loses to walking the list directly
+# (measured grid-1: 1.7 ms host vs ~4-7 ms native incl. tensorize).
+# Gated by the same master switch (KARPENTER_NATIVE_CUTOFF=0 disables all
+# routing); override with KARPENTER_HOST_CUTOFF.
+HOST_CUTOFF_PODS = 8
 # feasibility-work floor (real G×T cells, padding excluded) for the device:
 # the kernel's advantage is parallelism over groups×types, so a batch with
 # FEW DISTINCT GROUPS is a short sequential loop the C++ engine finishes in
@@ -172,8 +187,13 @@ class TPUSolver(Solver):
         volume_topology=None,
     ) -> SchedulerResults:
         has_topology = bool(getattr(topology, "has_groups", topology is not None and not isinstance(topology, NullTopology)))
-        if not templates:
-            return self.host.solve(
+        host_cutoff = 0
+        if _native_cutoff() > 0:
+            import os
+
+            host_cutoff = int(os.environ.get("KARPENTER_HOST_CUTOFF", HOST_CUTOFF_PODS))
+        if not templates or 0 < len(pods) <= host_cutoff:
+            res = self.host.solve(
                 pods,
                 templates,
                 instance_types,
@@ -183,6 +203,12 @@ class TPUSolver(Solver):
                 limits=limits,
                 volume_topology=volume_topology,
             )
+            if templates:
+                self.last_device_stats = dict(
+                    groups=0, types=0, device_pods=0, retry_pods=0,
+                    host_pods=len(pods), existing_pods=0, engine="host",
+                )
+            return res
         existing_nodes = list(existing_nodes)
 
         # weight order decides which template a new bin opens from
@@ -486,7 +512,7 @@ class TPUSolver(Solver):
         # fixed dispatch/tunnel latency dominates anything the accelerator
         # saves (the reference's stance that small batches are cheap,
         # batcher.go:52). Same tensors, same decode — only the kernel swaps.
-        cutoff = int(os.environ.get("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS))
+        cutoff = _native_cutoff()
         min_work = int(os.environ.get("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK))
         total = int(np.asarray(args["g_count"]).sum())
         # REAL counts, not the bucket-padded axes: padded groups have count

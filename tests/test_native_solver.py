@@ -265,3 +265,16 @@ class TestSmallBatchRouting:
         pods = [pod(f"p{i}", cpu=0.1 + (i % 200) * 0.01) for i in range(400)]
         s.solve(pods, [ClaimTemplate(pool)], {pool.name: cat})
         assert s.last_device_stats["engine"] == "device"
+
+    def test_tiny_batch_routes_to_host_loop(self, catalog, monkeypatch):
+        """At single-digit pod counts even tensorize overhead loses to the
+        pure FFD loop: the solve runs host-side outright."""
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        s = TPUSolver()
+        pool = nodepool()
+        res = s.solve([pod("p1")], [ClaimTemplate(pool)], {pool.name: catalog})
+        assert s.last_device_stats["engine"] == "host"
+        assert res.scheduled_pod_count() == 1
