@@ -28,8 +28,21 @@ class NodeClaimConsistencyController:
 
     def poll(self) -> bool:
         progressed = False
+        limits = None  # built once per poll, only if something terminates
         for claim in list(self.store.list("nodeclaims")):
-            if claim.metadata.deletion_timestamp is not None or not claim.initialized:
+            if claim.metadata.deletion_timestamp is not None:
+                # stuck-termination canary (consistency/termination.go:46):
+                # a terminating claim whose drain a PDB is blocking gets a
+                # visible reason instead of hanging silently. Pure
+                # observability: never counts as progress (the recorder
+                # dedupes repeats), or a stuck drain would spin the ring.
+                if limits is None:
+                    from karpenter_tpu.utils.pdb import PdbLimits
+
+                    limits = PdbLimits(self.store)
+                self._report_stuck_termination(claim, limits)
+                continue
+            if not claim.initialized:
                 continue
             node = self._node_for(claim)
             if node is None:
@@ -48,6 +61,33 @@ class NodeClaimConsistencyController:
                         "FailedConsistencyCheck", "; ".join(failures), obj=claim)
                 progressed = True
         return progressed
+
+    def _report_stuck_termination(self, claim, limits):
+        from karpenter_tpu.utils import pod as pod_util
+
+        node = self._node_for(claim)
+        if node is None or self.recorder is None:
+            return
+        for pod in self.store.list("pods"):
+            if pod.node_name != node.name or pod.metadata.deletion_timestamp:
+                continue
+            # mirror the drain's own filter (node/termination.py): pods the
+            # terminator never evicts cannot block it, so their PDBs must
+            # not trigger a false canary
+            if pod.owned_by_daemonset() or pod_util.is_owned_by_node(pod):
+                continue
+            if not pod_util.is_evictable(pod):
+                continue
+            blocking = limits.can_evict(pod)
+            if blocking is not None:
+                # emit-once semantics ride the recorder's dedupe TTL
+                self.recorder.publish(
+                    "FailedConsistencyCheck",
+                    f'can\'t drain node, PDB "{pod.namespace}/{blocking}" '
+                    "is blocking evictions",
+                    obj=claim,
+                )
+                return
 
     def _check(self, claim, node) -> list[str]:
         failures = []

@@ -237,3 +237,26 @@ class TestHashPropagation:
         want = np_.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION)
         assert want
         assert claim.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION) == want
+
+
+class TestStuckTerminationCanary:
+    def test_pdb_blocked_drain_reports_reason(self, env):
+        """A terminating claim whose drain a PDB blocks emits the
+        stuck-termination consistency event (consistency/termination.go:46)
+        instead of hanging silently."""
+        env.create("nodepools", nodepool())
+        env.create("pdbs", PodDisruptionBudget(
+            metadata=ObjectMeta(name="guard"),
+            selector=LabelSelector(match_labels={"app": "guarded"}),
+            min_available=1))
+        env.create("deployments", Deployment(
+            metadata=ObjectMeta(name="guarded"), replicas=1,
+            template=pod("guarded", labels={"app": "guarded"})))
+        env.run_until_idle()
+        (claim,) = env.store.list("nodeclaims")
+        env.store.delete("nodeclaims", claim)  # begin graceful termination
+        env.run_until_idle(max_rounds=30)
+        msgs = [e.message for e in env.recorder.by_reason("FailedConsistencyCheck")]
+        assert any("is blocking evictions" in m for m in msgs), msgs
+        # the claim is still terminating (drain blocked), not leaked
+        assert env.store.list("nodeclaims")
