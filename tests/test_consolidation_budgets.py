@@ -184,3 +184,95 @@ class TestFilterOutSameType:
         )
         method = MultiNodeConsolidation(ctx)
         assert method.compute_command(list(cands), BUDGETS) is None
+
+
+class TestSpotToSpotRules:
+    """consolidation.go:210-283: spot→spot replacement is feature-gated,
+    single-node spot→spot needs >=15 cheaper types (anti-churn), and the
+    kept list truncates to 15; on-demand candidates need no gate."""
+
+    def _ctx(self, gate):
+        clock = FakeClock(start=0.0)
+        return DisruptionContext(
+            provisioner=SimpleNamespace(), cluster=None, store=None,
+            clock=clock, options={"spot_to_spot_consolidation": gate},
+            registry=m.Registry())
+
+    def _sim(self, monkeypatch, replacement):
+        sim = SimpleNamespace(
+            new_claims=[replacement],
+            all_pods_scheduled=lambda: True)
+        monkeypatch.setattr(methods_mod, "simulate_scheduling",
+                            lambda *a, **kw: sim)
+
+    def _spot_candidate(self, price=1.0):
+        from karpenter_tpu.api import labels as wk
+
+        c = stub_candidate(0, price=price)
+        c.capacity_type = wk.CAPACITY_TYPE_SPOT
+        return c
+
+    def _types(self, n, price=0.01):
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+
+        return [make_instance_type(f"t{i:02d}", 1, 2, price_override=price)
+                for i in range(n)]
+
+    def test_gate_off_blocks_spot_to_spot(self, monkeypatch):
+        ctx = self._ctx(gate=False)
+        self._sim(monkeypatch, SimpleNamespace(
+            instance_types=self._types(20), requirements=Requirements()))
+        from karpenter_tpu.controllers.disruption.methods import (
+            compute_consolidation,
+        )
+
+        assert compute_consolidation(ctx, [self._spot_candidate()]) is None
+
+    def test_gate_on_needs_fifteen_cheaper_types(self, monkeypatch):
+        ctx = self._ctx(gate=True)
+        self._sim(monkeypatch, SimpleNamespace(
+            instance_types=self._types(10), requirements=Requirements()))
+        from karpenter_tpu.controllers.disruption.methods import (
+            compute_consolidation,
+        )
+
+        assert compute_consolidation(ctx, [self._spot_candidate()]) is None
+
+    def test_gate_on_with_enough_types_replaces_and_truncates(self, monkeypatch):
+        ctx = self._ctx(gate=True)
+        replacement = SimpleNamespace(
+            instance_types=self._types(25), requirements=Requirements())
+        self._sim(monkeypatch, replacement)
+        from karpenter_tpu.controllers.disruption.methods import (
+            compute_consolidation,
+        )
+
+        cmd = compute_consolidation(ctx, [self._spot_candidate()])
+        assert cmd is not None and cmd.action == "replace"
+        assert len(cmd.replacements[0].instance_types) == 15  # anti-churn cap
+
+    def test_on_demand_candidate_needs_no_gate(self, monkeypatch):
+        from karpenter_tpu.api import labels as wk
+
+        ctx = self._ctx(gate=False)
+        c = stub_candidate(0, price=1.0)
+        c.capacity_type = wk.CAPACITY_TYPE_ON_DEMAND
+        self._sim(monkeypatch, SimpleNamespace(
+            instance_types=self._types(3), requirements=Requirements()))
+        from karpenter_tpu.controllers.disruption.methods import (
+            compute_consolidation,
+        )
+
+        cmd = compute_consolidation(ctx, [c])
+        assert cmd is not None and cmd.action == "replace"
+
+    def test_no_cheaper_types_means_no_op(self, monkeypatch):
+        ctx = self._ctx(gate=True)
+        self._sim(monkeypatch, SimpleNamespace(
+            instance_types=self._types(20, price=5.0),  # all pricier
+            requirements=Requirements()))
+        from karpenter_tpu.controllers.disruption.methods import (
+            compute_consolidation,
+        )
+
+        assert compute_consolidation(ctx, [self._spot_candidate()]) is None
