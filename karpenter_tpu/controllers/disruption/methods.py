@@ -178,7 +178,13 @@ def compute_consolidation(ctx, candidates) -> Command | None:
             return None  # feature-gated (consolidation.go:214)
         if len(candidates) == 1 and len(cheaper) < SPOT_TO_SPOT_MIN_TYPES:
             return None  # anti-churn floor (consolidation.go:253-277)
-        cheaper = cheaper[:SPOT_TO_SPOT_MIN_TYPES]
+        # keep the CHEAPEST 15 (the reference price-sorts its options
+        # before slicing, consolidation.go:269): launching from the
+        # cheapest band is the whole point of the churn
+        from karpenter_tpu.cloudprovider.types import order_by_price
+
+        cheaper = order_by_price(cheaper, replacement.requirements)[
+            :SPOT_TO_SPOT_MIN_TYPES]
     else:
         # on-demand (or mixed) candidates: replacement may be spot or a
         # cheaper on-demand type; requirements keep both capacity types

@@ -212,44 +212,52 @@ class TestSpotToSpotRules:
         c.capacity_type = wk.CAPACITY_TYPE_SPOT
         return c
 
-    def _types(self, n, price=0.01):
-        from karpenter_tpu.cloudprovider.catalog import make_instance_type
-
-        return [make_instance_type(f"t{i:02d}", 1, 2, price_override=price)
+    def _types(self, n, price=0.01, step=0.0):
+        # ascending prices when step>0 so "cheapest kept" is detectable
+        return [make_instance_type(f"t{i:02d}", 1, 2,
+                                   price_override=price + i * step)
                 for i in range(n)]
 
     def test_gate_off_blocks_spot_to_spot(self, monkeypatch):
         ctx = self._ctx(gate=False)
         self._sim(monkeypatch, SimpleNamespace(
             instance_types=self._types(20), requirements=Requirements()))
-        from karpenter_tpu.controllers.disruption.methods import (
-            compute_consolidation,
-        )
-
-        assert compute_consolidation(ctx, [self._spot_candidate()]) is None
+        assert methods_mod.compute_consolidation(ctx, [self._spot_candidate()]) is None
 
     def test_gate_on_needs_fifteen_cheaper_types(self, monkeypatch):
         ctx = self._ctx(gate=True)
         self._sim(monkeypatch, SimpleNamespace(
             instance_types=self._types(10), requirements=Requirements()))
-        from karpenter_tpu.controllers.disruption.methods import (
-            compute_consolidation,
-        )
+        assert methods_mod.compute_consolidation(ctx, [self._spot_candidate()]) is None
 
-        assert compute_consolidation(ctx, [self._spot_candidate()]) is None
-
-    def test_gate_on_with_enough_types_replaces_and_truncates(self, monkeypatch):
+    def test_gate_on_keeps_the_cheapest_fifteen(self, monkeypatch):
         ctx = self._ctx(gate=True)
-        replacement = SimpleNamespace(
-            instance_types=self._types(25), requirements=Requirements())
-        self._sim(monkeypatch, replacement)
-        from karpenter_tpu.controllers.disruption.methods import (
-            compute_consolidation,
-        )
+        # ascending prices, shuffled order: the kept 15 must be the
+        # CHEAPEST 15 (the reference price-sorts before slicing,
+        # consolidation.go:269), not the first 15 seen
+        import random
 
-        cmd = compute_consolidation(ctx, [self._spot_candidate()])
+        types = self._types(25, price=0.01, step=0.001)
+        random.Random(3).shuffle(types)
+        replacement = SimpleNamespace(
+            instance_types=types, requirements=Requirements())
+        self._sim(monkeypatch, replacement)
+        cmd = methods_mod.compute_consolidation(ctx, [self._spot_candidate()])
         assert cmd is not None and cmd.action == "replace"
-        assert len(cmd.replacements[0].instance_types) == 15  # anti-churn cap
+        kept = [it.name for it in cmd.replacements[0].instance_types]
+        assert len(kept) == 15  # anti-churn cap
+        assert sorted(kept) == [f"t{i:02d}" for i in range(15)]
+
+    def test_multi_node_spot_needs_no_fifteen_type_floor(self, monkeypatch):
+        """The >=15 floor is SINGLE-candidate anti-churn only: an m->1
+        all-spot consolidation with few cheaper types still replaces
+        (consolidation.go:253's len(candidates)==1 scoping)."""
+        ctx = self._ctx(gate=True)
+        self._sim(monkeypatch, SimpleNamespace(
+            instance_types=self._types(5), requirements=Requirements()))
+        cands = [self._spot_candidate(), self._spot_candidate()]
+        cmd = methods_mod.compute_consolidation(ctx, cands)
+        assert cmd is not None and cmd.action == "replace"
 
     def test_on_demand_candidate_needs_no_gate(self, monkeypatch):
         from karpenter_tpu.api import labels as wk
@@ -259,11 +267,7 @@ class TestSpotToSpotRules:
         c.capacity_type = wk.CAPACITY_TYPE_ON_DEMAND
         self._sim(monkeypatch, SimpleNamespace(
             instance_types=self._types(3), requirements=Requirements()))
-        from karpenter_tpu.controllers.disruption.methods import (
-            compute_consolidation,
-        )
-
-        cmd = compute_consolidation(ctx, [c])
+        cmd = methods_mod.compute_consolidation(ctx, [c])
         assert cmd is not None and cmd.action == "replace"
 
     def test_no_cheaper_types_means_no_op(self, monkeypatch):
@@ -271,8 +275,4 @@ class TestSpotToSpotRules:
         self._sim(monkeypatch, SimpleNamespace(
             instance_types=self._types(20, price=5.0),  # all pricier
             requirements=Requirements()))
-        from karpenter_tpu.controllers.disruption.methods import (
-            compute_consolidation,
-        )
-
-        assert compute_consolidation(ctx, [self._spot_candidate()]) is None
+        assert methods_mod.compute_consolidation(ctx, [self._spot_candidate()]) is None
