@@ -161,36 +161,38 @@ def compute_consolidation(ctx, candidates) -> Command | None:
 
     # the replacement must launch strictly cheaper than the candidates cost
     # now: filter its instance types to the cheaper-than-current set
-    # (consolidation.go filterByPrice:210)
-    cheaper = []
+    # (consolidation.go filterByPrice:210), keeping the comparison price
+    # (spot-only when the whole candidate set is spot)
+    priced = []
     for it in replacement.instance_types:
         ofs = it.offerings.available().compatible(replacement.requirements)
         if all_spot:
             # spot→spot: compare within spot offerings only
             ofs = type(ofs)(o for o in ofs if o.capacity_type == wk.CAPACITY_TYPE_SPOT)
-        if ofs and min(o.price for o in ofs) < current_price:
-            cheaper.append(it)
-    if not cheaper:
+        if not ofs:
+            continue
+        p = min(o.price for o in ofs)
+        if p < current_price:
+            priced.append((p, it))
+    if not priced:
         return None
 
     if all_spot:
         if not ctx.options.get("spot_to_spot_consolidation", False):
             return None  # feature-gated (consolidation.go:214)
-        if len(candidates) == 1 and len(cheaper) < SPOT_TO_SPOT_MIN_TYPES:
+        if len(candidates) == 1 and len(priced) < SPOT_TO_SPOT_MIN_TYPES:
             return None  # anti-churn floor (consolidation.go:253-277)
-        # keep the CHEAPEST 15 (the reference price-sorts its options
-        # before slicing, consolidation.go:269): launching from the
-        # cheapest band is the whole point of the churn
-        from karpenter_tpu.cloudprovider.types import order_by_price
+        # keep the CHEAPEST 15 by the same SPOT-ONLY price the filter used
+        # (the reference price-sorts its options before slicing,
+        # consolidation.go:269): launching from the cheapest spot band is
+        # the whole point of the churn — an on-demand offering priced
+        # under a type's spot price must not buy it a slot
+        priced.sort(key=lambda t: (t[0], t[1].name))
+        priced = priced[:SPOT_TO_SPOT_MIN_TYPES]
+    # else: on-demand (or mixed) candidates keep both capacity types and
+    # the full cheaper set, in the replacement's original (price) order
 
-        cheaper = order_by_price(cheaper, replacement.requirements)[
-            :SPOT_TO_SPOT_MIN_TYPES]
-    else:
-        # on-demand (or mixed) candidates: replacement may be spot or a
-        # cheaper on-demand type; requirements keep both capacity types
-        pass
-
-    replacement.instance_types = cheaper
+    replacement.instance_types = [it for _, it in priced]
     return Command(candidates, replacements=[replacement], reason=REASON_UNDERUTILIZED)
 
 
