@@ -80,24 +80,38 @@ class TestChaosConvergence:
             deploys.append(d)
             env.create("deployments", d)
 
-        # the storm: workload churn + pod kills + ICE'd launches,
-        # randomized controller orderings throughout
+        # the storm: workload churn + pod kills + ICE'd launches + offering
+        # availability flaps, randomized controller orderings throughout
+        offerings = [o for it in env.cloud.get_instance_types(pool) for o in it.offerings]
+        flaps = 0
         for _ in range(12):
             action = rng.random()
-            if action < 0.4:
+            if action < 0.35:
                 d = rng.choice(deploys)
                 d.replicas = rng.randint(0, 5)
                 env.store.update("deployments", d)
-            elif action < 0.7:
+            elif action < 0.6:
                 pods = [p for p in env.store.list("pods")
                         if p.metadata.deletion_timestamp is None]
                 if pods:
                     env.store.delete("pods", rng.choice(pods))
+            elif action < 0.8:
+                # market turbulence: a random offering ICEs or recovers
+                # (exercises off_avail feasibility + the validation TTL's
+                # fresh-sim type-intersection drop)
+                o = rng.choice(offerings)
+                o.available = not o.available
+                flaps += 1
             else:
                 env.clock.step(rng.choice([5.0, 20.0, 60.0]))
             env.run_until_idle_shuffled(rng, max_rounds=150)
 
+        # markets recover with the storm
+        for o in offerings:
+            o.available = True
+
         assert chaos.ices > 0, "the storm should have injected faults"
+        assert flaps > 0, "the storm should have flapped an offering"
         # storm over: faults off, give the ring time to converge
         chaos.active = False
         for _ in range(8):
