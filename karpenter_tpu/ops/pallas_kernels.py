@@ -14,14 +14,15 @@ keep the jnp path. Enabled with KARPENTER_PALLAS=1 on a real TPU;
 `interpret=True` runs the same kernel on CPU for the parity tests.
 
 STATUS — reference kernel, not the default. Measured head-to-head on the
-50k-pod × 500-type headline bench (round 5, real TPU, best-of-5 each):
-pallas OFF 167/194 ms vs pallas ON 201/204 ms across two paired runs.
-XLA's fusion of the jnp formulation beats the hand-tiled Mosaic kernel
-here — the op is too small a share of the solve for tiling to pay, and
-the kernel boundary blocks fusion with the surrounding feasibility ops.
-Kept as a parity-tested reference for the day a bigger vocabulary or a
-fused feasibility+pack Mosaic kernel changes the math; bench.py records
-the on/off comparison in detail.pallas each round.
+50k-pod × 500-type headline bench (round 5, real TPU, best-of-5 each,
+four paired runs): pallas OFF 124/146/167/194 ms vs ON 127/130/201/204 ms.
+The deltas sit inside the tunnel's ±40 ms jitter — neither side wins
+reliably, which itself is the verdict: the compat op is too small a share
+of the solve for hand tiling to pay, and the kernel boundary blocks
+fusion with the surrounding feasibility ops, so the simpler XLA-fused jnp
+path stays the default. Kept as a parity-tested reference for the day a
+bigger vocabulary or a fused feasibility+pack Mosaic kernel changes the
+math; bench.py records the on/off comparison in detail.pallas each round.
 """
 
 from __future__ import annotations
