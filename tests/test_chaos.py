@@ -57,7 +57,9 @@ class ChaosCloud:
         env.cloud.create = create
 
 
-@pytest.mark.parametrize("seed", [3, 11, 99])
+# seed 100 draws zero flap actions in its storm, exercising the
+# forced-flap fallback; the others flap naturally
+@pytest.mark.parametrize("seed", [3, 11, 99, 100])
 class TestChaosConvergence:
     def test_storm_then_clean_fixpoint(self, seed):
         rng = random.Random(seed)
@@ -106,12 +108,21 @@ class TestChaosConvergence:
                 env.clock.step(rng.choice([5.0, 20.0, 60.0]))
             env.run_until_idle_shuffled(rng, max_rounds=150)
 
+        if flaps == 0:
+            # ~10% of seeds never draw the flap branch in 12 iterations:
+            # force one so every seed exercises the off_avail path (the
+            # same every-seed guarantee the first-create ICE gives)
+            rng.choice(offerings).available = False
+            flaps += 1
+            env.run_until_idle_shuffled(rng, max_rounds=150)
+
         # markets recover with the storm
         for o in offerings:
             o.available = True
 
         assert chaos.ices > 0, "the storm should have injected faults"
-        assert flaps > 0, "the storm should have flapped an offering"
+        # flaps >= 1 holds by construction (the fallback); seed 100 pins
+        # the fallback branch itself, the other seeds the storm branch
         # storm over: faults off, give the ring time to converge
         chaos.active = False
         for _ in range(8):
