@@ -18,6 +18,16 @@ class Condition:
     message: str = ""
     last_transition_time: float = field(default_factory=time.time)
 
+    @classmethod
+    def from_wire(cls, c: dict) -> "Condition":
+        """One normalizer for dict-shaped conditions (wire docs, test
+        fixtures) — every entry point must share it or the shapes drift."""
+        return cls(
+            type=c["type"], status=c.get("status", "Unknown"),
+            reason=c.get("reason", ""), message=c.get("message", ""),
+            last_transition_time=c.get("lastTransitionTime", 0.0),
+        )
+
 
 class ConditionedObject:
     """Mixin for objects with status.conditions: get/set/clear/is_true.
@@ -34,10 +44,7 @@ class ConditionedObject:
                     continue
                 # normalize dict-shaped conditions in place so set_condition
                 # and clear_condition can rely on attribute access
-                c = Condition(
-                    type=c["type"], status=c.get("status", "Unknown"),
-                    reason=c.get("reason", ""), message=c.get("message", ""),
-                )
+                c = Condition.from_wire(c)
                 self.status.conditions[i] = c
                 return c
             if c.type == cond_type:

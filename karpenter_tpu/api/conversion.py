@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import re
 
+from karpenter_tpu.api.conditions import Condition
 from karpenter_tpu.api.nodeclaim import NodeClaim, NodeClaimSpec, NodeClaimStatus
 from karpenter_tpu.api.nodepool import (
     Budget,
@@ -87,6 +88,27 @@ def format_duration(seconds: float | None) -> str:
 
 
 # ---- shared fragments ---------------------------------------------------
+
+def _conditions_from(items) -> list:
+    return [Condition.from_wire(c) for c in items or ()]
+
+
+def _conditions_to(conds) -> list:
+    out = []
+    for c in conds:
+        if isinstance(c, dict):
+            out.append(dict(c))
+            continue
+        d = {"type": c.type, "status": c.status}
+        if c.reason:
+            d["reason"] = c.reason
+        if c.message:
+            d["message"] = c.message
+        if c.last_transition_time:
+            d["lastTransitionTime"] = c.last_transition_time
+        out.append(d)
+    return out
+
 
 def _meta_from(doc: dict) -> ObjectMeta:
     m = doc.get("metadata", {})
@@ -171,7 +193,8 @@ def _nodepool_from(doc: dict, version: str) -> NodePool:
     # hub object would resurrect a later-cleared kubelet on the next encode
     meta.annotations.pop(KUBELET_COMPAT_ANNOTATION, None)
 
-    return NodePool(
+    status = doc.get("status", {})
+    np_ = NodePool(
         metadata=meta,
         spec=NodePoolSpec(
             template=NodeClaimTemplate(
@@ -201,6 +224,9 @@ def _nodepool_from(doc: dict, version: str) -> NodePool:
             weight=spec.get("weight", 0),
         ),
     )
+    np_.status.resources = dict(status.get("resources", {}))
+    np_.status.conditions = _conditions_from(status.get("conditions"))
+    return np_
 
 
 def _nodepool_to(np: NodePool, version: str) -> dict:
@@ -258,12 +284,20 @@ def _nodepool_to(np: NodePool, version: str) -> dict:
         spec["limits"] = dict(np.spec.limits)
     if np.spec.weight:
         spec["weight"] = np.spec.weight
-    return {
+    out = {
         "apiVersion": version,
         "kind": "NodePool",
         "metadata": meta,
         "spec": spec,
     }
+    status: dict = {}
+    if np.status.resources:
+        status["resources"] = dict(np.status.resources)
+    if np.status.conditions:
+        status["conditions"] = _conditions_to(np.status.conditions)
+    if status:
+        out["status"] = status
+    return out
 
 
 # ---- NodeClaim ----------------------------------------------------------
@@ -299,6 +333,7 @@ def _nodeclaim_from(doc: dict, version: str) -> NodeClaim:
             node_name=status.get("nodeName", ""),
             capacity=dict(status.get("capacity", {})),
             allocatable=dict(status.get("allocatable", {})),
+            conditions=_conditions_from(status.get("conditions")),
         ),
     )
 
@@ -332,12 +367,16 @@ def _nodeclaim_to(nc: NodeClaim, version: str) -> dict:
     status: dict = {}
     if nc.status.provider_id:
         status["providerID"] = nc.status.provider_id
+    if nc.status.image_id:
+        status["imageID"] = nc.status.image_id
     if nc.status.node_name:
         status["nodeName"] = nc.status.node_name
     if nc.status.capacity:
         status["capacity"] = dict(nc.status.capacity)
     if nc.status.allocatable:
         status["allocatable"] = dict(nc.status.allocatable)
+    if nc.status.conditions:
+        status["conditions"] = _conditions_to(nc.status.conditions)
     out = {
         "apiVersion": version,
         "kind": "NodeClaim",

@@ -183,3 +183,36 @@ class TestKubeletCompatStash:
         anns = v1_again["metadata"].get("annotations", {})
         assert KUBELET_COMPAT_ANNOTATION not in anns
         assert decode(v1_again).spec.template.kubelet == {}
+
+
+class TestStatusRoundTrip:
+    def test_nodeclaim_conditions_cross_the_wire(self):
+        from karpenter_tpu.api.nodeclaim import COND_INITIALIZED, NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+
+        nc = NodeClaim(metadata=ObjectMeta(name="c1"))
+        nc.status.provider_id = "pid-1"
+        nc.set_condition(COND_INITIALIZED, now=123.0)
+        wire = encode(nc, V1)
+        conds = wire["status"]["conditions"]
+        assert conds and conds[0]["type"] == COND_INITIALIZED
+        back = decode(wire)
+        assert back.is_true(COND_INITIALIZED)
+        assert back.status.provider_id == "pid-1"
+
+    def test_image_id_round_trips(self):
+        from karpenter_tpu.api.nodeclaim import NodeClaim
+        from karpenter_tpu.api.objects import ObjectMeta
+
+        nc = NodeClaim(metadata=ObjectMeta(name="c2"))
+        nc.status.image_id = "ami-123"
+        for version in (V1, V1BETA1):
+            assert decode(encode(nc, version)).status.image_id == "ami-123"
+
+    def test_nodepool_status_round_trips(self):
+        hub = decode(V1BETA1_NODEPOOL)
+        hub.status.resources = {"cpu": 42.0}
+        hub.set_condition("Ready", now=5.0)
+        back = decode(encode(hub, V1))
+        assert back.status.resources == {"cpu": 42.0}
+        assert back.is_true("Ready")
