@@ -21,11 +21,14 @@ class Condition:
     @classmethod
     def from_wire(cls, c: dict) -> "Condition":
         """One normalizer for dict-shaped conditions (wire docs, test
-        fixtures) — every entry point must share it or the shapes drift."""
+        fixtures) — every entry point must share it or the shapes drift.
+        A missing transition time reads as NOW: age-gated consumers
+        (emptiness consolidate_after, drift ordering) must restart their
+        waits rather than treat the condition as epoch-old."""
         return cls(
             type=c["type"], status=c.get("status", "Unknown"),
             reason=c.get("reason", ""), message=c.get("message", ""),
-            last_transition_time=c.get("lastTransitionTime", 0.0),
+            last_transition_time=c.get("lastTransitionTime") or time.time(),
         )
 
 
