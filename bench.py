@@ -11,9 +11,15 @@ Resilience: the TPU backend on this image is reached through a tunnel that
 can be contended or down, and a blocked PJRT init sleeps FOREVER (round 1
 died exactly this way, BENCH_r01.json rc=1). Every engine attempt therefore
 runs in a watchdog subprocess with a hard timeout, retries with backoff,
-and falls down a ladder — axon (TPU) → jax CPU → native C++ — so this
-script always prints a benchmark record and exits 0. Diagnostics for every
-failed attempt ride along in detail.attempts.
+and falls down a ladder — axon (TPU) → jax CPU → native C++ — so once the
+ladder starts this script always prints a benchmark record and exits 0.
+Diagnostics for every failed attempt ride along in detail.attempts.
+
+ONE deliberate exception precedes the ladder: the graftlint preflight
+(tier-1 gate, ISSUE 1). An unsuppressed static-analysis finding is a repo
+bug, not an environment hazard, so it exits 2 with the findings on stderr
+in milliseconds — failing fast is the point, and no engine record exists
+to report.
 """
 
 from __future__ import annotations
@@ -224,6 +230,24 @@ def main():
 
     n_pods = int(args[0]) if args else 50_000
     n_types = int(args[1]) if len(args) > 1 else 500
+
+    # graftlint preflight: an unsuppressed static-analysis finding fails in
+    # milliseconds here instead of after minutes of ladder attempts — the
+    # same tier-1 gate tests/test_static_analysis.py enforces. stdlib-only,
+    # so it cannot wedge on the tunnel the way a jax import can.
+    from karpenter_tpu.analysis import preflight
+
+    # anchored on the script, not the cwd: `python /path/to/bench.py` from
+    # anywhere must analyze the real tree, not silently scan nothing
+    pkg_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "karpenter_tpu")
+    problems = preflight([pkg_dir])
+    if problems:
+        for line in problems:
+            print(f"bench: {line}", file=sys.stderr)
+        print("bench: graftlint preflight failed — fix or suppress (with "
+              "justification) before benching", file=sys.stderr)
+        sys.exit(2)
 
     attempts = []
     for engine, tries, timeout, backoff in LADDER:

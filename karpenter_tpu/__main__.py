@@ -78,8 +78,11 @@ def _apply(env, plural: str, doc: dict) -> int:
     return 1
 
 
-def serve_metrics(registry, port: int):
-    """Prometheus text endpoint (the operator.go:160 metrics mux analog)."""
+def serve_metrics(registry, port: int, host: str = ""):
+    """Prometheus text endpoint (the operator.go:160 metrics mux analog).
+    `host` defaults to all interfaces for containerized scrapes; deploys
+    without a NetworkPolicy narrow it via KARPENTER_METRICS_BIND
+    (deploy/README.md, network exposure)."""
     from http.server import BaseHTTPRequestHandler, HTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -97,10 +100,11 @@ def serve_metrics(registry, port: int):
         def log_message(self, *a):  # quiet
             pass
 
-    # all interfaces: a container's Prometheus scrape arrives on the pod IP
-    # (operator.go's mux binds the same way); loopback would be dead in the
-    # deployment this entrypoint exists for
-    server = HTTPServer(("", port), Handler)
+    # default is all interfaces: a container's Prometheus scrape arrives on
+    # the pod IP (operator.go's mux binds the same way); loopback would be
+    # dead in the deployment this entrypoint exists for, so narrowing is an
+    # explicit override (KARPENTER_METRICS_BIND)
+    server = HTTPServer((host, port), Handler)
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server
@@ -144,13 +148,20 @@ def main(argv=None) -> int:
         log=make_logger(options.log_level),
     )
     if target:
+        # fallback counter + warn must land on THIS environment's registry
+        # and logging plane (the ones /metrics and stderr actually serve)
+        solver.bind_observability(registry=env.registry, log=env.log)
         print(f"karpenter-tpu operator: solver plane at {target}", file=sys.stderr)
 
     applied = sum(load_manifest(env, m) for m in args.manifest)
     print(f"karpenter-tpu operator: {applied} manifest objects applied, "
           f"tick={args.tick}s", file=sys.stderr)
 
-    server = serve_metrics(env.registry, options.metrics_port) if args.metrics else None
+    server = (
+        serve_metrics(env.registry, options.metrics_port,
+                      host=options.metrics_bind_addr)
+        if args.metrics else None
+    )
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

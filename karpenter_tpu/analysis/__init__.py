@@ -1,0 +1,76 @@
+"""graftlint: repo-native static analysis for the jax_graft codebase.
+
+Three rule families over the package AST (stdlib-only, no jax import —
+cheap enough to run as a tier-1 gate and as bench.py's preflight):
+
+- GL1xx tracing safety (tracing.py): host syncs, traced-value branching,
+  trace-time side effects, and jit-in-loop recompilation storms in code
+  reachable from ``jax.jit`` / ``pl.pallas_call`` entries.
+- GL2xx lock discipline (locks.py): unguarded mutation of lock-guarded
+  state, ABBA lock-order cycles, and plain-Lock re-entry deadlocks.
+- GL3xx drift (drift.py): stale/dead ``__init__`` export surface and
+  swallowed exceptions in controller reconcile paths.
+
+CLI: ``python -m karpenter_tpu.analysis [paths...]`` — exits nonzero on
+any unsuppressed finding. Suppress a justified pattern inline::
+
+    # graftlint: disable=GL101 -- host-side guard; jitted callers pass it
+
+See core.py for the directive grammar (line, def/class scope, and
+file-level forms).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.analysis.core import Finding, Project
+from karpenter_tpu.analysis.drift import RULES as _DRIFT_RULES, check_drift
+from karpenter_tpu.analysis.locks import RULES as _LOCK_RULES, check_locks
+from karpenter_tpu.analysis.tracing import RULES as _TRACING_RULES, check_tracing
+
+RULES: dict = {**_TRACING_RULES, **_LOCK_RULES, **_DRIFT_RULES}
+
+__all__ = [
+    "Finding",
+    "Project",
+    "RULES",
+    "analyze_project",
+    "analyze_paths",
+    "analyze_sources",
+    "preflight",
+]
+
+
+def analyze_project(project: Project):
+    """Run every rule family; returns (findings, suppressed) sorted by
+    position, deduplicated by (path, line, rule)."""
+    raw = check_tracing(project) + check_locks(project) + check_drift(project)
+    by_path = {m.path: m for m in project.modules.values()}
+    findings, suppressed, seen = [], [], set()
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.path, f.line, f.rule)
+        if key in seen:
+            continue
+        seen.add(key)
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.line, f.rule):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+def analyze_paths(paths):
+    return analyze_project(Project.from_paths(paths))
+
+
+def analyze_sources(sources: dict):
+    """Fixture entry point: {dotted_module_name: source} -> (findings,
+    suppressed). Used by tests to seed positive/negative rule fixtures."""
+    return analyze_project(Project.from_sources(sources))
+
+
+def preflight(paths) -> list:
+    """Rendered unsuppressed findings for embedding callers (bench.py runs
+    this before a long benchmark so a lint regression fails in seconds)."""
+    findings, _ = analyze_paths(paths)
+    return [f.render() for f in findings]

@@ -1,0 +1,47 @@
+"""``python -m karpenter_tpu.analysis [paths...]`` — the graftlint CLI.
+
+Prints one ``path:line: RULE-ID message`` per unsuppressed finding and
+exits 1 when any exist (0 otherwise); suppressed counts ride the summary
+line so justified exceptions stay visible. ``--list-rules`` documents the
+rule set. This is the tier-1 gate entry point (tests/test_static_analysis.py
+asserts a zero-finding tree) and bench.py's preflight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from karpenter_tpu.analysis import RULES, analyze_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="karpenter_tpu.analysis",
+        description="graftlint: tracing-safety, lock-discipline, and drift "
+        "checks for the karpenter_tpu tree",
+    )
+    ap.add_argument("paths", nargs="*", default=["karpenter_tpu"],
+                    help="files or directories to analyze (default: karpenter_tpu)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule ids and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES):
+            print(f"{rule}  {RULES[rule]}")
+        return 0
+
+    findings, suppressed = analyze_paths(args.paths or ["karpenter_tpu"])
+    for f in findings:
+        print(f.render())
+    print(
+        f"graftlint: {len(findings)} finding(s), "
+        f"{len(suppressed)} suppressed",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

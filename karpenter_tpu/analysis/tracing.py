@@ -1,0 +1,595 @@
+"""GL1xx — tracing safety for jit/pallas hot paths.
+
+The solve path compiles through ``jax.jit`` / ``pl.pallas_call`` wrappers
+(models/solver.py, ops/consolidate.py, parallel/mesh.py, ops/pallas_kernels.py).
+Inside anything reachable from those entries, the silent failure modes are:
+
+- GL101 host-sync: ``.item()`` / ``float()`` / ``int()`` / ``bool()`` /
+  ``np.asarray()`` on a traced value — a device→host pull per call (64 ms
+  each through the tunnel) or an outright TracerError.
+- GL102 traced-branch: Python ``if``/``while``/``assert`` on a traced
+  value — TracerBoolConversionError, or worse, a concrete leak that bakes
+  one batch's data into the compiled program.
+- GL103 trace-side-effect: ``print``, ``logging`` calls, ``os.environ``
+  reads, and ``global`` writes inside traced code — they run once at trace
+  time and freeze (the pallas_enabled() cache-keying bug class).
+- GL104 jit-in-loop: constructing ``jax.jit(...)`` / ``pl.pallas_call(...)``
+  inside a loop body — a fresh wrapper per iteration recompiles every time
+  (the recompilation-storm class the module-level kernel caches exist for).
+
+Reachability is an inter-procedural taint pass: entry functions are those
+handed to jit/pallas_call (as decorator, call argument, or via
+``functools.partial`` with its bound kwargs treated as static); calls into
+package-local functions propagate which parameters carry tracers, so a
+static ``max_bins=...`` threaded through ``solve_step`` never poisons the
+branch checks. Shape reads (``x.shape``/``ndim``/``dtype``/``size``,
+``len()``) and structure tests (``is None``, ``in``, ``isinstance``) are
+host-static by construction and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from karpenter_tpu.analysis.core import Finding, dotted
+
+RULES = {
+    "GL101": "host sync (.item()/float()/int()/bool()/np.asarray) on a traced value in jit-reachable code",
+    "GL102": "Python branch (if/while/assert) on a traced value in jit-reachable code",
+    "GL103": "host side effect (print/logging/os.environ/global) in jit-reachable code freezes at trace time",
+    "GL104": "jax.jit/pl.pallas_call constructed inside a loop recompiles every iteration",
+}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_HOST_RESULT_FUNCS = {"len", "isinstance", "type", "id", "hash"}
+_CAST_FUNCS = {"float", "int", "bool", "complex"}
+_NUMPY_ALIASES = {"np", "_np", "numpy", "onp"}
+_JIT_NAMES = {"jax.jit", "jit"}
+_PALLAS_NAMES = {"pl.pallas_call", "pallas.pallas_call", "pallas_call"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _const_names(node) -> set:
+    """static_argnames/argnums value -> set of str names and int indices
+    (a bare constant or a tuple/list/set of them). Int indices are resolved
+    to parameter names positionally once the target function is known."""
+    out = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, (str, int)):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, (str, int)):
+                out.add(elt.value)
+    return out
+
+
+def _resolve_static(static: set, params: list) -> set:
+    """Mixed str/int static spec -> parameter-name set."""
+    out = set()
+    for s in static:
+        if isinstance(s, int):
+            if 0 <= s < len(params):
+                out.add(params[s])
+        else:
+            out.add(s)
+    return out
+
+
+def _param_names(fn) -> list:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+class _FunctionEnv:
+    """Name resolution for one function: nested defs of the lexical chain,
+    module top-level functions, then imports."""
+
+    def __init__(self, project, module, chain):
+        self.project = project
+        self.module = module
+        self.chain = chain  # enclosing FunctionDefs, outermost first
+        self.imports = project.resolve_imports(module)
+        self.top = project.top_level_functions(module)
+
+    def local_defs(self) -> dict:
+        defs = {}
+        for fn in self.chain:
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(node.name, node)
+        return defs
+
+    def resolve(self, func_node):
+        """A callee expression -> (module, FunctionDef, chain) | None."""
+        if isinstance(func_node, ast.Name):
+            local = self.local_defs().get(func_node.id)
+            if local is not None:
+                return self.module, local, self.chain
+            top = self.top.get(func_node.id)
+            if top is not None:
+                return self.module, top, []
+            bound = self.imports.get(func_node.id)
+            if bound is not None and bound[0] == "symbol":
+                mod, sym = bound[1], bound[2]
+                fn = self.project.top_level_functions(mod).get(sym)
+                if fn is not None:
+                    return mod, fn, []
+        elif isinstance(func_node, ast.Attribute) and isinstance(func_node.value, ast.Name):
+            bound = self.imports.get(func_node.value.id)
+            if bound is not None and bound[0] == "module":
+                fn = self.project.top_level_functions(bound[1]).get(func_node.attr)
+                if fn is not None:
+                    return bound[1], fn, []
+        return None
+
+
+def _find_entries(project):
+    """Yield (module, FunctionDef, chain, traced_param_names)."""
+    for mod in project.modules.values():
+        parents: dict = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def chain_of(fn):
+            chain, cur = [], parents.get(fn)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    chain.append(cur)
+                cur = parents.get(cur)
+            return list(reversed(chain))
+
+        # decorator entries
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                static = set()
+                name = dotted(dec)
+                if isinstance(dec, ast.Call):
+                    inner = dotted(dec.func)
+                    if inner in _PARTIAL_NAMES and dec.args and dotted(dec.args[0]) in _JIT_NAMES:
+                        for kw in dec.keywords:
+                            if kw.arg in ("static_argnames", "static_argnums"):
+                                static |= _const_names(kw.value)
+                        name = "jax.jit"
+                    elif inner in _JIT_NAMES:
+                        for kw in dec.keywords:
+                            if kw.arg in ("static_argnames", "static_argnums"):
+                                static |= _const_names(kw.value)
+                        name = "jax.jit"
+                if name in _JIT_NAMES:
+                    params = _param_names(node)
+                    resolved_static = _resolve_static(static, params)
+                    traced = [p for p in params if p not in resolved_static]
+                    yield mod, node, chain_of(node), traced
+
+        # call-site entries: jax.jit(f), jax.jit(partial(f, ...)), pallas_call(f, ...)
+        env_cache: dict = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name not in _JIT_NAMES and name not in _PALLAS_NAMES:
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            static = set()
+            for kw in node.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    static |= _const_names(kw.value)
+            bound_kwargs = set()
+            if isinstance(target, ast.Call) and dotted(target.func) in _PARTIAL_NAMES:
+                bound_kwargs = {kw.arg for kw in target.keywords if kw.arg}
+                target = target.args[0] if target.args else None
+            if target is None:
+                continue
+            holder = parents.get(node)
+            while holder is not None and not isinstance(
+                holder, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                holder = parents.get(holder)
+            key = id(holder)
+            if key not in env_cache:
+                chain = (chain_of(holder) + [holder]) if holder is not None else []
+                env_cache[key] = _FunctionEnv(project, mod, chain)
+            resolved = env_cache[key].resolve(target)
+            if resolved is None:
+                continue
+            tmod, fn, fchain = resolved
+            params = _param_names(fn)
+            resolved_static = _resolve_static(static, params) | bound_kwargs
+            traced = [p for p in params if p not in resolved_static]
+            yield tmod, fn, fchain, traced
+
+
+class _TaintVisitor:
+    """One traced function body: propagate taint, emit findings, collect
+    call edges into other package-local functions."""
+
+    def __init__(self, project, module, fn, chain, traced, free_tainted):
+        self.project = project
+        self.module = module
+        self.fn = fn
+        self.env = _FunctionEnv(project, module, chain + [fn])
+        self.tainted = set(traced) | set(free_tainted)
+        self.findings: list = []
+        self.edges: list = []  # (module, fn, chain, traced_params, free_tainted)
+        self._seen_lines: set = set()
+
+    # -- taint ------------------------------------------------------------
+    def is_tainted(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            base = fname.split(".")[0]
+            if base in _HOST_RESULT_FUNCS or fname in _CAST_FUNCS:
+                return False
+            if fname.endswith(".item"):
+                return False
+            if base in _NUMPY_ALIASES and fname.split(".")[-1] in ("asarray", "array"):
+                return False  # host pull: flagged, result is host-side
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Attribute) and self.is_tainted(node.func.value):
+                return True  # method on a traced value
+            return any(self.is_tainted(a) for a in args)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity/membership tests resolve to host bools at trace time
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+                return False
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_tainted(v) for v in node.values if v is not None)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.is_tainted(node.elt) or any(
+                self.is_tainted(g.iter) for g in node.generators
+            )
+        if isinstance(node, ast.DictComp):
+            return (
+                self.is_tainted(node.key)
+                or self.is_tainted(node.value)
+                or any(self.is_tainted(g.iter) for g in node.generators)
+            )
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        return False
+
+    def _bind_targets(self, target, tainted: bool):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_targets(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind_targets(target.value, tainted)
+        # attribute/subscript stores don't rebind names
+
+    def propagate(self, emit: bool):
+        self._emit = emit
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs analyzed when referenced/called
+        if isinstance(node, ast.Assign):
+            t = self.is_tainted(node.value)
+            self._expr(node.value)
+            for target in node.targets:
+                self._bind_targets(target, t)
+            # comprehension loop vars over traced iterables (e.g. dict
+            # .items() of the traced arg dict) taint their element names
+            return
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            t = self.is_tainted(node.value)
+            self._expr(node.value)
+            self._bind_targets(node.target, t)
+            return
+        if isinstance(node, ast.AugAssign):
+            t = self.is_tainted(node.value) or self.is_tainted(node.target)
+            self._expr(node.value)
+            self._bind_targets(node.target, t)
+            return
+        if isinstance(node, ast.If):
+            self._branch_check(node.test, "if")
+            self._expr(node.test)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.While):
+            self._branch_check(node.test, "while")
+            self._expr(node.test)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Assert):
+            self._branch_check(node.test, "assert")
+            self._expr(node.test)
+            return
+        if isinstance(node, ast.For):
+            self._expr(node.iter)
+            self._bind_targets(node.target, self.is_tainted(node.iter))
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind_targets(
+                        item.optional_vars, self.is_tainted(item.context_expr)
+                    )
+            for s in node.body:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in node.body + node.orelse + node.finalbody:
+                self._stmt(s)
+            for handler in node.handlers:
+                for s in handler.body:
+                    self._stmt(s)
+            return
+        if hasattr(ast, "Match") and isinstance(node, ast.Match):
+            self._branch_check(node.subject, "match")
+            self._expr(node.subject)
+            for case in node.cases:
+                for s in case.body:
+                    self._stmt(s)
+            return
+        if isinstance(node, ast.Return) and node.value is not None:
+            self._expr(node.value)
+            return
+        if isinstance(node, ast.Expr):
+            self._expr(node.value)
+            return
+        if isinstance(node, ast.Global):
+            self._flag(
+                "GL103",
+                node.lineno,
+                f"`global {', '.join(node.names)}` inside jit-reachable "
+                f"`{self.fn.name}` is a trace-time side effect",
+            )
+            return
+        # default: walk any embedded expressions
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    # -- checks -----------------------------------------------------------
+    def _branch_flaggable(self, test) -> bool:
+        if isinstance(test, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in test.ops):
+                return False  # structure checks run host-side at trace time
+            return self.is_tainted(test)
+        if isinstance(test, ast.Call) and dotted(test.func) in ("isinstance", "hasattr", "callable"):
+            return False
+        if isinstance(test, ast.BoolOp):
+            return any(self._branch_flaggable(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._branch_flaggable(test.operand)
+        return self.is_tainted(test)
+
+    def _branch_check(self, test, kind: str):
+        if self._branch_flaggable(test):
+            self._flag(
+                "GL102",
+                test.lineno,
+                f"Python `{kind}` on a traced value inside jit-reachable "
+                f"`{self.fn.name}` (TracerBoolConversionError or a "
+                "concretization leak)",
+            )
+
+    def _flag(self, rule, line, message):
+        if not self._emit:
+            return
+        key = (rule, line)
+        if key in self._seen_lines:
+            return
+        self._seen_lines.add(key)
+        self.findings.append(Finding(self.module.path, line, rule, message))
+
+    def _expr(self, node):
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            self._call(call)
+        if self._emit:
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and sub.attr == "environ"
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "os"
+                ):
+                    self._flag(
+                        "GL103",
+                        sub.lineno,
+                        f"os.environ read inside jit-reachable `{self.fn.name}` "
+                        "freezes at trace time; resolve it host-side and pass "
+                        "the value in",
+                    )
+                if isinstance(sub, ast.IfExp):
+                    self._branch_check(sub.test, "conditional expression")
+
+    def _call(self, node):
+        fname = dotted(node.func)
+        base = fname.split(".")[0]
+        args = list(node.args) + [kw.value for kw in node.keywords]
+
+        # GL101 host syncs
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            if self.is_tainted(node.func.value):
+                self._flag(
+                    "GL101",
+                    node.lineno,
+                    f"`.item()` on a traced value inside jit-reachable "
+                    f"`{self.fn.name}` forces a device->host sync",
+                )
+        elif fname in _CAST_FUNCS and any(self.is_tainted(a) for a in args):
+            self._flag(
+                "GL101",
+                node.lineno,
+                f"`{fname}()` on a traced value inside jit-reachable "
+                f"`{self.fn.name}` forces concretization",
+            )
+        elif (
+            base in _NUMPY_ALIASES
+            and fname.split(".")[-1] in ("asarray", "array")
+            and any(self.is_tainted(a) for a in args)
+        ):
+            self._flag(
+                "GL101",
+                node.lineno,
+                f"`{fname}()` on a traced value inside jit-reachable "
+                f"`{self.fn.name}` pulls the array to host",
+            )
+
+        # GL103 side effects
+        if fname == "print":
+            self._flag(
+                "GL103",
+                node.lineno,
+                f"`print()` inside jit-reachable `{self.fn.name}` runs once "
+                "at trace time (use jax.debug.print for runtime values)",
+            )
+        elif base == "logging" or fname in ("os.getenv",):
+            self._flag(
+                "GL103",
+                node.lineno,
+                f"`{fname}()` inside jit-reachable `{self.fn.name}` is a "
+                "trace-time side effect",
+            )
+
+        # call edges into package-local functions
+        resolved = self.env.resolve(node.func)
+        if resolved is not None:
+            tmod, fn, fchain = resolved
+            params = _param_names(fn)
+            traced = set()
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred):
+                    if self.is_tainted(a.value):
+                        traced |= set(params[i:])
+                    break
+                if i < len(params) and self.is_tainted(a):
+                    traced.add(params[i])
+            for kw in node.keywords:
+                if kw.arg is not None and kw.arg in params and self.is_tainted(kw.value):
+                    traced.add(kw.arg)
+                elif kw.arg is None and self.is_tainted(kw.value):
+                    traced |= set(params)  # **kwargs splat of traced dict
+            free = set()
+            if tmod is self.module and fchain:
+                # nested sibling: free variables of the enclosing chain
+                free = self.tainted & _free_names(fn)
+            self.edges.append((tmod, fn, fchain, frozenset(traced), frozenset(free)))
+        # package-local function VALUES handed to combinators
+        # (jax.vmap(f), lax.scan(f, ...), pallas_call(f, ...)): fully traced
+        for a in node.args:
+            if isinstance(a, (ast.Name, ast.Attribute)) and a is not node.func:
+                r = self.env.resolve(a)
+                if r is not None:
+                    tmod, fn, fchain = r
+                    free = self.tainted & _free_names(fn) if tmod is self.module else set()
+                    self.edges.append(
+                        (tmod, fn, fchain, frozenset(_param_names(fn)), frozenset(free))
+                    )
+
+
+def _free_names(fn) -> set:
+    bound = set(_param_names(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    loads = {
+        n.id
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+    return loads - bound
+
+
+def _analyze_traced(project, module, fn, chain, traced, free_tainted):
+    v = _TaintVisitor(project, module, fn, chain, traced, free_tainted)
+    # taint fixpoint (loop-carried rebinds), then one emitting pass
+    for _ in range(4):
+        before = set(v.tainted)
+        v.propagate(emit=False)
+        if v.tainted == before:
+            break
+    v.edges = []
+    v._seen_lines = set()
+    v.propagate(emit=True)
+    return v.findings, v.edges
+
+
+def check_tracing(project) -> list:
+    findings: list = []
+    seen: set = set()
+    work = [
+        (mod, fn, chain, frozenset(traced), frozenset())
+        for mod, fn, chain, traced in _find_entries(project)
+    ]
+    while work:
+        mod, fn, chain, traced, free = work.pop()
+        key = (mod.name, fn.lineno, fn.name, traced, free)
+        if key in seen:
+            continue
+        seen.add(key)
+        f, edges = _analyze_traced(project, mod, fn, chain, list(traced), list(free))
+        findings.extend(f)
+        for tmod, tfn, tchain, ttraced, tfree in edges:
+            work.append((tmod, tfn, tchain, ttraced, tfree))
+
+    # GL104: jit/pallas_call wrappers built inside loops — everywhere,
+    # traced or not (the storm is a host-side structure bug)
+    for mod in project.modules.values():
+        loop_stack: list = []
+
+        def visit(node):
+            is_loop = isinstance(node, (ast.For, ast.While))
+            if is_loop:
+                loop_stack.append(node)
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if (name in _JIT_NAMES or name in _PALLAS_NAMES) and loop_stack:
+                    findings.append(
+                        Finding(
+                            mod.path,
+                            node.lineno,
+                            "GL104",
+                            f"`{name}(...)` constructed inside a loop builds a "
+                            "fresh wrapper (and recompiles) every iteration; "
+                            "hoist it and cache the compiled callable",
+                        )
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_loop:
+                loop_stack.pop()
+
+        visit(mod.tree)
+    return findings

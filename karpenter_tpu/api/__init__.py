@@ -16,3 +16,11 @@ from karpenter_tpu.api.nodepool import (  # noqa: F401
     NodePoolSpec,
     NodePoolStatus,
 )
+
+__all__ = [
+    "labels",
+    "Node", "ObjectMeta", "Pod", "PodDisruptionBudget", "Taint",
+    "Toleration", "TopologySpreadConstraint",
+    "NodeClaim", "NodeClaimSpec", "NodeClaimStatus",
+    "Budget", "Disruption", "NodePool", "NodePoolSpec", "NodePoolStatus",
+]

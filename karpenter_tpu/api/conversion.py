@@ -46,7 +46,9 @@ KUBELET_COMPAT_ANNOTATION = "compatibility.karpenter.sh/v1beta1-kubelet-conversi
 _POLICY_TO_V1 = {"WhenUnderutilized": "WhenEmptyOrUnderutilized"}
 _POLICY_FROM_V1 = {v: k for k, v in _POLICY_TO_V1.items()}
 
-_DUR = re.compile(r"(\d+(?:\.\d+)?)(h|m|s|ms)")
+# "ms" must precede "m" in the alternation or the regex engine commits to
+# the minutes unit and strands the trailing "s" ("500ms" read as "500m"+"s")
+_DUR = re.compile(r"(\d+(?:\.\d+)?)(ms|h|m|s)")
 _UNIT_SECONDS = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
 
 
@@ -72,18 +74,25 @@ def parse_duration(s) -> float | None:
 
 
 def format_duration(seconds: float | None) -> str:
-    """Seconds → canonical wire string; None → "Never"."""
+    """Seconds → canonical wire string; None → "Never". Negative inputs
+    clamp to "0s": the grammar has no sign, so an unclamped encode would
+    emit a wire string ("-1h58m30s") that parse_duration rejects — encode
+    must never produce an unparseable document."""
     if seconds is None:
         return "Never"
-    s = float(seconds)
+    # round to the wire resolution FIRST so the residual carries into the
+    # coarser units ("1000ms" must canonicalize to "1s", and a
+    # sub-half-millisecond residual must vanish rather than render "0ms",
+    # which the parse grammar rejects as "0m" + a dangling "s")
+    total_ms = int(round(max(float(seconds), 0.0) * 1000))
+    s, ms = divmod(total_ms, 1000)
     out = []
-    for unit, width in (("h", 3600.0), ("m", 60.0), ("s", 1.0)):
-        n = int(s // width)
+    for unit, width in (("h", 3600), ("m", 60), ("s", 1)):
+        n, s = divmod(s, width)
         if n:
             out.append(f"{n}{unit}")
-            s -= n * width
-    if s > 1e-9:
-        out.append(f"{int(round(s * 1000))}ms")
+    if ms:
+        out.append(f"{ms}ms")
     return "".join(out) or "0s"
 
 

@@ -14,3 +14,11 @@ from karpenter_tpu.cloudprovider.types import (  # noqa: F401
     satisfies_min_values,
     truncate_instance_types,
 )
+
+__all__ = [
+    "CloudProvider", "InstanceType", "InstanceTypeOverhead", "Offering",
+    "Offerings", "InsufficientCapacityError", "NodeClaimNotFoundError",
+    "NodeClassNotReadyError", "order_by_price", "compatible_instance_types",
+    "filter_instance_types", "instance_type_compatible",
+    "satisfies_min_values", "truncate_instance_types",
+]

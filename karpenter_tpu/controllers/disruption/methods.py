@@ -202,25 +202,41 @@ def filter_out_same_type(replacement, candidates) -> list:
     options include a type we are deleting, drop every option that is not
     strictly cheaper than the cheapest such overlapping node — otherwise the
     "consolidation" would relaunch one of its own victims, which is just a
-    delete with extra churn."""
+    delete with extra churn.
+
+    A same-type candidate with UNKNOWN price (delisted offering, price <= 0)
+    cannot anchor the strictly-cheaper comparison, so its type is removed
+    from the options outright (ADVICE.md round 5): we cannot prove a relaunch
+    of that type is cheaper than the node we are deleting, and the
+    conservative stance is to never buy what we can't price — the command
+    degrades toward delete-only rather than risking a same-cost relaunch."""
     existing_prices: dict = {}
+    unknown_types: set = set()
     for c in candidates:
         if c.instance_type is None:
             continue
         p = c.price
         if p <= 0:
-            continue  # delisted offering: price unknown, can't anchor the filter
+            unknown_types.add(c.instance_type.name)
+            continue
         prev = existing_prices.get(c.instance_type.name)
         if prev is None or p < prev:
             existing_prices[c.instance_type.name] = p
+    # a type is unpriceable only when NO candidate of it has a known price:
+    # a mixed type (one delisted node, one priced node) keeps both its
+    # anchor and its option slot — the priced node bounds the comparison
+    unknown_types -= set(existing_prices)
+    options = [
+        it for it in replacement.instance_types if it.name not in unknown_types
+    ]
     max_price = float("inf")
     for it in replacement.instance_types:
         if it.name in existing_prices:
             max_price = min(max_price, existing_prices[it.name])
     if max_price == float("inf"):
-        return list(replacement.instance_types)
+        return options
     kept = []
-    for it in replacement.instance_types:
+    for it in options:
         ofs = it.offerings.available().compatible(replacement.requirements)
         if ofs and min(o.price for o in ofs) < max_price:
             kept.append(it)
@@ -291,6 +307,25 @@ class MultiNodeConsolidation(Method):
                 self.ctx.provisioner, self.ctx.cluster, self.ctx.store, cands
             )
         except Exception:
+            # falling back to the sequential search is by design (the probe
+            # is a prefilter), but the reason must stay diagnosable — a
+            # permanently-failing probe silently costs every consolidation
+            # round its batched dispatch. The counter makes it visible on
+            # the scrape; the WARNING carries the traceback (stdlib logging
+            # is never configured here, and only WARNING+ reaches the
+            # lastResort stderr handler — the models/solver.py precedent)
+            import logging
+
+            from karpenter_tpu.operator import metrics as m
+
+            self.ctx.registry.counter(
+                m.DISRUPTION_PROBE_FAILURES,
+                "device consolidation probes that fell back to the "
+                "sequential search",
+            ).inc(method="multi")
+            logging.getLogger(__name__).warning(
+                "device consolidation probe failed; using sequential "
+                "binary search", exc_info=True)
             return None
 
     def _confirm(self, prefix):

@@ -8,3 +8,9 @@ from karpenter_tpu.models.solver import (  # noqa: F401
     TPUSolver,
     make_solver,
 )
+
+__all__ = [
+    "ClaimTemplate", "InFlightNodeClaim", "SchedulingQueue", "Scheduler",
+    "SchedulerResults", "HostSolver", "NativeSolver", "Solver", "TPUSolver",
+    "make_solver",
+]

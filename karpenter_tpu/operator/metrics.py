@@ -191,11 +191,13 @@ DISRUPTION_ELIGIBLE_NODES = f"{NAMESPACE}_disruption_eligible_nodes"
 DISRUPTION_PODS = f"{NAMESPACE}_disruption_pods_disrupted_total"
 DISRUPTION_BUDGETS = f"{NAMESPACE}_disruption_allowed_disruptions"
 CONSOLIDATION_TIMEOUTS = f"{NAMESPACE}_disruption_consolidation_timeouts_total"
+DISRUPTION_PROBE_FAILURES = f"{NAMESPACE}_disruption_probe_failures_total"
 DISRUPTION_ABNORMAL_RUNS = f"{NAMESPACE}_disruption_abnormal_runs_total"
 NODECLAIMS_DISRUPTED = f"{NAMESPACE}_nodeclaims_disrupted_total"
 CLUSTER_STATE_SYNCED = f"{NAMESPACE}_cluster_state_synced"
 CLOUDPROVIDER_DURATION = f"{NAMESPACE}_cloudprovider_duration_seconds"
 CLOUDPROVIDER_ERRORS = f"{NAMESPACE}_cloudprovider_errors_total"
+SOLVER_REMOTE_FALLBACKS = f"{NAMESPACE}_solver_remote_fallbacks_total"
 PODS_STATE = f"{NAMESPACE}_pods_state"
 PODS_STARTUP_DURATION = f"{NAMESPACE}_pods_startup_duration_seconds"
 NODES_CREATED = f"{NAMESPACE}_nodes_created_total"

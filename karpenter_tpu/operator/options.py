@@ -60,6 +60,10 @@ class Options:
     kube_client_burst: int = 300
     # service ports
     metrics_port: int = 8000
+    # metrics bind address: "" = all interfaces (a container's Prometheus
+    # scrape arrives on the pod IP); set KARPENTER_METRICS_BIND=127.0.0.1
+    # for local-only exposure — the mirror of the solver service's --host
+    metrics_bind_addr: str = ""
     health_probe_port: int = 8081
     # observability
     log_level: str = "info"
@@ -75,6 +79,7 @@ class Options:
             kube_client_qps=_env("KUBE_CLIENT_QPS", 200.0, float),
             kube_client_burst=_env("KUBE_CLIENT_BURST", 300, int),
             metrics_port=_env("METRICS_PORT", 8000, int),
+            metrics_bind_addr=_env("METRICS_BIND", ""),
             health_probe_port=_env("HEALTH_PROBE_PORT", 8081, int),
             log_level=_env("LOG_LEVEL", "info"),
             enable_profiling=_env("ENABLE_PROFILING", False, bool),

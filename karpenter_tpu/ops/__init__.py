@@ -1,1 +1,3 @@
 from karpenter_tpu.ops.tensorize import DeviceSnapshot, tensorize  # noqa: F401
+
+__all__ = ["DeviceSnapshot", "tensorize"]

@@ -3,3 +3,5 @@ from karpenter_tpu.parallel.mesh import (  # noqa: F401
     make_multihost_mesh,
     sharded_solve,
 )
+
+__all__ = ["make_mesh", "make_multihost_mesh", "sharded_solve"]

@@ -32,3 +32,12 @@ def daemon_schedulable(template_pod, taints, requirements, allow_undefined=None)
         )
         is None
     )
+
+__all__ = [
+    "IN", "NOT_IN", "EXISTS", "DOES_NOT_EXIST", "GT", "LT",
+    "Requirement", "Requirements", "pod_requirements",
+    "strict_pod_requirements", "label_requirements",
+    "node_selector_requirements", "has_preferred_node_affinity",
+    "Taints", "KNOWN_EPHEMERAL_TAINTS", "HostPortUsage", "VolumeUsage",
+    "daemon_schedulable",
+]

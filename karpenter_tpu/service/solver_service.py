@@ -112,19 +112,48 @@ from karpenter_tpu.models.solver import TPUSolver  # noqa: E402 (jax stays lazy)
 class RemoteSolver(TPUSolver):
     """Drop-in Solver whose kernel dispatch crosses the gRPC boundary:
     tensorize/decode/validation stay host-side, exactly one round trip per
-    solve (the in-process `_invoke` seam, served remotely)."""
+    solve (the in-process `_invoke` seam, served remotely).
 
-    def __init__(self, target: str):
+    Fallbacks are an operational signal, not just a log line: every
+    in-process rescue increments `karpenter_solver_remote_fallbacks_total`
+    (labeled by gRPC status code) in the injected registry and emits a
+    structured warn on the logging plane — a dead device plane shows up on
+    the scrape and in grep, not only in throughput."""
+
+    def __init__(self, target: str, registry=None, log=None):
         import grpc
 
+        from karpenter_tpu.operator import metrics as _metrics
+        from karpenter_tpu.operator.logging import make_logger
+
         super().__init__()
+        self._target = target
+        self._registry = registry if registry is not None else _metrics.REGISTRY
+        self._log = (log if log is not None else make_logger()).with_values(
+            component="remote_solver", target=target
+        )
         self._channel = grpc.insecure_channel(target, options=_GRPC_OPTS)
         self._call = self._channel.unary_unary(
             _METHOD, request_serializer=None, response_deserializer=None
         )
 
+    def bind_observability(self, registry=None, log=None):
+        """Re-home the fallback counter/log onto an Environment's registry
+        and logging plane. The operator builds the solver BEFORE the
+        Environment (the solver is a constructor arg), so __main__ binds
+        here afterwards — otherwise fallbacks would count in the global
+        registry, which serve_metrics never exposes."""
+        if registry is not None:
+            self._registry = registry
+        if log is not None:
+            self._log = log.with_values(
+                component="remote_solver", target=self._target
+            )
+
     def _invoke(self, args, key, max_bins):
         import grpc
+
+        from karpenter_tpu.operator import metrics as _metrics
 
         meta = {"max_bins": int(max_bins), "level_bits": int(key[-2]),
                 "max_minv": int(key[-1])}
@@ -134,11 +163,16 @@ class RemoteSolver(TPUSolver):
             # device plane unreachable: solve in-process rather than
             # failing the provisioning round (the Solver seam's fallback
             # stance — same philosophy as the engine ladder in bench.py)
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "solver service unavailable (%s); solving in-process",
-                getattr(e, "code", lambda: e)())
+            try:
+                code = str(e.code())
+            except Exception:
+                code = "UNKNOWN"
+            self._registry.counter(
+                _metrics.SOLVER_REMOTE_FALLBACKS,
+                "RemoteSolver dispatches rescued by the in-process kernel",
+            ).inc(code=code)
+            self._log.warn("solver service unavailable; solving in-process",
+                           code=code)
             return super()._invoke(args, key, max_bins)
         self._last_engine = "remote"
         arrays, _ = _unpack(blob)

@@ -150,6 +150,59 @@ class TestFilterOutSameType:
         kept = filter_out_same_type(replacement, cands)
         assert [it.name for it in kept] == ["nano"]
 
+    def test_unknown_price_candidate_drops_its_type(self):
+        """A same-type candidate whose price is unknown (<= 0, delisted
+        offering) cannot anchor the strictly-cheaper comparison — its type
+        leaves the option pool outright instead of surviving by default,
+        so an unpriceable node is never relaunched (ADVICE round 5)."""
+        small = make_instance_type("small", 2, 8)
+        nano = make_instance_type("nano", 1, 2)
+        cands = [
+            stub_candidate(0, instance_type=small, price=0.0),  # unknown
+            stub_candidate(1, instance_type=nano,
+                           price=min(o.price for o in nano.offerings)),
+        ]
+        replacement = SimpleNamespace(
+            instance_types=[small, nano], requirements=Requirements()
+        )
+        kept = filter_out_same_type(replacement, cands)
+        # small is gone (unpriceable same-type), and nano anchors the
+        # strictly-cheaper filter against itself -> nothing survives:
+        # the command degrades toward delete-only
+        assert kept == []
+
+    def test_mixed_known_and_unknown_price_keeps_the_anchor(self):
+        """A type with BOTH a delisted and a priced candidate is not
+        unpriceable: the priced node still anchors the strictly-cheaper
+        comparison, so a pricier non-overlapping option cannot sneak
+        through (the filter's whole purpose)."""
+        small = make_instance_type("small", 2, 8)
+        large = make_instance_type("large", 16, 64)
+        cheap = 0.001
+        cands = [
+            stub_candidate(0, instance_type=small, price=0.0),  # delisted
+            stub_candidate(1, instance_type=small, price=cheap),
+        ]
+        replacement = SimpleNamespace(
+            instance_types=[small, large], requirements=Requirements()
+        )
+        kept = filter_out_same_type(replacement, cands)
+        # anchored at 0.001: neither small (same type, not cheaper) nor
+        # large (far pricier) survives -> delete-only
+        assert kept == []
+
+    def test_unknown_price_only_overlap_degrades_to_delete_only(self):
+        """When the ONLY overlap is the unpriceable type, the remaining
+        (non-overlapping) options survive untouched."""
+        small = make_instance_type("small", 2, 8)
+        nano = make_instance_type("nano", 1, 2)
+        cands = [stub_candidate(0, instance_type=small, price=-1.0)]
+        replacement = SimpleNamespace(
+            instance_types=[small, nano], requirements=Requirements()
+        )
+        kept = filter_out_same_type(replacement, cands)
+        assert [it.name for it in kept] == ["nano"]
+
     def test_no_overlap_keeps_everything(self):
         small = make_instance_type("small", 2, 8)
         nano = make_instance_type("nano", 1, 2)

@@ -40,6 +40,39 @@ class TestDurations:
         with pytest.raises(ConversionError):
             parse_duration("3 hours")
 
+    def test_millisecond_carry_is_canonical(self):
+        """Rounding happens before decomposition: a residual that rounds
+        to a full second carries into the coarser unit instead of emitting
+        the non-canonical "1000ms" (string-compare consumers must see one
+        spelling per duration)."""
+        assert format_duration(0.99975) == "1s"
+        assert format_duration(3599.9996) == "1h"
+        assert format_duration(59.9999) == "1m"
+
+    def test_negative_clamps_to_zero(self):
+        """Encode must never emit a wire string parse_duration rejects:
+        the duration grammar has no sign, so negatives clamp to "0s"."""
+        assert format_duration(-90.0) == "0s"
+        assert format_duration(-0.001) == "0s"
+        assert parse_duration(format_duration(-7230.5)) == 0.0
+
+    def test_encode_parse_round_trip_property(self):
+        """Property: for ANY float input, format_duration emits a string
+        parse_duration accepts, and the round trip recovers max(x, 0) to
+        millisecond precision (the wire format's resolution)."""
+        import random
+
+        rng = random.Random(0xC0FFEE)
+        samples = [0.0, 0.0005, 1e-12, 59.999, 3599.999, -1.0, -1e9]
+        samples += [rng.uniform(-1e5, 1e6) for _ in range(200)]
+        samples += [rng.expovariate(1e-4) for _ in range(100)]
+        for x in samples:
+            wire = format_duration(x)
+            back = parse_duration(wire)
+            assert back is not None
+            assert back >= 0.0
+            assert abs(back - max(x, 0.0)) <= 5e-4 + 1e-9 * abs(x), (x, wire)
+
 
 V1BETA1_NODEPOOL = {
     "apiVersion": V1BETA1,

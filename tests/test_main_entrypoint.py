@@ -60,6 +60,27 @@ class TestOperatorMain:
         finally:
             server.shutdown()
 
+    def test_metrics_bind_address_override(self, monkeypatch):
+        """KARPENTER_METRICS_BIND narrows the listener (deploy/README.md
+        network exposure): loopback-bound serving still answers on
+        127.0.0.1, and the option plumbs through Options.from_env."""
+        from karpenter_tpu.__main__ import serve_metrics
+        from karpenter_tpu.operator.metrics import Registry
+        from karpenter_tpu.operator.options import Options
+
+        monkeypatch.setenv("KARPENTER_METRICS_BIND", "127.0.0.1")
+        opts = Options.from_env()
+        assert opts.metrics_bind_addr == "127.0.0.1"
+
+        server = serve_metrics(Registry(), 18766, host=opts.metrics_bind_addr)
+        try:
+            assert server.server_address[0] == "127.0.0.1"
+            health = urllib.request.urlopen(
+                "http://127.0.0.1:18766/healthz", timeout=5).read().decode()
+            assert health == "ok"
+        finally:
+            server.shutdown()
+
     def test_unknown_kind_rejected(self, tmp_path):
         from karpenter_tpu.__main__ import load_manifest
         from karpenter_tpu.operator import Environment

@@ -1,0 +1,649 @@
+"""graftlint (karpenter_tpu/analysis): rule-family unit tests + the tier-1
+gate.
+
+Each rule family is exercised against seeded positive fixtures (the
+analyzer MUST flag them) and negative fixtures (it must stay quiet),
+including real-code fixtures for the lock-discipline rules: the actual
+kube/store.py and operator/metrics.py sources must come back clean, and
+deliberately-raced variants of each — the lock textually stripped from one
+mutating method — must be flagged. The final class runs the analyzer over
+the whole installed package and asserts zero unsuppressed findings, which
+is what makes the pass a permanent gate: any future tracer leak, unguarded
+mutation, or export drift fails tier-1 before it costs a bench run.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+import karpenter_tpu
+from karpenter_tpu.analysis import (
+    RULES,
+    analyze_paths,
+    analyze_sources,
+)
+from karpenter_tpu.analysis.__main__ import main as cli_main
+
+PKG_DIR = os.path.dirname(os.path.abspath(karpenter_tpu.__file__))
+
+
+def rules_of(findings) -> list:
+    return [f.rule for f in findings]
+
+
+def read_pkg(relpath: str) -> str:
+    with open(os.path.join(PKG_DIR, relpath), encoding="utf-8") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# GL1xx tracing safety
+# ---------------------------------------------------------------------------
+
+class TestTracingRules:
+    def test_positive_branch_and_host_sync(self):
+        """if-on-tracer, float(), .item(), and print inside a jitted
+        function are each flagged exactly once."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "\n"
+            "def kernel(x, n):\n"
+            "    if x > 0:\n"
+            "        x = x + 1\n"
+            "    v = float(x)\n"
+            "    y = x.sum().item()\n"
+            "    print('trace-time', v)\n"
+            "    return x * y\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL102", "GL101", "GL101", "GL103"]
+
+    def test_positive_cross_module_reachability(self):
+        """Taint follows a call edge into another module: the jit entry
+        lives in a, the branch-on-tracer in b."""
+        findings, _ = analyze_sources({
+            "pkg.a": (
+                "import jax\n"
+                "from pkg.b import helper\n"
+                "\n"
+                "def entry(x):\n"
+                "    return helper(x, 3)\n"
+                "\n"
+                "fn = jax.jit(entry)\n"
+            ),
+            "pkg.b": (
+                "def helper(t, k):\n"
+                "    if t.sum() > k:\n"
+                "        return t\n"
+                "    return t * 2\n"
+            ),
+        })
+        assert rules_of(findings) == ["GL102"]
+        assert findings[0].path.endswith("pkg/b.py")
+
+    def test_positive_env_read_and_jit_in_loop(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "import os\n"
+            "\n"
+            "def kernel(x):\n"
+            "    if os.environ.get('MODE') == 'fast':\n"
+            "        return x\n"
+            "    return x + 1\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+            "\n"
+            "def build(fns):\n"
+            "    out = []\n"
+            "    for f in fns:\n"
+            "        out.append(jax.jit(f))\n"
+            "    return out\n"
+        )})
+        assert sorted(rules_of(findings)) == ["GL103", "GL104"]
+
+    def test_positive_traced_branch_in_try_else(self):
+        """try/else bodies are walked too — a traced branch hiding in the
+        else block must not slip past the gate."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "\n"
+            "def kernel(x):\n"
+            "    try:\n"
+            "        y = x + 1\n"
+            "    except ValueError:\n"
+            "        y = x\n"
+            "    else:\n"
+            "        if x > 0:\n"
+            "            y = y * 2\n"
+            "    return y\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL102"]
+
+    def test_negative_static_args_and_structure_checks(self):
+        """static_argnames params, shape-derived ints, `is None` guards,
+        and dict-membership tests never flag — the exact idioms the real
+        kernels use (ops/kernels.py solve_step)."""
+        findings, _ = analyze_sources({"fx": (
+            "import functools\n"
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "\n"
+            "@functools.partial(jax.jit, static_argnames=('flag',))\n"
+            "def kernel(args, y=None, *, flag=False):\n"
+            "    if 'bias' not in args:\n"
+            "        args = dict(args)\n"
+            "        args['bias'] = 0.0\n"
+            "    x = args['x']\n"
+            "    if y is None:\n"
+            "        y = jnp.zeros_like(x)\n"
+            "    n, k = x.shape\n"
+            "    if flag and n > 3:\n"
+            "        return x + y\n"
+            "    for i in range(k):\n"
+            "        y = y + x[:, i].sum()\n"
+            "    return y\n"
+        )})
+        assert findings == []
+
+    def test_negative_host_code_not_reachable_from_jit(self):
+        """float()/branching/env reads are fine in plain host functions —
+        reachability, not text matching, drives the family."""
+        findings, _ = analyze_sources({"fx": (
+            "import os\n"
+            "\n"
+            "def routing_cutoff():\n"
+            "    return int(os.environ.get('CUTOFF', 192))\n"
+            "\n"
+            "def host_decode(arr):\n"
+            "    total = float(arr.sum())\n"
+            "    if total > 0:\n"
+            "        return total\n"
+            "    return 0.0\n"
+        )})
+        assert findings == []
+
+    def test_negative_integer_static_argnums(self):
+        """static_argnums (positional form) maps to parameter names:
+        branching on an int-indexed static arg is legal."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "\n"
+            "def kernel(n, x):\n"
+            "    if n > 3:\n"
+            "        return x * n\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel, static_argnums=(0,))\n"
+        )})
+        assert findings == []
+
+    def test_negative_partial_bound_statics(self):
+        """functools.partial-bound kwargs at the jit call site are static:
+        branching on them inside the callee is legal (parallel/mesh.py's
+        _jitted_solve_step pattern)."""
+        findings, _ = analyze_sources({"fx": (
+            "import functools\n"
+            "import jax\n"
+            "\n"
+            "def solve(args, mode=0):\n"
+            "    if mode > 1:\n"
+            "        return args['x'] * 2\n"
+            "    return args['x']\n"
+            "\n"
+            "fn = jax.jit(functools.partial(solve, mode=3))\n"
+        )})
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# GL2xx lock discipline
+# ---------------------------------------------------------------------------
+
+LOCKED_CLASS = (
+    "import threading\n"
+    "\n"
+    "class Store:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._items = {}\n"
+    "\n"
+    "    def put(self, k, v):\n"
+    "        with self._lock:\n"
+    "            self._items[k] = v\n"
+    "\n"
+    "    def @NAME@(self, k):\n"
+    "@BODY@"
+    "\n"
+    "    def read(self, k):\n"
+    "        return self._items.get(k)\n"
+)
+
+
+def locked_class(name: str, body: str) -> str:
+    return LOCKED_CLASS.replace("@NAME@", name).replace("@BODY@", body)
+
+
+class TestLockRules:
+    def test_positive_unguarded_mutation(self):
+        src = locked_class("racy", "        self._items.pop(k, None)\n")
+        findings, _ = analyze_sources({"fx": src})
+        assert rules_of(findings) == ["GL201"]
+        assert "racy" in findings[0].message
+
+    def test_positive_self_deadlock_on_plain_lock(self):
+        findings, _ = analyze_sources({"fx": (
+            "import threading\n"
+            "\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n"
+            "            self.flush()\n"
+            "\n"
+            "    def flush(self):\n"
+            "        with self._lock:\n"
+            "            self._n = 0\n"
+        )})
+        assert rules_of(findings) == ["GL203"]
+
+    def test_positive_self_recursive_deadlock(self):
+        """Direct recursion under a plain Lock re-acquires just as fatally
+        as calling a sibling method."""
+        findings, _ = analyze_sources({"fx": (
+            "import threading\n"
+            "\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "\n"
+            "    def drain(self, retry=True):\n"
+            "        with self._lock:\n"
+            "            self._items.clear()\n"
+            "            if retry:\n"
+            "                self.drain(retry=False)\n"
+        )})
+        assert "GL203" in rules_of(findings)
+
+    def test_positive_abba_cycle_across_classes(self):
+        a = (
+            "import threading\n"
+            "from m2 import B\n"
+            "\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.b = B()\n"
+            "        self._x = 0\n"
+            "    def doit(self):\n"
+            "        with self._lock:\n"
+            "            self._x += 1\n"
+            "            self.b.poke()\n"
+        )
+        b = (
+            "import threading\n"
+            "from m1 import A\n"
+            "\n"
+            "class B:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.a = A()\n"
+            "        self._y = 0\n"
+            "    def poke(self):\n"
+            "        with self._lock:\n"
+            "            self._y += 1\n"
+            "    def cross(self):\n"
+            "        with self._lock:\n"
+            "            self._y += 1\n"
+            "            self.a.doit()\n"
+        )
+        findings, _ = analyze_sources({"m1": a, "m2": b})
+        assert rules_of(findings) == ["GL202"]
+
+    def test_positive_wrong_lock_mutation(self):
+        """Lock identity matters: mutating _a-guarded state while holding
+        only _b is still a lost-update race."""
+        findings, _ = analyze_sources({"fx": (
+            "import threading\n"
+            "\n"
+            "class Two:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self._items = {}\n"
+            "\n"
+            "    def put(self, k, v):\n"
+            "        with self._a:\n"
+            "            self._items[k] = v\n"
+            "\n"
+            "    def wrong(self, k):\n"
+            "        with self._b:\n"
+            "            self._items.pop(k, None)\n"
+        )})
+        assert rules_of(findings) == ["GL201"]
+        assert "self._a" in findings[0].message
+
+    def test_negative_distinct_locks_no_false_deadlock(self):
+        """Holding _a (even reentrant) while calling a method that takes
+        _b is not re-entry — GL203 must compare lock identities."""
+        findings, _ = analyze_sources({"fx": (
+            "import threading\n"
+            "\n"
+            "class Two:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.RLock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self._x = 0\n"
+            "        self._y = 0\n"
+            "\n"
+            "    def m1(self):\n"
+            "        with self._a:\n"
+            "            self._x += 1\n"
+            "            self.m2()\n"
+            "\n"
+            "    def m2(self):\n"
+            "        with self._b:\n"
+            "            self._y += 1\n"
+        )})
+        assert [f for f in findings if f.rule == "GL203"] == []
+
+    def test_negative_private_helper_called_under_lock(self):
+        """The KubeStore._maybe_finalize pattern: an unlocked private
+        helper whose every intra-class call site holds the lock."""
+        findings, _ = analyze_sources({"fx": (
+            "import threading\n"
+            "\n"
+            "class Store:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._items = {}\n"
+            "\n"
+            "    def delete(self, k):\n"
+            "        with self._lock:\n"
+            "            self._cleanup(k)\n"
+            "\n"
+            "    def _cleanup(self, k):\n"
+            "        self._items.pop(k, None)\n"
+        )})
+        assert findings == []
+
+    def test_negative_reads_never_flag(self):
+        src = locked_class("peek", "        return len(self._items)\n")
+        findings, _ = analyze_sources({"fx": src})
+        assert findings == []
+
+    # -- real-code fixtures (the satellite requirement) --------------------
+
+    def test_real_kube_store_is_clean(self):
+        src = read_pkg(os.path.join("kube", "store.py"))
+        findings, _ = analyze_sources({"karpenter_tpu.kube.store": src})
+        assert [f for f in findings if f.rule.startswith("GL2")] == []
+
+    def test_real_metrics_registry_is_clean(self):
+        src = read_pkg(os.path.join("operator", "metrics.py"))
+        findings, _ = analyze_sources({"karpenter_tpu.operator.metrics": src})
+        assert [f for f in findings if f.rule.startswith("GL2")] == []
+
+    def test_raced_kube_store_is_flagged(self):
+        """Strip the lock from drain_events: _events stays guarded by
+        create/update/delete, so the unlocked swap is a lost-update race
+        the rule must catch."""
+        src = read_pkg(os.path.join("kube", "store.py"))
+        locked = (
+            "    def drain_events(self) -> list:\n"
+            "        with self._lock:\n"
+            "            events, self._events = self._events, []\n"
+            "            return events\n"
+        )
+        raced = (
+            "    def drain_events(self) -> list:\n"
+            "        events, self._events = self._events, []\n"
+            "        return events\n"
+        )
+        assert locked in src, "store.py drifted; update the raced fixture"
+        findings, _ = analyze_sources(
+            {"karpenter_tpu.kube.store": src.replace(locked, raced)}
+        )
+        gl201 = [f for f in findings if f.rule == "GL201"]
+        assert len(gl201) == 1
+        assert "drain_events" in gl201[0].message
+        assert "_events" in gl201[0].message
+
+    def test_raced_metrics_gauge_is_flagged(self):
+        """Strip the lock from Gauge.inc (set/clear still guard _values):
+        concurrent exporters racing inc against clear is exactly the
+        delete-then-set sweep hazard."""
+        src = read_pkg(os.path.join("operator", "metrics.py"))
+        head, sep, gauge_on = src.partition("class Gauge(_Metric):")
+        assert sep, "metrics.py drifted; update the raced fixture"
+        locked = (
+            "    def inc(self, amount: float = 1.0, **labels):\n"
+            "        key = _labels_key(labels)\n"
+            "        with self._lock:\n"
+            "            self._values[key] = self._values.get(key, 0.0) + amount\n"
+        )
+        raced = (
+            "    def inc(self, amount: float = 1.0, **labels):\n"
+            "        key = _labels_key(labels)\n"
+            "        self._values[key] = self._values.get(key, 0.0) + amount\n"
+        )
+        assert locked in gauge_on, "Gauge.inc drifted; update the raced fixture"
+        findings, _ = analyze_sources({
+            "karpenter_tpu.operator.metrics": head + sep + gauge_on.replace(locked, raced, 1)
+        })
+        gl201 = [f for f in findings if f.rule == "GL201"]
+        assert len(gl201) == 1
+        assert "Gauge.inc" in gl201[0].message
+
+
+# ---------------------------------------------------------------------------
+# GL3xx drift
+# ---------------------------------------------------------------------------
+
+class TestDriftRules:
+    def test_positive_stale_export(self):
+        findings, _ = analyze_sources({"fx": (
+            "def real():\n"
+            "    pass\n"
+            "\n"
+            "__all__ = ['real', 'ghost']\n"
+        )})
+        assert rules_of(findings) == ["GL301"]
+        assert "ghost" in findings[0].message
+
+    def test_positive_dead_reexport(self):
+        findings, _ = analyze_sources({
+            "pkg.__init__": (
+                "from pkg.sub import used_fn, dead_fn\n"
+                "\n"
+                "__all__ = ['used_fn']\n"
+            ),
+            "pkg.sub": "def used_fn(): pass\n\ndef dead_fn(): pass\n",
+            "consumer": "from pkg import used_fn\n",
+        })
+        assert rules_of(findings) == ["GL302"]
+        assert "dead_fn" in findings[0].message
+
+    def test_positive_swallowed_controller_exception(self):
+        findings, _ = analyze_sources({"x.controllers.recon": (
+            "class C:\n"
+            "    def reconcile(self):\n"
+            "        try:\n"
+            "            self.work()\n"
+            "        except Exception:\n"
+            "            pass\n"
+        )})
+        assert rules_of(findings) == ["GL303"]
+
+    def test_negative_consistent_all_and_consumed_exports(self):
+        findings, _ = analyze_sources({
+            "pkg.__init__": (
+                "from pkg.sub import a_fn, b_fn\n"
+                "\n"
+                "__all__ = ['a_fn', 'b_fn']\n"
+            ),
+            "pkg.sub": "def a_fn(): pass\n\ndef b_fn(): pass\n",
+        })
+        assert findings == []
+
+    def test_negative_handler_that_logs_or_reraises(self):
+        findings, _ = analyze_sources({"x.controllers.recon": (
+            "class C:\n"
+            "    def reconcile(self):\n"
+            "        try:\n"
+            "            self.work()\n"
+            "        except Exception:\n"
+            "            self.log.warn('reconcile failed')\n"
+            "\n"
+            "    def strict(self):\n"
+            "        try:\n"
+            "            self.work()\n"
+            "        except Exception:\n"
+            "            raise\n"
+            "\n"
+            "    def narrow(self, k):\n"
+            "        try:\n"
+            "            return self.cache[k]\n"
+            "        except KeyError:\n"
+            "            return None\n"
+        )})
+        assert findings == []
+
+    def test_negative_swallow_outside_controllers_not_flagged(self):
+        """GL303 is scoped to the controller ring — utility fallbacks
+        (engine ladders, availability probes) legitimately eat errors."""
+        findings, _ = analyze_sources({"x.native.loader": (
+            "def available():\n"
+            "    try:\n"
+            "        import ctypes  # noqa: F401\n"
+            "        return True\n"
+            "    except Exception:\n"
+            "        return False\n"
+        )})
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    SRC = (
+        "import jax\n"
+        "\n"
+        "def kernel(x):\n"
+        "    if x > 0:  # graftlint: disable=GL102 -- calibrated escape hatch\n"
+        "        return x\n"
+        "    # graftlint: disable=GL101 -- block-comment form\n"
+        "    v = float(x)\n"
+        "    return v\n"
+        "\n"
+        "fn = jax.jit(kernel)\n"
+    )
+
+    def test_inline_and_block_comment_directives(self):
+        findings, suppressed = analyze_sources({"fx": self.SRC})
+        assert findings == []
+        assert sorted(rules_of(suppressed)) == ["GL101", "GL102"]
+
+    def test_scope_directive_on_def_line(self):
+        src = (
+            "import jax\n"
+            "\n"
+            "def kernel(x):  # graftlint: disable=GL101,GL102 -- whole fn\n"
+            "    if x > 0:\n"
+            "        return float(x)\n"
+            "    return 0.0\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )
+        findings, suppressed = analyze_sources({"fx": src})
+        assert findings == []
+        assert len(suppressed) == 2
+
+    def test_unrelated_rule_not_suppressed(self):
+        src = self.SRC.replace("disable=GL102", "disable=GL999")
+        findings, _ = analyze_sources({"fx": src})
+        assert "GL102" in rules_of(findings)
+
+    def test_bare_disable_without_justification_suppresses_nothing(self):
+        """The `-- why` clause is mandatory (ROADMAP policy, machine
+        enforced): a justification-free disable leaves the finding live."""
+        src = (
+            "import jax\n"
+            "\n"
+            "def kernel(x):\n"
+            "    if x > 0:  # graftlint: disable=GL102\n"
+            "        return x\n"
+            "    return x * 2\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )
+        findings, suppressed = analyze_sources({"fx": src})
+        assert rules_of(findings) == ["GL102"]
+        assert suppressed == []
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the whole package is clean, and the CLI agrees
+# ---------------------------------------------------------------------------
+
+class TestPackageGate:
+    def test_whole_package_zero_unsuppressed_findings(self):
+        findings, suppressed = analyze_paths([PKG_DIR])
+        assert findings == [], "\n".join(f.render() for f in findings)
+        # suppressions must stay deliberate: each one carries an inline
+        # justification and the count is pinned so drift is a diff
+        assert len(suppressed) <= 4
+
+    def test_cli_exit_codes_and_output(self, tmp_path, capsys):
+        clean = tmp_path / "clean.py"
+        clean.write_text("def ok():\n    return 1\n")
+        assert cli_main([str(clean)]) == 0
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "import jax\n"
+            "def k(x):\n"
+            "    return float(x)\n"
+            "fn = jax.jit(k)\n"
+        )
+        rc = cli_main([str(dirty)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "GL101" in out and "dirty.py:3" in out
+
+    def test_gate_survives_package_named_checkout_dir(self, tmp_path):
+        """Module names anchor at the LAST path component named
+        karpenter_tpu: a clone directory with the package's own name must
+        not double the prefix and silently break cross-module analysis."""
+        import shutil
+
+        nested = tmp_path / "karpenter_tpu" / "karpenter_tpu"
+        shutil.copytree(PKG_DIR, nested)
+        findings, suppressed = analyze_paths([str(nested)])
+        assert findings == [], "\n".join(f.render() for f in findings)
+        assert len(suppressed) == 2
+
+    def test_cli_list_rules(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("GL101", "GL102", "GL103", "GL104",
+                     "GL201", "GL202", "GL203",
+                     "GL301", "GL302", "GL303"):
+            assert rule in out
+        assert set(RULES) == {
+            "GL101", "GL102", "GL103", "GL104",
+            "GL201", "GL202", "GL203",
+            "GL301", "GL302", "GL303",
+        }
