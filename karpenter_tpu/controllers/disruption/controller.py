@@ -38,6 +38,7 @@ ABNORMAL_RUN_GAP = 15 * 60.0  # logAbnormalRuns threshold (controller.go:274-283
 class DisruptionContext:
     def __init__(self, provisioner, cluster, store, clock, options=None, registry=None):
         from karpenter_tpu.operator import metrics as _m
+        from karpenter_tpu.ops.consolidate import SnapshotCache
 
         self.provisioner = provisioner
         self.cluster = cluster
@@ -45,6 +46,10 @@ class DisruptionContext:
         self.clock = clock
         self.options = options or {}
         self.registry = registry or _m.REGISTRY
+        # one tensorization per cluster-state generation, shared by every
+        # consolidation probe and confirming simulation in a round
+        # (ops/consolidate.py documents the invalidation contract)
+        self.snapshot_cache = SnapshotCache()
 
 
 class DisruptionController:
@@ -225,7 +230,10 @@ class DisruptionController:
             # price change) during the validation TTL invalidates the
             # command (validation.go:186: command types ⊆ fresh-sim types)
             sim = simulate_scheduling(
-                self.provisioner, self.cluster, self.store, list(cmd.candidates)
+                self.provisioner, self.cluster, self.store, list(cmd.candidates),
+                # generation-checked: after _execute bumped the state the
+                # cache declines and the validation re-assembles fresh inputs
+                inputs=self.ctx.snapshot_cache.inputs_for(self.cluster),
             )
             if not sim.all_pods_scheduled() or len(sim.new_claims) > len(cmd.replacements):
                 return False

@@ -101,14 +101,20 @@ class SimulationResults:
         return all(p.uid in placed for p in self.candidate_pods)
 
 
-def simulate_scheduling(provisioner, cluster, store, candidates) -> SimulationResults:
-    """Counterfactual solve: cluster minus candidates (helpers.go:51)."""
+def simulate_scheduling(provisioner, cluster, store, candidates, inputs=None) -> SimulationResults:
+    """Counterfactual solve: cluster minus candidates (helpers.go:51).
+
+    `inputs` optionally carries pre-assembled solver inputs (templates,
+    catalog, overhead, limits, domains) from the round's snapshot cache
+    (ops/consolidate.py) — valid only within one cluster-state generation,
+    which the cache's `inputs_for` enforces before handing them out."""
     excluded = {c.provider_id for c in candidates}
     state_nodes = [sn for sn in cluster.nodes() if sn.provider_id not in excluded]
     candidate_pods = [p for c in candidates for p in c.reschedulable_pods]
     pending = [p for p in store.list("pods") if pod_util.is_provisionable(p)]
     deleting = provisioner.deleting_node_pods(state_nodes, pending + candidate_pods)
     results = provisioner.schedule(
-        pods=pending + candidate_pods + deleting, state_nodes=state_nodes
+        pods=pending + candidate_pods + deleting, state_nodes=state_nodes,
+        inputs=inputs,
     )
     return SimulationResults(results, candidate_pods)

@@ -76,10 +76,14 @@ class Drift(Method):
         empty = [c for c in drifted if not c.reschedulable_pods]
         if empty:
             return Command(empty, reason=self.reason)
-        # else one at a time, with replacement simulation
+        # else one at a time, with replacement simulation (sharing the
+        # round's cached solver inputs when still generation-current)
+        cache = getattr(self.ctx, "snapshot_cache", None)
+        inputs = cache.inputs_for(self.ctx.cluster) if cache is not None else None
         for c in drifted:
             sim = simulate_scheduling(
-                self.ctx.provisioner, self.ctx.cluster, self.ctx.store, [c]
+                self.ctx.provisioner, self.ctx.cluster, self.ctx.store, [c],
+                inputs=inputs,
             )
             if not sim.all_pods_scheduled():
                 continue
@@ -105,7 +109,14 @@ class Emptiness(Method):
             if c.reschedulable_pods:
                 continue
             wait = c.node_pool.spec.disruption.consolidate_after or 0.0
-            since = claim.get_condition(COND_EMPTY).last_transition_time
+            cond = claim.get_condition(COND_EMPTY)
+            since = cond.last_transition_time if cond is not None else None
+            if since is None:
+                # condition present but its transition time unset (partial
+                # status write, wire-doc normalization gap): the age gate
+                # cannot be proven, so the node is NOT yet eligible — skip
+                # it this round instead of raising mid-ladder
+                continue
             if clock.now() - since < wait:
                 continue
             empty.append(c)
@@ -141,13 +152,29 @@ class EmptyNodeConsolidation(Method):
         return Command(empty, reason=self.reason)
 
 
-def candidate_prices(candidates) -> float:
-    return sum(c.price for c in candidates)
+def candidate_prices(candidates) -> float | None:
+    """Sum of the candidates' current offering prices, or None when ANY
+    candidate's price is unknown (delisted offering, price <= 0) — the
+    reference's getCandidatePrices error stance (consolidation.go:86-97):
+    an unpriceable node cannot anchor a "strictly cheaper" comparison, and
+    silently summing it as 0 understates the current cost, letting a
+    replacement pass against a candidate set it may not actually beat."""
+    total = 0.0
+    for c in candidates:
+        p = c.price
+        if p <= 0:
+            return None
+        total += p
+    return total
 
 
 def compute_consolidation(ctx, candidates) -> Command | None:
     """Shared consolidation core (consolidation.go:112-296)."""
-    sim = simulate_scheduling(ctx.provisioner, ctx.cluster, ctx.store, candidates)
+    cache = getattr(ctx, "snapshot_cache", None)
+    inputs = cache.inputs_for(ctx.cluster) if cache is not None else None
+    sim = simulate_scheduling(
+        ctx.provisioner, ctx.cluster, ctx.store, candidates, inputs=inputs
+    )
     if not sim.all_pods_scheduled():
         return None
     if len(sim.new_claims) == 0:
@@ -157,6 +184,8 @@ def compute_consolidation(ctx, candidates) -> Command | None:
 
     replacement = sim.new_claims[0]
     current_price = candidate_prices(candidates)
+    if current_price is None:
+        return None  # unpriceable candidate: abort the replacement path
     all_spot = all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates)
 
     # the replacement must launch strictly cheaper than the candidates cost
@@ -243,6 +272,73 @@ def filter_out_same_type(replacement, candidates) -> list:
     return kept
 
 
+def _device_probe(ctx, probe_fn, method_label, cands, pool):
+    """Shared probe runner for both consolidation methods: the TPUSolver
+    gate, the exception fallback, and the batch-size histogram. Falling
+    back to the sequential search is by design (the probes are
+    prefilters), but the reason must stay diagnosable — a permanently-
+    failing probe silently costs every consolidation round its batched
+    dispatch. The counter makes it visible on the scrape; the WARNING
+    carries the traceback (stdlib logging is never configured here, and
+    only WARNING+ reaches the lastResort stderr handler — the
+    models/solver.py precedent)."""
+    from karpenter_tpu.models.solver import TPUSolver
+
+    if not isinstance(getattr(ctx.provisioner, "solver", None), TPUSolver):
+        return None
+    try:
+        out = probe_fn(
+            ctx.provisioner, ctx.cluster, ctx.store, cands,
+            cache=getattr(ctx, "snapshot_cache", None),
+            registry=ctx.registry,
+            # the snapshot is built over the FULL consolidatable pool so
+            # MultiNode's and SingleNode's probes share one tensorization
+            build_candidates=pool,
+        )
+    except Exception:
+        import logging
+
+        from karpenter_tpu.operator import metrics as m
+
+        ctx.registry.counter(
+            m.DISRUPTION_PROBE_FAILURES,
+            "device consolidation probes that fell back to the "
+            "sequential search",
+        ).inc(method=method_label)
+        logging.getLogger(__name__).warning(
+            "device consolidation probe (%s) failed; using the sequential "
+            "search", method_label, exc_info=True)
+        return None
+    if out is not None:
+        from karpenter_tpu.operator import metrics as m
+
+        ctx.registry.histogram(
+            m.DISRUPTION_PROBE_BATCH_SIZE,
+            "counterfactual rows ranked per batched probe dispatch",
+            buckets=m.PROBE_BATCH_BUCKETS,
+        ).observe(len(cands), method=method_label)
+    return out
+
+
+# sentinel distinguishing a scan the wall clock cut short from one that
+# exhausted (and thereby CLEARED) its candidates — the single-node
+# back-check must never treat "never checked" as "rejected"
+_TIMED_OUT = object()
+
+
+def _search_timed_out(ctx, deadline, search_type) -> bool:
+    """Wall-clock budget check shared by both consolidation searches
+    (multinodeconsolidation.go:37, singlenodeconsolidation.go:46)."""
+    if ctx.clock.now() <= deadline:
+        return False
+    from karpenter_tpu.operator import metrics as m
+
+    ctx.registry.counter(
+        m.CONSOLIDATION_TIMEOUTS, "consolidation searches cut off by wall clock"
+    ).inc(type=search_type)
+    return True
+
+
 class MultiNodeConsolidation(Method):
     """Largest N where candidates[0..N] collapse into ≤1 replacement
     (disruption/multinodeconsolidation.go:47-163). The prefix search runs
@@ -259,21 +355,23 @@ class MultiNodeConsolidation(Method):
     last_probe: str = ""  # "device" | "sequential" (observability + tests)
 
     def compute_command(self, candidates, budgets):
-        cands = _consolidatable(candidates)
-        cands.sort(key=lambda c: c.disruption_cost)
-        cands = within_budget(budgets, self.reason, cands)[:MULTI_NODE_CANDIDATE_CAP]
+        pool = _consolidatable(candidates)
+        pool.sort(key=lambda c: c.disruption_cost)
+        cands = within_budget(budgets, self.reason, pool)[:MULTI_NODE_CANDIDATE_CAP]
         if len(cands) < 2:
             return None
         self._deadline = self.ctx.clock.now() + MULTI_NODE_TIMEOUT
 
-        k = self._probe(cands)
+        k = self._probe(cands, pool)
         if k is not None:
             self.last_probe = "device"
-            # the probe is approximate in both directions (strict label
-            # compat under-estimates; no price filter over-estimates), so
-            # every answer is confirmed by the real simulation and a miss
-            # degenerates into the reference's binary search on the
-            # remaining range — never a silently skipped consolidation
+            # the probe is approximate in both directions (topology
+            # tightening and the cheapest-offering price prune can
+            # under-estimate; the coarse fit model over-estimates the
+            # exact price/validation checks), so every answer is confirmed
+            # by the real simulation and a miss degenerates into the
+            # reference's binary search on the remaining range — never a
+            # silently skipped consolidation
             if k < 2:
                 cmd = self._confirm(cands[:2])
                 if cmd is None:
@@ -296,37 +394,11 @@ class MultiNodeConsolidation(Method):
         self.last_probe = "sequential"
         return self._binary_search(cands, hi=len(cands))
 
-    def _probe(self, cands):
-        from karpenter_tpu.models.solver import TPUSolver
+    def _probe(self, cands, pool=None):
         from karpenter_tpu.ops.consolidate import batched_feasible_prefix
 
-        if not isinstance(getattr(self.ctx.provisioner, "solver", None), TPUSolver):
-            return None
-        try:
-            return batched_feasible_prefix(
-                self.ctx.provisioner, self.ctx.cluster, self.ctx.store, cands
-            )
-        except Exception:
-            # falling back to the sequential search is by design (the probe
-            # is a prefilter), but the reason must stay diagnosable — a
-            # permanently-failing probe silently costs every consolidation
-            # round its batched dispatch. The counter makes it visible on
-            # the scrape; the WARNING carries the traceback (stdlib logging
-            # is never configured here, and only WARNING+ reaches the
-            # lastResort stderr handler — the models/solver.py precedent)
-            import logging
-
-            from karpenter_tpu.operator import metrics as m
-
-            self.ctx.registry.counter(
-                m.DISRUPTION_PROBE_FAILURES,
-                "device consolidation probes that fell back to the "
-                "sequential search",
-            ).inc(method="multi")
-            logging.getLogger(__name__).warning(
-                "device consolidation probe failed; using sequential "
-                "binary search", exc_info=True)
-            return None
+        return _device_probe(self.ctx, batched_feasible_prefix, "multi",
+                             cands, pool)
 
     def _confirm(self, prefix):
         """One real simulation of a candidate prefix, with the same-type
@@ -342,14 +414,7 @@ class MultiNodeConsolidation(Method):
         return cmd
 
     def _timed_out(self) -> bool:
-        if self.ctx.clock.now() <= self._deadline:
-            return False
-        from karpenter_tpu.operator import metrics as m
-
-        self.ctx.registry.counter(
-            m.CONSOLIDATION_TIMEOUTS, "consolidation searches cut off by wall clock"
-        ).inc(type="multi")
-        return True
+        return _search_timed_out(self.ctx, self._deadline, "multi")
 
     def _binary_search(self, cands, hi, lo=1, best=None):
         # binary search on prefix length (multinodeconsolidation.go:111-163),
@@ -371,28 +436,113 @@ class MultiNodeConsolidation(Method):
 
 
 class SingleNodeConsolidation(Method):
-    """Linear scan, one candidate at a time, abandoned after a 3-minute
-    wall clock (disruption/singlenodeconsolidation.go:46-120)."""
+    """One-candidate-at-a-time consolidation, abandoned after a 3-minute
+    wall clock (disruption/singlenodeconsolidation.go:46-120).
+
+    The reference's linear scan — a full scheduling simulation per
+    candidate — runs here as ONE batched device probe
+    (ops/consolidate.py batched_single_feasible): every candidate's
+    counterfactual is ranked in a single vmapped pack dispatch over the
+    round's shared snapshot, and only probe HITS get the real confirming
+    simulation (price filter, validation). The probe is a seed, not the
+    decision: a confirming hit back-checks every cheaper probe miss before
+    shipping (so a false negative can never disrupt a costlier node than
+    the reference's lowest-cost-first scan would), and whenever NO hit
+    confirms, one paranoia confirmation runs on the cheapest miss (the
+    mirror of MultiNode's k<2 confirm) — if it lands, the probe misjudged
+    the batch and the method degenerates into the reference's sequential
+    scan; if it fails, the probe's negative answer stands for this round
+    (the next state change re-probes). Inexpressible scenarios skip the
+    probe entirely and run the sequential scan."""
 
     reason = REASON_UNDERUTILIZED
     needs_validation = True
     is_consolidation = True
+    last_probe: str = ""  # "device" | "sequential" (observability + tests)
 
     def compute_command(self, candidates, budgets):
-        cands = _consolidatable(candidates)
-        cands.sort(key=lambda c: c.disruption_cost)
-        cands = within_budget(budgets, self.reason, cands)
+        pool = _consolidatable(candidates)
+        pool.sort(key=lambda c: c.disruption_cost)
+        cands = within_budget(budgets, self.reason, pool)
+        if not cands:
+            return None
         deadline = self.ctx.clock.now() + SINGLE_NODE_TIMEOUT
-        for c in cands:
-            if self.ctx.clock.now() > deadline:
-                from karpenter_tpu.operator import metrics as m
-
-                self.ctx.registry.counter(
-                    m.CONSOLIDATION_TIMEOUTS,
-                    "consolidation searches cut off by wall clock",
-                ).inc(type="single")
+        probed = self._probe(cands, pool)
+        if probed is None:
+            self.last_probe = "sequential"
+            res = self._scan(cands, deadline)
+            return None if res is _TIMED_OUT else res
+        feas, definitive = probed
+        self.last_probe = "device"
+        # confirm hits in disruption-cost order; probe misses are only
+        # SKIPPED, never discarded: when a hit confirms, any miss that
+        # precedes it is back-checked first so a probe false negative can
+        # never make the method ship a costlier node than the reference's
+        # lowest-cost-first scan would (the result is exactly the first
+        # candidate — in order — that the exact simulation accepts, up to
+        # and including the first confirming hit)
+        any_hit = False
+        skipped: list = []
+        for c, ok in zip(cands, feas):
+            if not ok:
+                skipped.append(c)
+                continue
+            any_hit = True
+            if self._timed_out(deadline):
                 return None  # abandon mid-scan (:71-75)
+            cmd = compute_consolidation(self.ctx, [c])
+            if cmd is None:
+                continue
+            earlier = self._scan(skipped, deadline)
+            if earlier is _TIMED_OUT:
+                # an exhausted budget mid-back-check means the cheaper
+                # misses were NEVER cleared: shipping the later hit would
+                # disrupt a costlier node than the reference's lowest-cost-
+                # first scan ever could — abandon, like the reference does
+                return None
+            return earlier if earlier is not None else cmd
+        if skipped:
+            if not definitive:
+                # topology bundle: misses are hints, not answers (the waves
+                # counterfactual can tighten the probe) — finish with the
+                # reference's scan so no consolidation is silently skipped
+                res = self._scan(skipped, deadline)
+                return None if res is _TIMED_OUT else res
+            # no hit confirmed: one paranoia simulation of the cheapest
+            # skipped miss guards the definitive probe's residual
+            # false-negative corner (f32 fit rounding); if it lands the
+            # probe misjudged the batch
+            if self._timed_out(deadline):
+                return None
+            cmd = compute_consolidation(self.ctx, [skipped[0]])
+            if cmd is not None:
+                return cmd
+            if any_hit and skipped[1:]:
+                # hits existed but ALL confirms failed — the probe is
+                # demonstrably misaligned with the exact checks this round,
+                # so finish with the reference's scan rather than skipping
+                res = self._scan(skipped[1:], deadline)
+                return None if res is _TIMED_OUT else res
+        return None
+
+    def _scan(self, cands, deadline):
+        """The reference's linear scan (singlenodeconsolidation.go:64-89).
+        Returns the first confirmed command, None when every candidate was
+        exhausted, or _TIMED_OUT when the wall clock expired mid-scan — the
+        back-check caller must distinguish 'cleared' from 'never checked'."""
+        for c in cands:
+            if self._timed_out(deadline):
+                return _TIMED_OUT  # abandon mid-scan (:71-75)
             cmd = compute_consolidation(self.ctx, [c])
             if cmd is not None:
                 return cmd
         return None
+
+    def _timed_out(self, deadline) -> bool:
+        return _search_timed_out(self.ctx, deadline, "single")
+
+    def _probe(self, cands, pool=None):
+        from karpenter_tpu.ops.consolidate import batched_single_feasible
+
+        return _device_probe(self.ctx, batched_single_feasible, "single",
+                             cands, pool)

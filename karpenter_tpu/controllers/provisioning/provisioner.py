@@ -203,7 +203,7 @@ class Provisioner:
             out.append(p)
         return out
 
-    def schedule(self, pods=None, state_nodes=None):
+    def schedule(self, pods=None, state_nodes=None, inputs=None):
         # nodes are snapshotted BEFORE pods are listed: a pod that binds in
         # between appears both as pending and in its node's usage, which
         # over-provisions (safe); the reverse order would under-provision
@@ -217,7 +217,13 @@ class Provisioner:
             pods.extend(self.deleting_node_pods(state_nodes, pods))
             if not pods:
                 return None
-        templates, its_by_pool, overhead, limits, domains = self.solver_inputs()
+        # disruption simulations may hand in the round's cached solver
+        # inputs (ops/consolidate.py SnapshotCache.inputs_for) — identical
+        # content to a fresh assembly within one cluster-state generation,
+        # which the cache verifies before releasing them
+        templates, its_by_pool, overhead, limits, domains = (
+            inputs if inputs is not None else self.solver_inputs()
+        )
 
         # pods with unresolvable PVCs can't schedule: report and drop from
         # the batch (ValidatePersistentVolumeClaims, volumetopology.go:155)

@@ -24,18 +24,27 @@ class WorkloadController:
 
     def poll(self) -> bool:
         progressed = self._gc_orphans()
+        # one pass over the pod list, indexed by owning deployment: the
+        # naive per-deployment rescan is O(deployments × pods) and at fleet
+        # scale (1000 deployments × 1000 pods) it dominated the whole
+        # reconcile round — this is the controller-manager's informer-index
+        # equivalent, not a behavior change (list order is preserved, so
+        # scale-down still trims store-insertion order)
+        owned_by: dict = {}
+        for p in self.store.list("pods"):
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            for o in p.metadata.owner_references:
+                if o.get("kind") == "Deployment":
+                    owned_by.setdefault(
+                        (p.metadata.namespace, o.get("name")), []
+                    ).append(p)
         for deploy in self.store.list("deployments"):
             if deploy.template is None:
                 continue
-            owned = [
-                p
-                for p in self.store.list("pods", namespace=deploy.metadata.namespace)
-                if p.metadata.deletion_timestamp is None
-                and any(
-                    o.get("kind") == "Deployment" and o.get("name") == deploy.metadata.name
-                    for o in p.metadata.owner_references
-                )
-            ]
+            owned = owned_by.get(
+                (deploy.metadata.namespace, deploy.metadata.name), []
+            )
             for extra in owned[deploy.replicas :]:
                 # scale-down: newest-first would need creation ordering;
                 # owned list order (store insertion) approximates it

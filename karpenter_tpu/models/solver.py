@@ -25,7 +25,7 @@ from karpenter_tpu.ops.tensorize import (
     UNCAPPED,
     bucket as _bucket,
     device_eligible,
-    pad_to,
+    kernel_args,
 )
 from karpenter_tpu.utils import resources as resutil
 
@@ -406,59 +406,12 @@ class TPUSolver(Solver):
             B = min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
         Gp, Tp, Bp = _bucket(G), _bucket(T), _bucket(B)
 
-        pad = pad_to
-
-        args = dict(
-            g_mask=pad(snap.g_mask, (Gp, K, W)),
-            g_has=pad(snap.g_has, (Gp, K)),
-            g_tol=pad(snap.g_tol, (Gp, K)),
-            g_demand=pad(snap.g_demand, (Gp, R)),
-            g_count=pad(snap.g_count, (Gp,)),
-            g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
-            g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
-            g_tmpl_ok=pad(snap.g_tmpl_ok, (Gp, M)),
-            g_bin_cap=pad(snap.g_bin_cap, (Gp,)),
-            g_single=pad(snap.g_single, (Gp,)),
-            g_decl=pad(snap.g_decl, (Gp, snap.g_decl.shape[1])),
-            g_match=pad(snap.g_match, (Gp, snap.g_match.shape[1])),
-            # padded group rows get sown=0 (cap 0), which is inert: their
-            # count is 0 so they never take
-            g_sown=pad(snap.g_sown, (Gp, snap.g_sown.shape[1])),
-            g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
-            g_aneed=pad(snap.g_aneed, (Gp, snap.g_aneed.shape[1])),
-            g_amatch=pad(snap.g_amatch, (Gp, snap.g_amatch.shape[1])),
-            t_mask=pad(snap.t_mask, (Tp, K, W)),
-            t_has=pad(snap.t_has, (Tp, K)),
-            t_tol=pad(snap.t_tol, (Tp, K)),
-            t_alloc=pad(snap.t_alloc, (Tp, R)),
-            t_cap=pad(snap.t_cap, (Tp, R)),
-            t_tmpl=pad(snap.t_tmpl, (Tp,)),
-            off_zone=pad_to(snap.off_zone, (Tp, snap.off_zone.shape[1]), fill=-1),
-            off_ct=pad_to(snap.off_ct, (Tp, snap.off_ct.shape[1]), fill=-1),
-            off_avail=pad(snap.off_avail, (Tp, snap.off_avail.shape[1])),
-            off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
-            m_mask=snap.m_mask,
-            m_has=snap.m_has,
-            m_tol=snap.m_tol,
-            m_overhead=snap.m_overhead,
-            m_limits=snap.m_limits,
-            m_minv=snap.m_minv,
-        )
-        # padded types must be infeasible: zero alloc fails fits (pods>=1),
-        # and their offerings carry the -1 "no domain" sentinel
-
         E = esnap.E if esnap is not None else 0
         Ep = _bucket(max(E, 1), lo=8)
-        if esnap is not None:
-            args.update(
-                e_avail=pad(esnap.e_avail, (Ep, R)),
-                ge_ok=pad(esnap.ge_ok, (Gp, Ep)),
-                e_npods=pad(esnap.e_npods, (Ep,)),
-                e_scnt=pad(esnap.e_scnt, (Ep, esnap.e_scnt.shape[1])),
-                e_decl=pad(esnap.e_decl, (Ep, esnap.e_decl.shape[1])),
-                e_match=pad(esnap.e_match, (Ep, esnap.e_match.shape[1])),
-                e_aff=pad(esnap.e_aff, (Ep, esnap.e_aff.shape[1])),
-            )
+        # one shared assembly point with the batched consolidation probes
+        # (ops/consolidate.py): a tensor family added to the snapshot is
+        # wired once in kernel_args and reaches both paths
+        args = kernel_args(snap, esnap, Gp=Gp, Tp=Tp, Ep=Ep)
 
         # the level-fill search range shrinks when every type caps its pod
         # count (the kubelet max-pods resource): levels never exceed

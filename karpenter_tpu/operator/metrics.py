@@ -192,6 +192,16 @@ DISRUPTION_PODS = f"{NAMESPACE}_disruption_pods_disrupted_total"
 DISRUPTION_BUDGETS = f"{NAMESPACE}_disruption_allowed_disruptions"
 CONSOLIDATION_TIMEOUTS = f"{NAMESPACE}_disruption_consolidation_timeouts_total"
 DISRUPTION_PROBE_FAILURES = f"{NAMESPACE}_disruption_probe_failures_total"
+DISRUPTION_SNAPSHOT_CACHE_HITS = (
+    f"{NAMESPACE}_disruption_snapshot_cache_hits_total"
+)
+DISRUPTION_SNAPSHOT_CACHE_MISSES = (
+    f"{NAMESPACE}_disruption_snapshot_cache_misses_total"
+)
+DISRUPTION_PROBE_BATCH_SIZE = f"{NAMESPACE}_disruption_probe_batch_size"
+# counterfactual-rows-per-dispatch buckets (powers of two up to the probe's
+# chunk cap) — durations make no sense for a size histogram
+PROBE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 DISRUPTION_ABNORMAL_RUNS = f"{NAMESPACE}_disruption_abnormal_runs_total"
 NODECLAIMS_DISRUPTED = f"{NAMESPACE}_nodeclaims_disrupted_total"
 CLUSTER_STATE_SYNCED = f"{NAMESPACE}_cluster_state_synced"

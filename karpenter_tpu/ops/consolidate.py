@@ -1,39 +1,80 @@
-"""Batched device consolidation probe — the #2 kernel (SURVEY.md §2.6).
+"""Batched device consolidation probes — the #2 kernel (SURVEY.md §2.6).
 
-The reference's MultiNodeConsolidation binary-searches prefix length over
-the disruption-cost-ordered candidates, each probe a full scheduling
-simulation (multinodeconsolidation.go:111-163) — log2(100) sequential
-solves. On a TPU the search becomes ONE batched counterfactual: vmap the
-pack kernel over all N prefixes at once. Prefix k's snapshot shares every
-tensor with the master except
+The reference's consolidation pass is host-bound twice over: the
+MultiNodeConsolidation prefix search runs log2(100) sequential scheduling
+simulations (multinodeconsolidation.go:111-163), and SingleNodeConsolidation
+then walks every remaining candidate with one FULL simulation each under a
+3-minute wall clock (singlenodeconsolidation.go:46-120). On a TPU both
+searches become ONE batched counterfactual each: vmap the pack kernel over
+N per-candidate (or per-prefix) snapshots that share every tensor with the
+master except
 
-- ``g_count``: pending pods plus the reschedulable pods of candidates[:k]
-- ``e_avail``: the cluster's nodes with candidates[:k] zeroed out
+- ``g_count``: pending pods plus the reschedulable pods of the
+  counterfactual's candidates
+- ``e_avail``: the cluster's nodes with those candidates zeroed out
 
-so the batch is two stacked arrays over a shared snapshot. ``max_bins=1``
-encodes the m→1 replacement rule (consolidation.go:164): a prefix whose
-pods don't fit into the surviving nodes plus ONE fresh claim simply leaves
-pods unassigned and is infeasible. The largest feasible prefix then gets
-the one real simulation (price filter, validation) — ≤2 device dispatches
-replacing the sequential ladder.
+so a batch is two stacked arrays over one shared snapshot. ``max_bins=1``
+encodes the m→1 replacement rule (consolidation.go:164): a counterfactual
+whose pods don't fit into the surviving nodes plus ONE fresh claim simply
+leaves pods unassigned and is infeasible. Probe hits then get the real
+confirming simulation (price filter, validation) — a handful of device
+dispatches replacing the sequential ladders.
 
-Topology-bearing clusters ride the probe too: the waves compiler
+Topology-bearing clusters ride the probes too: the waves compiler
 (ops/waves.py) turns the batch's spread/affinity/anti constraints into the
 same class tensors the solve path uses, with one counterfactual
 approximation — EVERY candidate's pods are excluded from the cluster domain
-counts (each prefix rebinds them), so prefixes that keep some candidates
-alive see slightly lower counts than the exact simulation. The error runs
-in BOTH directions (lower anti/spread counts loosen the probe; lower
-affinity match counts tighten it, so an affinity-dependent prefix can read
-infeasible), which is why every probe answer is only a SEED: the winner is
-confirmed by the real simulation and a mis-estimate degenerates into the
-sequential binary search around k, never a skipped consolidation.
+counts (each counterfactual rebinds them), so counterfactuals that keep
+some candidates alive see slightly lower counts than the exact simulation.
+The error runs in BOTH directions (lower anti/spread counts loosen the
+probe; lower affinity match counts tighten it, so an affinity-dependent
+counterfactual can read infeasible), which is why every probe answer is
+only a SEED: winners are confirmed by the real simulation and a
+mis-estimate degenerates into the reference's sequential search, never a
+skipped consolidation.
 
-The probe is a sound PREFILTER, not the decision: anything it cannot
+The probes are sound PREFILTERS, not the decision: anything they cannot
 express (waves-inexpressible shapes, non-basic-eligible pods, volume
 limits) returns None and the caller falls back to the sequential search; a
 probe hit is always re-validated by the full simulation before a command
 ships.
+
+Snapshot-cache invalidation contract
+------------------------------------
+
+``SnapshotCache`` memoizes ONE :class:`DisruptionSnapshot` — the tensorized
+cluster view plus the solver inputs it was derived from — keyed on the
+cluster-state generation counter (``state/cluster.py
+Cluster.consolidation_state``). Within one generation the cache serves
+every consumer of the disruption round: the MultiNode prefix probe, the
+SingleNode candidate probe, and (via ``inputs``) the confirming
+``simulate_scheduling`` calls and the controller's ``_validate`` re-check.
+
+* **Generation key.** Every informer event that can change the scheduling
+  answer bumps the counter (pod/node/nodeclaim updates, nodepool AND
+  daemonset changes, deletion marks). A bundle whose generation no longer
+  matches is dead: the next ``get`` re-tensorizes from scratch. Executing a
+  command always bumps the generation (``mark_for_deletion``), so a
+  validation round never sees a pre-command snapshot.
+* **What delta-updates cover.** Candidate exclusion only: per-counterfactual
+  ``g_count`` (pending base + the candidates' reschedulable pods, derived
+  from the cached per-pod group index) and ``e_avail`` (the candidates'
+  node columns zeroed). Everything else — group masks, type/offering
+  tensors, existing-node admission, topology class tensors — is shared
+  read-only from the one tensorization.
+* **When full re-tensorize is mandatory.** Any generation bump; a build
+  candidate set that is not a superset of the queried one (methods pass the
+  full consolidatable pool as ``build_candidates`` so MultiNode's build
+  also serves SingleNode); and any in-place catalog mutation that bypasses
+  the informer plane (offerings flipped without a store event) — the cache
+  cannot see those, which is safe only because probe answers are seeds:
+  the confirming simulation re-tensorizes through ``tensorize``'s own
+  offering-fingerprinted type cache and rejects stale hits.
+
+Cache efficacy is scrapeable: ``karpenter_disruption_snapshot_cache_hits/
+misses_total`` count bundle reuse, and the
+``karpenter_disruption_probe_batch_size`` histogram records how many
+counterfactuals each dispatch ranked.
 """
 
 from __future__ import annotations
@@ -46,10 +87,24 @@ from karpenter_tpu.ops.tensorize import (
     bucket as _bucket,
     device_basic_eligible,
     group_by_signature,
+    kernel_args,
     pad_to as pad,
     tensorize,
     tensorize_existing,
 )
+
+# counterfactual rows per dispatch: 128 is exactly the shape family the
+# capped prefix probe compiles (bucket(MULTI_NODE_CANDIDATE_CAP+1) = 128),
+# so a 1000-candidate single-node scan re-uses the multi probe's compiled
+# kernel instead of paying a fresh XLA compile per fleet size
+PROBE_CHUNK_ROWS = 128
+
+
+def _pow2(n: int, lo: int = 8) -> int:
+    """Next power of two >= n (>= lo) — the probe's pad ladder."""
+    import math
+
+    return max(lo, 1 << math.ceil(math.log2(max(n, 1))))
 
 
 @functools.lru_cache(maxsize=8)
@@ -63,8 +118,14 @@ def _batched_kernel(max_bins: int, max_minv: int = 0):
         # m_minv cannot run on a tracer under this jit/vmap
         out = kernels.solve_step(args, max_bins=max_bins, use_pallas=False,
                                  max_minv=max_minv)
-        placed = out["assign"].sum() + out["assign_e"].sum()
-        return placed, out["used"].sum()
+        # PER-GROUP placed counts, not a scalar: feasibility is "all the
+        # candidates' pods land", and pods within a group are spec-
+        # identical (interchangeable), so group-wise `placed >= the
+        # candidates' contribution` is exact — a scalar total cannot tell
+        # a stuck PENDING pod (which the reference's all_pods_scheduled
+        # ignores) from a stuck candidate pod (which blocks the command)
+        placed_g = out["assign"].sum(axis=1) + out["assign_e"].sum(axis=1)
+        return placed_g, out["used"].sum()
 
     # g_count and e_avail carry the batch axis; everything else broadcasts
     def batched(varying, shared):
@@ -76,23 +137,209 @@ def _batched_kernel(max_bins: int, max_minv: int = 0):
     return jax.jit(batched)
 
 
-def batched_feasible_prefix(provisioner, cluster, store, candidates):
-    """Largest k such that candidates[:k] consolidate into the remaining
-    cluster plus at most one fresh claim, decided in one device call.
-    Returns None when the probe cannot express the scenario (the caller
-    falls back to the sequential binary search)."""
+class DisruptionSnapshot:
+    """One tensorized cluster view shared by a whole disruption round.
+
+    Holds the solver inputs, the existing-node axis, the master device
+    snapshot over (pending pods + every probeable candidate's reschedulable
+    pods), and the per-pod group index that lets each probe derive its
+    counterfactual ``g_count`` rows without re-tensorizing."""
+
+    def __init__(self, generation, build_key, inputs, pending, enodes,
+                 col_by_pid, unprobeable, plan, snap, esnap, gidx_of, base):
+        self.generation = generation
+        self.build_key = build_key  # frozenset of build-candidate provider ids
+        self.inputs = inputs  # (templates, its_by_pool, overhead, limits, domains)
+        self.pending = pending
+        self.enodes = enodes
+        self.col_by_pid = col_by_pid  # provider_id -> existing-node column
+        self.unprobeable = unprobeable  # provider ids the probe cannot express
+        self.plan = plan
+        self.snap = snap
+        self.esnap = esnap
+        self.gidx_of = gidx_of  # pod uid -> group index
+        self.base = base  # [G] i32: pending-pod counts (every counterfactual's floor)
+        self.max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
+        # cheapest AVAILABLE offering across the whole catalog: the lower
+        # bound of any replacement claim's launch price, used by the probes'
+        # price prefilter (it under-estimates the true replacement price —
+        # compatibility can only raise it — so pruning on it is sound)
+        avail_prices = snap.off_price[snap.off_avail]
+        self.min_price = float(avail_prices.min()) if avail_prices.size else float("inf")
+        self._shared = None
+        self._dims = None
+
+    def columns_for(self, candidates):
+        """Existing-node columns for the queried candidates; None when any
+        of them is invisible or inexpressible (caller stays sequential)."""
+        cols = []
+        for c in candidates:
+            col = self.col_by_pid.get(c.provider_id)
+            if col is None:
+                return None
+            cols.append(col)
+        return cols
+
+    def contribs_for(self, candidates):
+        """[N,G] per-candidate reschedulable-pod counts over the snapshot's
+        group axis; None when a pod is missing from the snapshot (a stale
+        view the generation key should have caught — stay sequential)."""
+        G = self.snap.G
+        contrib = np.zeros((len(candidates), G), dtype=np.int32)
+        for j, c in enumerate(candidates):
+            for p in c.reschedulable_pods:
+                g = self.gidx_of.get(p.uid)
+                if g is None:
+                    return None
+                contrib[j, g] += 1
+        return contrib
+
+    def _shared_args(self):
+        if self._shared is None:
+            # pure power-of-two ladder (no 3·2^k steps): the solver's finer
+            # ladder trades compiles for scan width, but the probe re-keys
+            # its XLA compile on every fleet-size family and a consolidating
+            # fleet walks DOWN through them (1000 → 334 nodes crosses 4 fine
+            # buckets but only 2 power-of-two ones) — compile count, not
+            # padded-scan width, dominates the probe's wall clock
+            Gp = _pow2(self.snap.G)
+            Ep = _pow2(self.esnap.E)
+            Tp = _pow2(self.snap.T)
+            # NOTE: kernel_args is the assembly point shared with
+            # models/solver.py — a field missed there weakens both paths at
+            # once and the lockstep test catches it
+            self._shared = kernel_args(
+                self.snap, self.esnap, Gp=Gp, Tp=Tp, Ep=Ep,
+                include_counts=False,
+            )
+            self._dims = (Gp, Ep)
+        return self._shared, self._dims
+
+    def dispatch(self, g_count_k, e_zero_cols):
+        """Run the batched pack kernel over the counterfactual rows; returns
+        (placed_g, used) — per-row PER-GROUP placed-pod counts (shape
+        [rows, Gp]) and per-row fresh-claim counts.
+
+        ``e_zero_cols[i]`` holds the existing-node columns row i removes
+        from the cluster; counterfactual ``e_avail`` rows materialize
+        chunk-locally from the master tensor — never the full [rows, E, R]
+        array host-side, which an uncapped single-node batch over a large
+        fleet would blow into hundreds of MB before the first dispatch.
+        Rows are chunked (and the chunk axis padded on the same pure pow-2
+        ladder as the snapshot axes) so the batch stays inside a handful of
+        compiled shape families. Small-work snapshots route through the C++
+        engine under the solver's routing gate (models/solver.py
+        NATIVE_CUTOFF_PODS stance): few-group batches are short sequential
+        loops the native engine finishes without paying an XLA compile per
+        fleet-size family."""
+        if self._native_routable():
+            try:
+                return self._dispatch_native(g_count_k, e_zero_cols)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native probe dispatch failed; using the XLA kernel",
+                    exc_info=True)
+        shared, (Gp, Ep) = self._shared_args()
+        R = len(self.snap.resources)
+        rows = g_count_k.shape[0]
+        placed_g = np.empty((rows, Gp), dtype=np.int64)
+        used = np.empty(rows, dtype=np.int64)
+        for lo in range(0, rows, PROBE_CHUNK_ROWS):
+            hi = min(lo + PROBE_CHUNK_ROWS, rows)
+            n = hi - lo
+            Np = _pow2(n, lo=4)
+            e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
+            for i in range(n):
+                cols = e_zero_cols[lo + i]
+                if cols is not None and len(cols):
+                    e_chunk[i, cols, :] = 0.0
+            varying = dict(
+                g_count=pad(g_count_k[lo:hi], (Np, Gp)),
+                e_avail=pad(e_chunk, (Np, Ep, R)),
+            )
+            out_placed, out_used = _batched_kernel(1, self.max_minv)(
+                varying, shared)
+            placed_g[lo:hi] = np.asarray(out_placed)[:n]
+            used[lo:hi] = np.asarray(out_used)[:n]
+        return placed_g, used
+
+    def _native_routable(self) -> bool:
+        """The solver's engine-routing gate applied to the probe: the same
+        KARPENTER_NATIVE_CUTOFF master switch (0 disables all routing, so
+        tests keep the XLA path under coverage) and the same feasibility-
+        work floor — a probe row's parallelism is G×T, and below the floor
+        the accelerator (or its CPU emulation) can't amortize dispatch and
+        compile."""
+        import os
+
+        from karpenter_tpu.models.solver import DEVICE_MIN_WORK, _native_cutoff
+
+        if _native_cutoff() <= 0:
+            return False
+        min_work = int(os.environ.get("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK))
+        if self.snap.G * self.snap.T >= min_work:
+            return False
+        try:
+            from karpenter_tpu import native
+
+            return native.available()
+        except Exception:
+            return False
+
+    def _dispatch_native(self, g_count_k, e_zero_cols):
+        from karpenter_tpu import native
+
+        shared, (Gp, Ep) = self._shared_args()
+        R = len(self.snap.resources)
+        rows = g_count_k.shape[0]
+        placed_g = np.empty((rows, Gp), dtype=np.int64)
+        used = np.empty(rows, dtype=np.int64)
+        for i in range(rows):
+            e_row = self.esnap.e_avail.copy()
+            cols = e_zero_cols[i]
+            if cols is not None and len(cols):
+                e_row[cols, :] = 0.0
+            args = dict(shared)
+            args["g_count"] = pad(g_count_k[i], (Gp,))
+            args["e_avail"] = pad(e_row, (Ep, R))
+            out = native.solve_step(args, 1)
+            placed_g[i] = (
+                np.asarray(out["assign"]).sum(axis=1)
+                + np.asarray(out["assign_e"]).sum(axis=1)
+            )
+            used[i] = int(np.asarray(out["used"]).sum())
+        return placed_g, used
+
+
+def build_disruption_snapshot(provisioner, cluster, store, candidates):
+    """Assemble the shared tensor bundle for one disruption round. Returns
+    None when the device path cannot express the scenario at all (the
+    probes then fall back to the sequential search)."""
     try:
         import jax  # noqa: F401
     except Exception:
         return None
     from karpenter_tpu.utils import pod as pod_util
 
+    generation = cluster.consolidation_state()
     pending = [p for p in store.list("pods") if pod_util.is_provisionable(p)]
-    cand_pods = [list(c.reschedulable_pods) for c in candidates]
-    all_pods = pending + [p for ps in cand_pods for p in ps]
+    if any(not device_basic_eligible(p) for p in pending):
+        return None  # every counterfactual row must hold the pending pods
+
+    # candidates whose pods the kernel can't express are dropped from the
+    # bundle (not fatal): queries naming them fall back to the sequential
+    # search, everyone else still rides the shared snapshot
+    probeable, unprobeable = [], set()
+    for c in candidates:
+        pods = list(c.reschedulable_pods)
+        if any(not device_basic_eligible(p) for p in pods):
+            unprobeable.add(c.provider_id)
+        else:
+            probeable.append((c, pods))
+    all_pods = pending + [p for _, ps in probeable for p in ps]
     if not all_pods:
-        return None
-    if any(not device_basic_eligible(p) for p in all_pods):
         return None
 
     templates, its_by_pool, overhead, limits, domains = provisioner.solver_inputs()
@@ -101,7 +348,7 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
 
     # counterfactual topology: all candidate pods excluded from the cluster
     # domain counts (helpers.go:51's excluded-pod stance, applied across
-    # every prefix at once)
+    # every counterfactual at once)
     from karpenter_tpu.controllers.provisioning.provisioner import ClusterStateView
     from karpenter_tpu.models.topology import Topology
     from karpenter_tpu.ops import waves
@@ -112,12 +359,13 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
     state_nodes = list(cluster.nodes())
     enodes = provisioner._existing_nodes(state_nodes, topology)
     by_pid = {e.state_node.provider_id: i for i, e in enumerate(enodes)}
-    cand_cols = []
-    for c in candidates:
+    col_by_pid = {}
+    for c, _ in probeable:
         i = by_pid.get(c.provider_id)
         if i is None:
-            return None  # candidate invisible to the probe: stay sequential
-        cand_cols.append(i)
+            unprobeable.add(c.provider_id)  # invisible to the probe
+        else:
+            col_by_pid[c.provider_id] = i
 
     plan = None
     if topology.has_groups:
@@ -133,100 +381,228 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates):
         return None
     esnap = tensorize_existing(snap, enodes, plan)
 
-    # per-group pod counts: pending base + per-candidate contributions.
-    # Row 0 is the PREFIX-0 BASELINE (pending pods only, every node alive):
-    # feasibility is judged on the INCREMENT over it, so a pending pod that
-    # cannot schedule anywhere (and would not block the sequential path,
-    # which only requires the candidates' pods to land —
-    # SimulationResults.all_pods_scheduled) does not poison every prefix.
     gidx_of = {}
     for g, pods_g in enumerate(snap.groups):
         for p in pods_g:
             gidx_of[p.uid] = g
-    G = snap.G
-    base = np.zeros(G, dtype=np.int32)
+    # pending pods join every counterfactual row (they contend for capacity
+    # exactly as in the real simulation), but feasibility is judged PER
+    # GROUP against the candidates' contribution only — a pending pod that
+    # cannot schedule anywhere (and would not block the sequential path,
+    # which only requires the candidates' pods to land —
+    # SimulationResults.all_pods_scheduled) cannot poison the batch
+    base = np.zeros(snap.G, dtype=np.int32)
     for p in pending:
         base[gidx_of[p.uid]] += 1
+
+    return DisruptionSnapshot(
+        generation=generation,
+        build_key=frozenset(c.provider_id for c in candidates),
+        inputs=(templates, its_by_pool, overhead, limits, domains),
+        pending=pending,
+        enodes=enodes,
+        col_by_pid=col_by_pid,
+        unprobeable=unprobeable,
+        plan=plan,
+        snap=snap,
+        esnap=esnap,
+        gidx_of=gidx_of,
+        base=base,
+    )
+
+
+class SnapshotCache:
+    """Round-scoped memo of the latest :class:`DisruptionSnapshot`, keyed
+    on the cluster-state generation (see the module docstring for the full
+    invalidation contract). One instance lives on the DisruptionContext so
+    Emptiness → MultiNode → SingleNode → validation share one
+    tensorization per generation."""
+
+    def __init__(self):
+        self._bundle = None
+        self._neg = None  # (generation, build_key) of a failed build
+
+    def get(self, provisioner, cluster, store, candidates, registry=None):
+        from karpenter_tpu.operator import metrics as m
+
+        generation = cluster.consolidation_state()
+        key = frozenset(c.provider_id for c in candidates)
+        b = self._bundle
+        if b is not None and b.generation == generation and key <= b.build_key:
+            if registry is not None:
+                registry.counter(
+                    m.DISRUPTION_SNAPSHOT_CACHE_HITS,
+                    "disruption probes served from the snapshot cache",
+                ).inc(kind="snapshot")
+            return b
+        if self._neg == (generation, key):
+            # an inexpressible build is generation-stable: don't re-pay the
+            # assembly for every method in the round. Counted under its own
+            # label — a permanently-inexpressible cluster must not read as
+            # a healthy snapshot cache on the scrape
+            if registry is not None:
+                registry.counter(
+                    m.DISRUPTION_SNAPSHOT_CACHE_HITS,
+                    "disruption probes served from the snapshot cache",
+                ).inc(kind="negative")
+            return None
+        if registry is not None:
+            registry.counter(
+                m.DISRUPTION_SNAPSHOT_CACHE_MISSES,
+                "disruption snapshot rebuilds (generation bump or wider "
+                "candidate set)",
+            ).inc()
+        b = build_disruption_snapshot(provisioner, cluster, store, candidates)
+        if b is not None:
+            self._bundle = b
+            self._neg = None
+        else:
+            self._neg = (generation, key)
+        return b
+
+    def inputs_for(self, cluster):
+        """The cached solver inputs when still generation-current, else
+        None — lets the confirming simulations skip re-assembling
+        templates/catalog/overhead inside one disruption round. Safe
+        because every structural input change bumps the generation and the
+        catalog objects are shared by identity."""
+        b = self._bundle
+        if (
+            b is not None
+            and cluster is not None
+            and b.generation == cluster.consolidation_state()
+        ):
+            return b.inputs
+        return None
+
+
+def _bundle_for(provisioner, cluster, store, candidates, cache, registry,
+                build_candidates):
+    build = build_candidates if build_candidates else list(candidates)
+    if cache is not None:
+        return cache.get(provisioner, cluster, store, build, registry=registry)
+    return build_disruption_snapshot(provisioner, cluster, store, build)
+
+
+def batched_feasible_prefix(provisioner, cluster, store, candidates,
+                            cache=None, registry=None, build_candidates=None):
+    """Largest k such that candidates[:k] consolidate into the remaining
+    cluster plus at most one fresh claim, decided in one device call.
+    Returns None when the probe cannot express the scenario (the caller
+    falls back to the sequential binary search)."""
+    bundle = _bundle_for(
+        provisioner, cluster, store, candidates, cache, registry,
+        build_candidates,
+    )
+    if bundle is None:
+        return None
+    cols = bundle.columns_for(candidates)
+    if cols is None:
+        return None
+    contrib = bundle.contribs_for(candidates)
+    if contrib is None:
+        return None
+
+    base = bundle.base
     N = len(candidates)
-    contrib = np.zeros((N, G), dtype=np.int32)
-    for j, ps in enumerate(cand_pods):
-        for p in ps:
-            contrib[j, gidx_of[p.uid]] += 1
-    g_count_k = np.concatenate(
-        [base[None, :], base[None, :] + np.cumsum(contrib, axis=0)], axis=0
-    )  # [N+1,G]: row 0 = baseline, row k = prefix k
+    G = bundle.snap.G
+    cum = np.cumsum(contrib, axis=0)  # [N,G]: row k = prefix k+1's candidate pods
+    g_count_k = base[None, :] + cum  # pending pods contend exactly as in the real sim
+    col_arr = np.asarray(cols, dtype=np.intp)
+    # row k removes candidates[:k+1] (views into one array, not copies)
+    e_zero_cols = [col_arr[: k + 1] for k in range(N)]
 
-    E = esnap.E
-    e_avail_k = np.repeat(esnap.e_avail[None, :, :], N + 1, axis=0)  # [N+1,E,R]
-    for j in range(N):
-        for col in cand_cols[: j + 1]:
-            e_avail_k[j + 1, col, :] = 0.0
-
-    # shared args padded once; the batch axis buckets so XLA compiles per
-    # shape family, not per candidate count
-    Np = _bucket(N + 1, lo=4)
-    Gp, Ep = _bucket(G, lo=8), _bucket(E, lo=8)
-    Tp = _bucket(snap.T, lo=8)
-
-    R = len(snap.resources)
-    M = len(snap.templates)
-    K = len(snap.keys)
-    # NOTE: keep this assembly in lockstep with models/solver.py
-    # _run_and_decode's args dict — a field missed here silently weakens
-    # the probe (it under- or over-estimates and burns the dispatch)
-    shared = dict(
-        g_mask=pad(snap.g_mask, (Gp,) + snap.g_mask.shape[1:]),
-        g_has=pad(snap.g_has, (Gp,) + snap.g_has.shape[1:]),
-        g_tol=pad(snap.g_tol, (Gp, K)),
-        g_demand=pad(snap.g_demand, (Gp, R)),
-        g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
-        g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
-        g_tmpl_ok=pad(snap.g_tmpl_ok, (Gp, M)),
-        g_bin_cap=pad(snap.g_bin_cap, (Gp,)),
-        g_single=pad(snap.g_single, (Gp,)),
-        g_decl=pad(snap.g_decl, (Gp, snap.g_decl.shape[1])),
-        g_match=pad(snap.g_match, (Gp, snap.g_match.shape[1])),
-        g_sown=pad(snap.g_sown, (Gp, snap.g_sown.shape[1])),
-        g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
-        g_aneed=pad(snap.g_aneed, (Gp, snap.g_aneed.shape[1])),
-        g_amatch=pad(snap.g_amatch, (Gp, snap.g_amatch.shape[1])),
-        ge_ok=pad(esnap.ge_ok, (Gp, Ep)),
-        e_npods=pad(esnap.e_npods, (Ep,)),
-        e_scnt=pad(esnap.e_scnt, (Ep, esnap.e_scnt.shape[1])),
-        e_decl=pad(esnap.e_decl, (Ep, esnap.e_decl.shape[1])),
-        e_match=pad(esnap.e_match, (Ep, esnap.e_match.shape[1])),
-        e_aff=pad(esnap.e_aff, (Ep, esnap.e_aff.shape[1])),
-        t_mask=pad(snap.t_mask, (Tp,) + snap.t_mask.shape[1:]),
-        t_has=pad(snap.t_has, (Tp,) + snap.t_has.shape[1:]),
-        t_tol=pad(snap.t_tol, (Tp, K)),
-        t_alloc=pad(snap.t_alloc, (Tp, R)),
-        t_cap=pad(snap.t_cap, (Tp, R)),
-        t_tmpl=pad(snap.t_tmpl, (Tp,)),
-        off_zone=pad(snap.off_zone, (Tp, snap.off_zone.shape[1]), fill=-1),
-        off_ct=pad(snap.off_ct, (Tp, snap.off_ct.shape[1]), fill=-1),
-        off_avail=pad(snap.off_avail, (Tp, snap.off_avail.shape[1])),
-        off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
-        m_mask=snap.m_mask,
-        m_has=snap.m_has,
-        m_tol=snap.m_tol,
-        m_overhead=snap.m_overhead,
-        m_limits=snap.m_limits,
-        m_minv=snap.m_minv,
-    )
-    varying = dict(
-        g_count=pad(g_count_k, (Np, Gp)),
-        e_avail=pad(e_avail_k, (Np, Ep, R)),
-    )
-
-    max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
-    placed, _used = _batched_kernel(1, max_minv)(varying, shared)
-    placed = np.asarray(placed)[: N + 1]
-    need = g_count_k.sum(axis=1)
-    # prefix k feasible iff its displaced pods ALL land on top of whatever
-    # the baseline already achieves (baseline deficit = stuck pending pods)
-    deficit0 = int(need[0] - placed[0])
-    feasible = (need[1:] - placed[1:]) <= deficit0
+    placed_g, used = bundle.dispatch(g_count_k, e_zero_cols)
+    # prefix k feasible iff EVERY group placed at least the prefix's own
+    # candidate contribution: pods within a group are spec-identical
+    # (interchangeable), so the group-wise test is exactly "all displaced
+    # pods land" — and a stuck PENDING pod, which the reference's
+    # all_pods_scheduled ignores (helpers.py SimulationResults), can never
+    # poison the batch
+    feasible = (placed_g[:, :G] >= cum).all(axis=1)
+    if bundle.plan is None:
+        # price prefilter (consolidation.go filterByPrice as a batch
+        # prune): a prefix that needs the one fresh claim can only ship if
+        # SOME available offering launches strictly cheaper than the prefix
+        # costs today; the cheapest catalog offering under-estimates the
+        # replacement price. Plan-free bundles only: the kernel fills
+        # existing nodes before opening the fresh bin, so `used` is
+        # reliable there — topology tightening can inflate it, and a wrong
+        # prune would burn the binary-search simulations the batch exists
+        # to avoid
+        prices = np.array(
+            [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64
+        )
+        # a prefix containing an unpriceable candidate aborts its replace
+        # path outright (candidate_prices' getCandidatePrices stance)
+        prefix_known = np.logical_and.accumulate(prices > 0)
+        prefix_price = np.cumsum(prices)
+        feasible &= (used == 0) | (
+            prefix_known & (bundle.min_price < prefix_price)
+        )
     ks = np.flatnonzero(feasible)
     if ks.size == 0:
         return 0
     return int(ks[-1]) + 1
+
+
+def batched_single_feasible(provisioner, cluster, store, candidates,
+                            cache=None, registry=None, build_candidates=None):
+    """Per-candidate consolidation feasibility, every candidate probed in
+    one batched device call: counterfactual c removes ONLY candidate c and
+    asks whether its reschedulable pods land on the surviving nodes plus at
+    most one fresh claim.
+
+    Returns ``(mask, definitive)`` — a bool array over ``candidates``
+    (probe hits are SEEDS for the real confirming simulation) and whether
+    the MISSES may be trusted: for topology-compiled bundles the waves
+    counterfactual approximation can tighten the probe (module docstring),
+    and unlike the prefix probe there is no binary-search recovery around a
+    mis-estimated candidate, so non-definitive misses must be re-checked by
+    the caller's sequential scan rather than skipped. Returns None when the
+    scenario is inexpressible (the caller falls back to the sequential
+    scan)."""
+    bundle = _bundle_for(
+        provisioner, cluster, store, candidates, cache, registry,
+        build_candidates,
+    )
+    if bundle is None:
+        return None
+    cols = bundle.columns_for(candidates)
+    if cols is None:
+        return None
+    contrib = bundle.contribs_for(candidates)
+    if contrib is None:
+        return None
+
+    base = bundle.base
+    N = len(candidates)
+    G = bundle.snap.G
+    g_count_k = base[None, :] + contrib  # [N,G]
+    col_arr = np.asarray(cols, dtype=np.intp)
+    # row c removes ONLY candidate c
+    e_zero_cols = [col_arr[c : c + 1] for c in range(N)]
+
+    placed_g, used = bundle.dispatch(g_count_k, e_zero_cols)
+    # same group-wise criterion as the prefix probe: candidate c's pods all
+    # land iff every group places at least c's contribution (stuck pending
+    # pods are not the candidate's problem — all_pods_scheduled checks only
+    # candidate pods)
+    mask = (placed_g[:, :G] >= contrib).all(axis=1)
+    if bundle.plan is None:
+        # price prefilter, mirroring the prefix probe: a candidate whose
+        # pods need the one fresh claim only consolidates if SOME available
+        # offering could launch strictly cheaper than the candidate costs
+        # today (an unpriceable candidate aborts the replace path
+        # outright); a used==0 counterfactual is a pure delete — no price
+        # involved. Plan-free bundles only: the kernel fills existing nodes
+        # before opening the fresh bin, so `used` is reliable there, while
+        # a topology bundle's tightened fit can inflate it — which is
+        # exactly why those misses are flagged non-definitive.
+        prices = np.array(
+            [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64
+        )
+        mask = mask & (
+            (used == 0) | ((prices > 0) & (bundle.min_price < prices))
+        )
+    return mask, bundle.plan is None

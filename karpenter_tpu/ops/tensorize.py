@@ -315,6 +315,83 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
     )
 
 
+def kernel_args(snap: DeviceSnapshot, esnap: "ExistingSnapshot | None" = None,
+                Gp: int | None = None, Tp: int | None = None,
+                Ep: int | None = None, include_counts: bool = True) -> dict:
+    """Padded solve_step argument dict — the ONE assembly point shared by
+    the full solve (models/solver.py) and the batched consolidation probes
+    (ops/consolidate.py). Before this helper each caller assembled its own
+    dict and they drifted (g_tol/t_tol/m_tol were once dropped from the
+    probe and tainted pools read as intolerable); the lockstep guard in
+    tests/test_batched_consolidation.py pins the family list.
+
+    ``include_counts=False`` omits ``g_count``/``e_avail`` — the probes
+    carry those on the vmapped batch axis instead of the shared snapshot.
+
+    Padded types are infeasible by construction: zero allocatable fails
+    every fit (pods >= 1) and their offerings carry the -1 "no domain"
+    sentinel. Padded group rows have count 0, so their sown=0 cap is inert.
+    """
+    K = snap.g_mask.shape[1]
+    W = snap.W
+    R = len(snap.resources)
+    M = len(snap.templates)
+    if Gp is None:
+        Gp = bucket(snap.G)
+    if Tp is None:
+        Tp = bucket(snap.T)
+    pad = pad_to
+    args = dict(
+        g_mask=pad(snap.g_mask, (Gp, K, W)),
+        g_has=pad(snap.g_has, (Gp, K)),
+        g_tol=pad(snap.g_tol, (Gp, K)),
+        g_demand=pad(snap.g_demand, (Gp, R)),
+        g_zone_allowed=pad(snap.g_zone_allowed, (Gp, snap.g_zone_allowed.shape[1])),
+        g_ct_allowed=pad(snap.g_ct_allowed, (Gp, snap.g_ct_allowed.shape[1])),
+        g_tmpl_ok=pad(snap.g_tmpl_ok, (Gp, M)),
+        g_bin_cap=pad(snap.g_bin_cap, (Gp,)),
+        g_single=pad(snap.g_single, (Gp,)),
+        g_decl=pad(snap.g_decl, (Gp, snap.g_decl.shape[1])),
+        g_match=pad(snap.g_match, (Gp, snap.g_match.shape[1])),
+        g_sown=pad(snap.g_sown, (Gp, snap.g_sown.shape[1])),
+        g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
+        g_aneed=pad(snap.g_aneed, (Gp, snap.g_aneed.shape[1])),
+        g_amatch=pad(snap.g_amatch, (Gp, snap.g_amatch.shape[1])),
+        t_mask=pad(snap.t_mask, (Tp, K, W)),
+        t_has=pad(snap.t_has, (Tp, K)),
+        t_tol=pad(snap.t_tol, (Tp, K)),
+        t_alloc=pad(snap.t_alloc, (Tp, R)),
+        t_cap=pad(snap.t_cap, (Tp, R)),
+        t_tmpl=pad(snap.t_tmpl, (Tp,)),
+        off_zone=pad(snap.off_zone, (Tp, snap.off_zone.shape[1]), fill=-1),
+        off_ct=pad(snap.off_ct, (Tp, snap.off_ct.shape[1]), fill=-1),
+        off_avail=pad(snap.off_avail, (Tp, snap.off_avail.shape[1])),
+        off_price=pad(snap.off_price, (Tp, snap.off_price.shape[1])),
+        m_mask=snap.m_mask,
+        m_has=snap.m_has,
+        m_tol=snap.m_tol,
+        m_overhead=snap.m_overhead,
+        m_limits=snap.m_limits,
+        m_minv=snap.m_minv,
+    )
+    if include_counts:
+        args["g_count"] = pad(snap.g_count, (Gp,))
+    if esnap is not None:
+        if Ep is None:
+            Ep = bucket(max(esnap.E, 1), lo=8)
+        args.update(
+            ge_ok=pad(esnap.ge_ok, (Gp, Ep)),
+            e_npods=pad(esnap.e_npods, (Ep,)),
+            e_scnt=pad(esnap.e_scnt, (Ep, esnap.e_scnt.shape[1])),
+            e_decl=pad(esnap.e_decl, (Ep, esnap.e_decl.shape[1])),
+            e_match=pad(esnap.e_match, (Ep, esnap.e_match.shape[1])),
+            e_aff=pad(esnap.e_aff, (Ep, esnap.e_aff.shape[1])),
+        )
+        if include_counts:
+            args["e_avail"] = pad(esnap.e_avail, (Ep, R))
+    return args
+
+
 def pod_signature(pod) -> tuple:
     """Scheduling-equivalence key for pod deduplication.
 

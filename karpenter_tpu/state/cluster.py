@@ -55,8 +55,11 @@ class Cluster:
                 self.delete_pod(obj)
             else:
                 self.update_pod(obj)
-        elif kind == "nodepools":
-            # any nodepool change can change the consolidation answer
+        elif kind in ("nodepools", "daemonsets"):
+            # any nodepool or daemonset change can change the consolidation
+            # answer (templates, budgets, daemon overhead) — and both feed
+            # the solver inputs cached by the disruption snapshot cache
+            # (ops/consolidate.py), whose generation key is this counter
             self.mark_unconsolidated()
 
     def resync(self):
@@ -171,11 +174,17 @@ class Cluster:
                 and pod.affinity.pod_anti_affinity.required
             ):
                 self._antiaffinity_pods[key] = pod
-            self.mark_unconsolidated()
         elif pod.node_name and bound == pod.node_name:
             sn = self._node_by_name(pod.node_name)
             if sn is not None:
                 sn.pods[key] = pod  # refresh the stored object
+        # EVERY non-delete pod event bumps the generation — a new binding,
+        # a refreshed bound object (labels/tolerations/topology changes the
+        # cached disruption snapshot tensorized from the old object), or an
+        # unbound pending pod joining the counterfactual baseline. The
+        # consolidation_state() contract makes this mandatory; keeping the
+        # bump unconditional means a future branch cannot silently miss it.
+        self.mark_unconsolidated()
 
     def delete_pod(self, pod):
         key = pod.key()
@@ -269,5 +278,11 @@ class Cluster:
     def consolidation_state(self) -> int:
         """Fence for consolidation decisions: if unchanged since the last
         fruitless consolidation round, nothing relevant moved and the
-        search can be skipped (consolidation.go isConsolidated)."""
+        search can be skipped (consolidation.go isConsolidated).
+
+        This counter doubles as the GENERATION KEY of the disruption
+        snapshot cache (ops/consolidate.py SnapshotCache): a tensorized
+        cluster view is valid exactly as long as this value is unchanged,
+        so every informer mutation that can change a scheduling answer
+        must bump it."""
         return self._state_seq

@@ -1,0 +1,12 @@
+"""`python -m perf` — the BASELINE benchmark driver (perf/run.py).
+
+Same CLI as `python perf/run.py`: no args runs all five configs, numeric
+args pick a subset (`python -m perf 4` is the consolidation benchmark, node
+count via PERF_CONSOLIDATION_NODES), `grid` / `grid-mv` run the reference
+benchmark grid.
+"""
+
+from perf.run import main
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,11 @@
 """Run the 5 BASELINE benchmark configs + the reference benchmark grid.
 
 Usage:
-    python perf/run.py              # all 5 configs
-    python perf/run.py 1 3 5       # a subset
-    python perf/run.py grid        # the reference {1..5000}x400 grid
+    python -m perf                 # all 5 configs (also: python perf/run.py)
+    python -m perf 1 3 5           # a subset
+    python -m perf 4               # the consolidation benchmark alone
+                                   # (PERF_CONSOLIDATION_NODES=300 default)
+    python -m perf grid            # the reference {1..5000}x400 grid
                                    # (scheduling_benchmark_test.go:77-97)
 
 One JSON line per result: {config, pods, types, ms, pods_per_sec, nodes,
@@ -105,6 +107,9 @@ def run_consolidation_config(n_nodes=None):
     end_nodes = len(env.store.list("nodes"))
     end_pods = len([p for p in env.store.list("pods") if p.node_name])
     hist = env.registry.histogram("karpenter_disruption_evaluation_duration_seconds")
+    from karpenter_tpu.operator import metrics as m
+
+    batch_hist = env.registry.histogram(m.DISRUPTION_PROBE_BATCH_SIZE)
     print(json.dumps({
         "config": f"4-consolidation-{n_nodes}-underutilized",
         "start_nodes": start_nodes,
@@ -114,6 +119,24 @@ def run_consolidation_config(n_nodes=None):
         "rounds": rounds,
         "multinode_eval_ms_sum": round(1000 * hist.sum(method="MultiNodeConsolidation"), 2),
         "multinode_evals": hist.count(method="MultiNodeConsolidation"),
+        "singlenode_eval_ms_sum": round(1000 * hist.sum(method="SingleNodeConsolidation"), 2),
+        "singlenode_evals": hist.count(method="SingleNodeConsolidation"),
+        # snapshot-cache efficacy + probe dispatch shape (the PR-2 tentpole:
+        # one tensorization per disruption round, batched candidate ranking)
+        "snapshot_cache": {
+            "hits": env.registry.counter(
+                m.DISRUPTION_SNAPSHOT_CACHE_HITS).value(kind="snapshot"),
+            "misses": env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value(),
+        },
+        "probe_batches": {
+            "multi": batch_hist.count(method="multi"),
+            "single": batch_hist.count(method="single"),
+            "rows_sum": round(batch_hist.sum(method="multi") + batch_hist.sum(method="single")),
+        },
+        "probe_fallbacks": (
+            env.registry.counter(m.DISRUPTION_PROBE_FAILURES).value(method="multi")
+            + env.registry.counter(m.DISRUPTION_PROBE_FAILURES).value(method="single")
+        ),
         # reference budget: ≤60s per multi-node search (multinodeconsolidation.go:37)
         "within_1min_budget": bool(hist.sum(method="MultiNodeConsolidation") <= 60.0),
     }))

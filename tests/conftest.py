@@ -41,3 +41,13 @@ try:
         getattr(xla_bridge, "_backend_factories", {}).pop(_plat, None)
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP.md): the marker must be declared
+    # or every slow-marked benchmark warns as unknown
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running benchmarks excluded from the tier-1 gate "
+        "(run explicitly or via python -m perf)",
+    )

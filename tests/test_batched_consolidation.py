@@ -32,7 +32,7 @@ def compute(env, force_sequential=False):
     d = env.disruption
     method = mnc(env)
     if force_sequential:
-        method._probe = lambda cands: None
+        method._probe = lambda cands, pool=None: None
     candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock, queue=d.queue)
     budgets = build_disruption_budgets(d.cluster, d.store, d.clock)
     cmd = method.compute_command(candidates, budgets)

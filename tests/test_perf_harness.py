@@ -4,6 +4,8 @@ while preserving the workload (runs on the CPU mesh via conftest)."""
 
 import sys
 
+import pytest
+
 sys.path.insert(0, ".")
 
 from perf import configs as C  # noqa: E402
@@ -66,3 +68,32 @@ class TestConfigs:
         bound = len([p for p in env.store.list("pods") if p.node_name])
         assert end < start, f"no consolidation ({start}->{end})"
         assert bound == 6, f"workload lost: {bound}/6 pods bound"
+
+
+@pytest.mark.slow
+class TestConsolidationMicroBench:
+    """The 300-node consolidation micro-benchmark (python -m perf 4) as a
+    slow-marked test, so the PERF trajectory's #2 kernel is runnable from
+    the suite: the fleet must consolidate 3:1 with the workload preserved,
+    the disruption rounds must ride the batched probes (device, not the
+    sequential scans), and the snapshot cache must actually serve hits."""
+
+    def test_300_node_consolidation_bench(self, capsys, monkeypatch):
+        import json
+
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+        from perf.run import run_consolidation_config
+
+        # measure the SHIPPED engine routing: conftest pins
+        # KARPENTER_NATIVE_CUTOFF=0 so unit tests keep the XLA kernel under
+        # coverage, but the benchmark exists to track the production path
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        run_consolidation_config(300)
+        out = capsys.readouterr().out
+        data = json.loads(out.strip().splitlines()[-1])
+        assert data["end_nodes"] == 100, data
+        assert data["pods_bound"][0] == data["pods_bound"][1] == 300, data
+        assert data["probe_fallbacks"] == 0, data
+        assert data["probe_batches"]["single"] >= 1, data
+        assert data["snapshot_cache"]["hits"] >= 1, data
+        assert data["within_1min_budget"], data

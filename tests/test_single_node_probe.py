@@ -1,0 +1,369 @@
+"""Batched single-node consolidation probe + the disruption snapshot cache.
+
+The PR-2 tentpole: SingleNodeConsolidation's linear scan
+(singlenodeconsolidation.go:46-120) runs as ONE batched device dispatch
+(ops/consolidate.py batched_single_feasible) over the round's shared
+snapshot, with probe hits confirmed by the real simulation. The parity
+suite randomizes clusters with test_chaos.py's seeding discipline and
+requires the device decision (candidate chosen / none) to equal the
+sequential scan's; the cache suite proves one tensorization serves both
+probes per cluster-state generation and that a store mutation between
+methods forces a re-tensorize.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api.nodeclaim import COND_EMPTY
+from karpenter_tpu.api.nodepool import (
+    CONSOLIDATION_WHEN_EMPTY,
+    NodePool,
+)
+from karpenter_tpu.api.objects import Deployment, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.controllers.disruption.helpers import (
+    build_disruption_budgets,
+    get_candidates,
+)
+from karpenter_tpu.controllers.disruption.methods import (
+    Emptiness,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator import metrics as m
+
+GIB = 2**30
+
+
+def build_random_env(seed):
+    """A seeded random fleet scaled down to underutilization — the
+    test_chaos.py recipe (seeded rng, deployment churn) minus the fault
+    injection, so the consolidation answer is deterministic per seed."""
+    rng = random.Random(seed)
+    env = Environment(
+        instance_types=[
+            make_instance_type("small", 4, 16),
+            make_instance_type("large", 16, 64),
+        ],
+        enable_disruption=True,
+    )
+    env.disruption.poll_period = float("inf")  # drive polls by hand
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    pool.spec.disruption.consolidate_after = 0.0
+    pool.spec.disruption.budgets[0].nodes = "100%"
+    env.create("nodepools", pool)
+    deploys = []
+    for i in range(rng.randint(4, 7)):
+        d = Deployment(
+            metadata=ObjectMeta(name=f"d{i}"),
+            replicas=rng.randint(2, 4),
+            template=Pod(
+                metadata=ObjectMeta(name=f"d{i}", labels={"app": f"d{i}"}),
+                requests={"cpu": rng.choice([1.0, 2.0, 5.0]),
+                          "memory": rng.choice([1, 2, 4]) * GIB},
+            ),
+        )
+        deploys.append(d)
+        env.create("deployments", d)
+    env.run_until_idle(max_rounds=200)
+    for d in deploys:
+        d.replicas = max(1, d.replicas - rng.randint(1, 3))
+        env.store.update("deployments", d)
+    env.run_until_idle(max_rounds=200)
+    return env
+
+
+def round_inputs(env):
+    d = env.disruption
+    candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock, queue=d.queue)
+    budgets = build_disruption_budgets(d.cluster, d.store, d.clock)
+    return candidates, budgets
+
+
+def single_method(env):
+    return next(
+        mth for mth in env.disruption.methods
+        if isinstance(mth, SingleNodeConsolidation)
+    )
+
+
+class TestSingleNodeProbeParity:
+    @pytest.mark.parametrize("seed", [3, 11, 42, 99])
+    def test_device_decision_matches_sequential_scan(self, seed):
+        env = build_random_env(seed)
+        method = single_method(env)
+        candidates, budgets = round_inputs(env)
+
+        cmd_dev = method.compute_command(list(candidates), budgets)
+        assert method.last_probe == "device"
+        method._probe = lambda cands, pool=None: None
+        cmd_seq = method.compute_command(list(candidates), budgets)
+        assert method.last_probe == "sequential"
+
+        assert (cmd_dev is None) == (cmd_seq is None), (
+            f"seed {seed}: device={cmd_dev} sequential={cmd_seq}"
+        )
+        if cmd_dev is not None:
+            assert [c.name for c in cmd_dev.candidates] == [
+                c.name for c in cmd_seq.candidates
+            ]
+            assert len(cmd_dev.replacements) == len(cmd_seq.replacements)
+
+    def test_probe_ranks_whole_fleet_in_one_batch(self):
+        env = build_random_env(7)
+        method = single_method(env)
+        candidates, budgets = round_inputs(env)
+        method.compute_command(list(candidates), budgets)
+        assert method.last_probe == "device"
+        hist = env.registry.histogram(m.DISRUPTION_PROBE_BATCH_SIZE)
+        assert hist.count(method="single") == 1
+        # the one dispatch carried a counterfactual row per candidate
+        assert hist.sum(method="single") == len(candidates)
+
+    def test_topology_misses_rescanned_sequentially(self):
+        """Topology-compiled bundles flag their misses non-definitive (the
+        waves counterfactual can tighten the probe): the device decision
+        must still equal the sequential scan's because unconfirmed misses
+        get the reference's scan instead of being trusted."""
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.api.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+
+        env = build_random_env(11)
+        pods = [p for p in env.store.list("pods") if p.node_name]
+        assert pods
+        for p in pods[:2]:
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "x"}))]
+            p.metadata.labels["app"] = "x"
+            env.store.update("pods", p)
+        method = single_method(env)
+        candidates, budgets = round_inputs(env)
+        cmd_dev = method.compute_command(list(candidates), budgets)
+        probe_dev = method.last_probe
+        method._probe = lambda cands, pool=None: None
+        cmd_seq = method.compute_command(list(candidates), budgets)
+        assert probe_dev == "device"
+        assert (cmd_dev is None) == (cmd_seq is None)
+        if cmd_dev is not None:
+            assert [c.name for c in cmd_dev.candidates] == [
+                c.name for c in cmd_seq.candidates
+            ]
+
+    def test_probe_falls_back_without_device_solver(self):
+        from karpenter_tpu.models.solver import HostSolver
+
+        env = build_random_env(3)
+        method = single_method(env)
+        candidates, budgets = round_inputs(env)
+        env.provisioner.solver = HostSolver()  # not a TPUSolver
+        method.compute_command(list(candidates), budgets)
+        assert method.last_probe == "sequential"
+
+
+class TestSnapshotCache:
+    def test_one_tensorization_serves_both_probes(self):
+        env = build_random_env(5)
+        d = env.disruption
+        candidates, budgets = round_inputs(env)
+        multi = next(
+            mth for mth in d.methods if isinstance(mth, MultiNodeConsolidation)
+        )
+        single = single_method(env)
+        multi.compute_command(list(candidates), budgets)
+        single.compute_command(list(candidates), budgets)
+        assert multi.last_probe == "device" and single.last_probe == "device"
+        hits = env.registry.counter(
+            m.DISRUPTION_SNAPSHOT_CACHE_HITS).value(kind="snapshot")
+        misses = env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value()
+        assert misses == 1, "the round must tensorize exactly once"
+        assert hits >= 1, "the second probe must ride the cached snapshot"
+
+    def test_store_mutation_bumps_generation_and_forces_retensorize(self):
+        env = build_random_env(5)
+        d = env.disruption
+        cache = d.ctx.snapshot_cache
+        candidates, _ = round_inputs(env)
+        b1 = cache.get(d.provisioner, d.cluster, d.store, candidates,
+                       registry=env.registry)
+        assert b1 is not None
+        b2 = cache.get(d.provisioner, d.cluster, d.store, candidates,
+                       registry=env.registry)
+        assert b2 is b1, "same generation: the bundle must be reused"
+
+        # a store mutation flowing the informer path bumps the generation
+        pod = next(p for p in env.store.list("pods") if p.node_name)
+        env.store.delete("pods", pod)
+        for event in env.store.drain_events():
+            env.cluster.on_event(event)
+
+        b3 = cache.get(d.provisioner, d.cluster, d.store, candidates,
+                       registry=env.registry)
+        assert b3 is not b1, "generation bump must force a re-tensorize"
+        assert b3 is not None and b3.generation > b1.generation
+        misses = env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value()
+        assert misses == 2
+
+    def test_negative_serve_counted_separately(self, monkeypatch):
+        """A generation-stable failed build is served from the negative
+        cache under its own label — a permanently-inexpressible cluster
+        must not read as a healthy snapshot cache on the scrape."""
+        from karpenter_tpu.ops import consolidate as cons
+
+        env = build_random_env(3)
+        d = env.disruption
+        cache = d.ctx.snapshot_cache
+        candidates, _ = round_inputs(env)
+        monkeypatch.setattr(cons, "build_disruption_snapshot",
+                            lambda *a, **kw: None)
+        reg = env.registry
+        assert cache.get(d.provisioner, d.cluster, d.store, candidates,
+                         registry=reg) is None
+        assert cache.get(d.provisioner, d.cluster, d.store, candidates,
+                         registry=reg) is None
+        hits = reg.counter(m.DISRUPTION_SNAPSHOT_CACHE_HITS)
+        assert hits.value(kind="snapshot") == 0
+        assert hits.value(kind="negative") == 1
+        assert reg.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value() == 1
+
+    def test_inputs_for_declines_after_generation_bump(self):
+        env = build_random_env(5)
+        d = env.disruption
+        cache = d.ctx.snapshot_cache
+        candidates, _ = round_inputs(env)
+        assert cache.get(d.provisioner, d.cluster, d.store, candidates) is not None
+        assert cache.inputs_for(d.cluster) is not None
+        env.cluster.mark_unconsolidated()
+        assert cache.inputs_for(d.cluster) is None
+
+    def test_daemonset_event_bumps_generation(self):
+        """Daemonset changes alter the solver inputs (daemon overhead), so
+        they must invalidate the snapshot cache like nodepool changes do."""
+        from karpenter_tpu.api.objects import DaemonSet
+
+        env = build_random_env(5)
+        before = env.cluster.consolidation_state()
+        ds = DaemonSet(metadata=ObjectMeta(name="logging"),
+                       template=Pod(metadata=ObjectMeta(name="log"),
+                                    requests={"cpu": 0.1}))
+        env.store.create("daemonsets", ds)
+        for event in env.store.drain_events():
+            env.cluster.on_event(event)
+        assert env.cluster.consolidation_state() > before
+
+
+class TestUnknownPriceAbort:
+    """candidate_prices: an unknown (<= 0) candidate price aborts the
+    replacement path instead of silently understating current cost."""
+
+    def _ctx_and_sim(self, monkeypatch, replacement):
+        from types import SimpleNamespace
+
+        from karpenter_tpu.controllers.disruption import methods as methods_mod
+        from karpenter_tpu.controllers.disruption.controller import DisruptionContext
+        from karpenter_tpu.utils.clock import FakeClock
+
+        ctx = DisruptionContext(
+            provisioner=SimpleNamespace(), cluster=None, store=None,
+            clock=FakeClock(start=0.0), registry=m.Registry(),
+        )
+        sim = SimpleNamespace(
+            new_claims=[replacement] if replacement is not None else [],
+            all_pods_scheduled=lambda: True,
+        )
+        monkeypatch.setattr(methods_mod, "simulate_scheduling",
+                            lambda *a, **kw: sim)
+        return ctx
+
+    def _candidate(self, price):
+        from types import SimpleNamespace
+
+        from karpenter_tpu.api import labels as wk
+
+        return SimpleNamespace(
+            name=f"node-{price}", provider_id=f"pid-{price}",
+            reschedulable_pods=[], instance_type=None, price=price,
+            capacity_type=wk.CAPACITY_TYPE_ON_DEMAND,
+        )
+
+    def test_unknown_price_aborts_replacement(self, monkeypatch):
+        from types import SimpleNamespace
+
+        from karpenter_tpu.controllers.disruption import methods as methods_mod
+        from karpenter_tpu.scheduling import Requirements
+
+        replacement = SimpleNamespace(
+            instance_types=[make_instance_type("nano", 1, 2)],
+            requirements=Requirements(),
+        )
+        ctx = self._ctx_and_sim(monkeypatch, replacement)
+        cands = [self._candidate(1.0), self._candidate(0.0)]  # one unknown
+        assert methods_mod.compute_consolidation(ctx, cands) is None
+
+    def test_known_prices_still_replace(self, monkeypatch):
+        from types import SimpleNamespace
+
+        from karpenter_tpu.controllers.disruption import methods as methods_mod
+        from karpenter_tpu.scheduling import Requirements
+
+        replacement = SimpleNamespace(
+            instance_types=[make_instance_type("nano", 1, 2)],
+            requirements=Requirements(),
+        )
+        ctx = self._ctx_and_sim(monkeypatch, replacement)
+        cands = [self._candidate(1.0), self._candidate(2.0)]
+        cmd = methods_mod.compute_consolidation(ctx, cands)
+        assert cmd is not None and cmd.action == "replace"
+
+    def test_unknown_price_delete_only_still_allowed(self, monkeypatch):
+        """The reference checks prices only on the replace path
+        (consolidation.go: the delete branch precedes getCandidatePrices):
+        deleting an unpriceable empty-ish node stays legal."""
+        from karpenter_tpu.controllers.disruption import methods as methods_mod
+
+        ctx = self._ctx_and_sim(monkeypatch, None)  # sim yields 0 new claims
+        cands = [self._candidate(0.0)]
+        cmd = methods_mod.compute_consolidation(ctx, cands)
+        assert cmd is not None and cmd.action == "delete"
+
+    def test_candidate_prices_helper(self):
+        from karpenter_tpu.controllers.disruption.methods import candidate_prices
+
+        assert candidate_prices([self._candidate(1.0), self._candidate(2.5)]) == 3.5
+        assert candidate_prices([self._candidate(1.0), self._candidate(0.0)]) is None
+        assert candidate_prices([self._candidate(-1.0)]) is None
+
+
+class TestEmptinessTransitionGuard:
+    def test_unset_transition_time_is_not_yet_eligible(self):
+        """An Empty condition whose last_transition_time is unset must read
+        as "not yet eligible" instead of raising mid-ladder."""
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+        )
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pool.spec.disruption.consolidation_policy = CONSOLIDATION_WHEN_EMPTY
+        pool.spec.disruption.consolidate_after = 30.0
+        env.create("nodepools", pool)
+        (p,) = env.provision(Pod(metadata=ObjectMeta(name="p1"),
+                                 requests={"cpu": 0.5}))
+        env.store.delete("pods", p)
+        env.run_until_idle()
+        claim = env.store.list("nodeclaims")[0]
+        assert claim.is_true(COND_EMPTY)
+        claim.get_condition(COND_EMPTY).last_transition_time = None
+
+        env.clock.step(120.0)  # far past consolidate_after
+        method = Emptiness(env.disruption.ctx)
+        candidates, budgets = round_inputs(env)
+        assert method.compute_command(candidates, budgets) is None  # no raise
+        # and the ladder as a whole survives the malformed condition
+        env.run_until_idle()
+        assert env.store.list("nodeclaims"), "node must NOT be deleted yet"
