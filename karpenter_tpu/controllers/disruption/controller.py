@@ -94,13 +94,21 @@ class DisruptionController:
         ]
         self._last_run: float = -1e18
         self._pending = None  # (command, method, computed_at)
+        # per-nodepool instance-type catalog memo for candidate discovery
+        # (helpers.get_candidates): cleared on nodepool events, shared by
+        # compute and validate so repeated rounds stop re-listing the
+        # cloud provider. Offering mutations stay visible — the catalog
+        # objects are shared by identity.
+        self._catalog_cache: dict = {}
         # fence from the last consolidation round that found nothing: while
         # cluster state is unchanged, re-searching is pointless
         # (consolidation.go isConsolidated)
         self._noop_fence = None
 
     def on_event(self, event):
-        pass
+        if event.kind == "nodepools":
+            # a nodepool change can change which instance types it may use
+            self._catalog_cache.clear()
 
     def poll(self) -> bool:
         progressed = self.queue.poll()
@@ -161,7 +169,8 @@ class DisruptionController:
         from karpenter_tpu.operator import metrics as m
 
         candidates = get_candidates(
-            self.cluster, self.store, self.cloud, self.clock, queue=self.queue
+            self.cluster, self.store, self.cloud, self.clock, queue=self.queue,
+            catalog_cache=self._catalog_cache,
         )
         self.registry.gauge(m.DISRUPTION_ELIGIBLE_NODES, "disruptable candidates").set(
             len(candidates))
@@ -208,7 +217,8 @@ class DisruptionController:
         fresh = {
             c.provider_id: c
             for c in get_candidates(
-                self.cluster, self.store, self.cloud, self.clock, queue=self.queue
+                self.cluster, self.store, self.cloud, self.clock, queue=self.queue,
+                catalog_cache=self._catalog_cache,
             )
         }
         spent: dict = {}
@@ -229,11 +239,19 @@ class DisruptionController:
             # simulation allows — a cheaper type that vanished (ICE'd,
             # price change) during the validation TTL invalidates the
             # command (validation.go:186: command types ⊆ fresh-sim types)
+            # refresh FIRST: a successful delta-advance makes the bundle
+            # generation-current, so inputs_for then serves the cached
+            # solver inputs instead of a redundant re-assembly. After
+            # _execute bumped the state, either path reflects every mark
+            # the execute applied (delta-advanced or declined → rebuilt).
+            bundle = self.ctx.snapshot_cache.refresh(
+                self.provisioner, self.cluster, self.store,
+                registry=self.registry,
+            )
             sim = simulate_scheduling(
                 self.provisioner, self.cluster, self.store, list(cmd.candidates),
-                # generation-checked: after _execute bumped the state the
-                # cache declines and the validation re-assembles fresh inputs
                 inputs=self.ctx.snapshot_cache.inputs_for(self.cluster),
+                bundle=bundle,
             )
             if not sim.all_pods_scheduled() or len(sim.new_claims) > len(cmd.replacements):
                 return False

@@ -17,11 +17,21 @@ from karpenter_tpu.utils import pod as pod_util
 from karpenter_tpu.utils.pdb import PdbLimits
 
 
-def get_candidates(cluster, store, cloud, clock, queue=None) -> list:
-    """Disruptable nodes as Candidates (helpers.go:146)."""
+def get_candidates(cluster, store, cloud, clock, queue=None,
+                   catalog_cache=None) -> list:
+    """Disruptable nodes as Candidates (helpers.go:146).
+
+    ``catalog_cache`` optionally carries a nodepool-name -> {type name:
+    InstanceType} memo owned by the disruption controller: candidate
+    discovery runs at least twice per executed command (compute +
+    validate) and every poll round otherwise, and re-listing the cloud
+    provider each time is pure waste for providers where GetInstanceTypes
+    is a real API call. The controller clears it on nodepool events; the
+    catalog objects themselves are shared by identity with the solver's
+    type cache, so in-place offering flips stay visible."""
     pdb_limits = PdbLimits(store)
     pools = {np.name: np for np in store.list("nodepools")}
-    catalogs: dict = {}
+    catalogs: dict = catalog_cache if catalog_cache is not None else {}
     out = []
     for sn in cluster.nodes():
         if sn.deleting() or sn.marked_for_deletion:
@@ -46,7 +56,8 @@ def build_disruption_budgets(cluster, store, clock) -> dict:
     """nodepool name -> reason -> allowed disruptions (helpers.go:199)."""
     totals: dict = {}
     disrupting: dict = {}
-    for sn in cluster.nodes():
+    # read-only aggregation: the live StateNodes suffice — no snapshot copy
+    for sn in cluster.state_nodes():
         pool = sn.nodepool_name
         if not pool:
             continue
@@ -101,17 +112,39 @@ class SimulationResults:
         return all(p.uid in placed for p in self.candidate_pods)
 
 
-def simulate_scheduling(provisioner, cluster, store, candidates, inputs=None) -> SimulationResults:
+def simulate_scheduling(provisioner, cluster, store, candidates, inputs=None,
+                        bundle=None) -> SimulationResults:
     """Counterfactual solve: cluster minus candidates (helpers.go:51).
 
     `inputs` optionally carries pre-assembled solver inputs (templates,
     catalog, overhead, limits, domains) from the round's snapshot cache
     (ops/consolidate.py) — valid only within one cluster-state generation,
-    which the cache's `inputs_for` enforces before handing them out."""
+    which the cache's `inputs_for` enforces before handing them out.
+
+    `bundle` optionally carries the round's DisruptionSnapshot: when still
+    generation-current it supplies the existing-node view directly — the
+    candidate-free node set as cheap forks of tensorized prototypes
+    (`sim_enodes`) and the solver's existing-node tensors as row slices of
+    the shared snapshot (`derive_esnap`) — so a confirming simulation
+    skips the O(E) snapshot+constructor sweep and the O(E×G) re-tensorize
+    that otherwise dominate every confirm. The result is the same solve
+    over the same state; the fast path only ever changes how the inputs
+    are materialized, and declines to None-mapped candidates."""
     excluded = {c.provider_id for c in candidates}
-    state_nodes = [sn for sn in cluster.nodes() if sn.provider_id not in excluded]
     candidate_pods = [p for c in candidates for p in c.reschedulable_pods]
     pending = [p for p in store.list("pods") if pod_util.is_provisionable(p)]
+    if bundle is not None and bundle.generation == cluster.consolidation_state():
+        protos = bundle.sim_enodes(excluded)
+        if protos is not None:
+            seen = {p.uid for p in pending}
+            seen.update(p.uid for p in candidate_pods)
+            deleting = bundle.sim_deleting_pods(seen)
+            results = provisioner.schedule(
+                pods=pending + candidate_pods + deleting, state_nodes=[],
+                inputs=inputs, enodes_base=protos, existing_base=bundle,
+            )
+            return SimulationResults(results, candidate_pods)
+    state_nodes = [sn for sn in cluster.nodes() if sn.provider_id not in excluded]
     deleting = provisioner.deleting_node_pods(state_nodes, pending + candidate_pods)
     results = provisioner.schedule(
         pods=pending + candidate_pods + deleting, state_nodes=state_nodes,

@@ -78,12 +78,18 @@ class Drift(Method):
             return Command(empty, reason=self.reason)
         # else one at a time, with replacement simulation (sharing the
         # round's cached solver inputs when still generation-current)
-        cache = getattr(self.ctx, "snapshot_cache", None)
-        inputs = cache.inputs_for(self.ctx.cluster) if cache is not None else None
+        ctx = self.ctx
+        cache = getattr(ctx, "snapshot_cache", None)
+        bundle = (
+            cache.refresh(ctx.provisioner, ctx.cluster, ctx.store,
+                          registry=ctx.registry)
+            if cache is not None else None
+        )
+        inputs = cache.inputs_for(ctx.cluster) if cache is not None else None
         for c in drifted:
             sim = simulate_scheduling(
                 self.ctx.provisioner, self.ctx.cluster, self.ctx.store, [c],
-                inputs=inputs,
+                inputs=inputs, bundle=bundle,
             )
             if not sim.all_pods_scheduled():
                 continue
@@ -171,9 +177,15 @@ def candidate_prices(candidates) -> float | None:
 def compute_consolidation(ctx, candidates) -> Command | None:
     """Shared consolidation core (consolidation.go:112-296)."""
     cache = getattr(ctx, "snapshot_cache", None)
+    bundle = (
+        cache.refresh(ctx.provisioner, ctx.cluster, ctx.store,
+                      registry=ctx.registry)
+        if cache is not None else None
+    )
     inputs = cache.inputs_for(ctx.cluster) if cache is not None else None
     sim = simulate_scheduling(
-        ctx.provisioner, ctx.cluster, ctx.store, candidates, inputs=inputs
+        ctx.provisioner, ctx.cluster, ctx.store, candidates, inputs=inputs,
+        bundle=bundle,
     )
     if not sim.all_pods_scheduled():
         return None
@@ -343,16 +355,26 @@ class MultiNodeConsolidation(Method):
     """Largest N where candidates[0..N] collapse into ≤1 replacement
     (disruption/multinodeconsolidation.go:47-163). The prefix search runs
     as ONE batched device probe (ops/consolidate.py) — all N prefixes
-    evaluated in a single vmapped pack call — with the winner re-validated
-    by the full simulation; scenarios the probe can't express fall back to
-    the reference's sequential binary search. The whole search is bounded
-    by a 1-minute wall clock (multinodeconsolidation.go:37): on timeout the
-    best command found so far is returned rather than searching unbounded."""
+    evaluated in a single vmapped pack call — and when the probe declares
+    its ladder DEFINITIVE (plan-free, claim accounting provably mirroring
+    the simulation's: every modeled host check can only over-estimate)
+    the single winning prefix pays the round's only confirming simulation
+    and ships. Probe-vs-host disagreement (the confirm at k fails) falls
+    back to the reference's sequential binary search below k.
+    Non-definitive ladders (topology-compiled bundles, batches too large
+    to prove claimability for) keep the upward gallop step around k, so
+    the chosen command matches the reference's there at the reference's
+    cost; scenarios the probe can't express at all fall back to the full
+    sequential search. The whole
+    search is bounded by a 1-minute wall clock (multinodeconsolidation.go
+    :37): on timeout the best command found so far is returned rather than
+    searching unbounded."""
 
     reason = REASON_UNDERUTILIZED
     needs_validation = True
     is_consolidation = True
     last_probe: str = ""  # "device" | "sequential" (observability + tests)
+    last_host_confirms: int = 0  # host simulations this round (tests + perf)
 
     def compute_command(self, candidates, budgets):
         pool = _consolidatable(candidates)
@@ -361,35 +383,39 @@ class MultiNodeConsolidation(Method):
         if len(cands) < 2:
             return None
         self._deadline = self.ctx.clock.now() + MULTI_NODE_TIMEOUT
+        self.last_host_confirms = 0
 
-        k = self._probe(cands, pool)
-        if k is not None:
+        probed = self._probe(cands, pool)
+        if probed is not None:
+            k, definitive = probed
             self.last_probe = "device"
-            # the probe is approximate in both directions (topology
-            # tightening and the cheapest-offering price prune can
-            # under-estimate; the coarse fit model over-estimates the
-            # exact price/validation checks), so every answer is confirmed
-            # by the real simulation and a miss degenerates into the
-            # reference's binary search on the remaining range — never a
-            # silently skipped consolidation
             if k < 2:
+                # paranoia confirm of the smallest prefix guards the
+                # probe's residual false-negative corner (f32 rounding);
+                # if it lands, the probe misjudged the batch and the
+                # reference's full search takes over
                 cmd = self._confirm(cands[:2])
                 if cmd is None:
                     return None  # probe confirmed: nothing consolidates
-                return self._binary_search(cands, hi=len(cands), lo=2, best=cmd)
+                return self._binary_search(cands, hi=len(cands), lo=3, best=cmd)
             cmd = self._confirm(cands[:k])
             if cmd is not None and len(cmd.candidates) >= 2:
-                if k < len(cands):
-                    # one upward gallop step: if the probe truncated, resume
-                    # the search above k, seeded with the confirmed command
-                    up = self._confirm(cands[: k + 1])
-                    if up is not None:
-                        return self._binary_search(
-                            cands, hi=len(cands), lo=k + 2, best=up
-                        )
+                if definitive or k >= len(cands):
+                    # the ladder already proved every prefix above k
+                    # infeasible (definitive misses only over-estimate):
+                    # this confirm was the round's ONLY host solve
+                    return cmd
+                # non-definitive ladder: k is a seed, not an answer — one
+                # upward gallop step, then resume the search above it
+                up = self._confirm(cands[: k + 1])
+                if up is not None:
+                    return self._binary_search(
+                        cands, hi=len(cands), lo=k + 2, best=up
+                    )
                 return cmd
-            # the probe over-estimated (price filter / validation detail the
-            # kernel doesn't model): finish with the search below k
+            # probe-vs-host disagreement (price filter / validation detail
+            # the kernel doesn't model): the reference's search below k
+            # decides, so the shipped command never differs from its answer
             return self._binary_search(cands, hi=k - 1)
         self.last_probe = "sequential"
         return self._binary_search(cands, hi=len(cands))
@@ -403,7 +429,16 @@ class MultiNodeConsolidation(Method):
     def _confirm(self, prefix):
         """One real simulation of a candidate prefix, with the same-type
         price filter applied to any replacement. None = prefix fails."""
-        cmd = compute_consolidation(self.ctx, prefix)
+        from karpenter_tpu.operator import metrics as m
+
+        self.last_host_confirms += 1
+        self.ctx.registry.counter(
+            m.DISRUPTION_HOST_CONFIRMS,
+            "confirming host simulations run by consolidation methods",
+        ).inc(method="multi")
+        with self.ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
+                                       method="multi"):
+            cmd = compute_consolidation(self.ctx, prefix)
         if cmd is None or cmd.action == "no-op":
             return None
         if cmd.action == "replace":
@@ -490,7 +525,7 @@ class SingleNodeConsolidation(Method):
             any_hit = True
             if self._timed_out(deadline):
                 return None  # abandon mid-scan (:71-75)
-            cmd = compute_consolidation(self.ctx, [c])
+            cmd = self._confirm_one(c)
             if cmd is None:
                 continue
             earlier = self._scan(skipped, deadline)
@@ -514,7 +549,7 @@ class SingleNodeConsolidation(Method):
             # probe misjudged the batch
             if self._timed_out(deadline):
                 return None
-            cmd = compute_consolidation(self.ctx, [skipped[0]])
+            cmd = self._confirm_one(skipped[0])
             if cmd is not None:
                 return cmd
             if any_hit and skipped[1:]:
@@ -533,10 +568,23 @@ class SingleNodeConsolidation(Method):
         for c in cands:
             if self._timed_out(deadline):
                 return _TIMED_OUT  # abandon mid-scan (:71-75)
-            cmd = compute_consolidation(self.ctx, [c])
+            cmd = self._confirm_one(c)
             if cmd is not None:
                 return cmd
         return None
+
+    def _confirm_one(self, c):
+        """One real simulation of a single candidate, with host-confirm
+        accounting (the perf harness's `host_confirm_count`)."""
+        from karpenter_tpu.operator import metrics as m
+
+        self.ctx.registry.counter(
+            m.DISRUPTION_HOST_CONFIRMS,
+            "confirming host simulations run by consolidation methods",
+        ).inc(method="single")
+        with self.ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
+                                       method="single"):
+            return compute_consolidation(self.ctx, [c])
 
     def _timed_out(self, deadline) -> bool:
         return _search_timed_out(self.ctx, deadline, "single")

@@ -203,7 +203,8 @@ class Provisioner:
             out.append(p)
         return out
 
-    def schedule(self, pods=None, state_nodes=None, inputs=None):
+    def schedule(self, pods=None, state_nodes=None, inputs=None,
+                 enodes_base=None, existing_base=None):
         # nodes are snapshotted BEFORE pods are listed: a pod that binds in
         # between appears both as pending and in its node's usage, which
         # over-provisions (safe); the reverse order would under-provision
@@ -264,7 +265,15 @@ class Provisioner:
             else StoreClusterView(self.store)
         )
         topology = Topology(cluster=view, domains=domains, pods=pods)
-        existing_nodes = self._existing_nodes(state_nodes, topology)
+        if enodes_base is not None:
+            # disruption fast path (helpers.simulate_scheduling): the
+            # round's snapshot bundle supplies generation-current
+            # ExistingNode prototypes; forking re-binds them to THIS
+            # solve's topology and fresh mutable state, skipping the O(E)
+            # constructor sweep per confirming simulation
+            existing_nodes = [en.fork(topology) for en in enodes_base]
+        else:
+            existing_nodes = self._existing_nodes(state_nodes, topology)
         results = self.solver.solve(
             pods,
             templates,
@@ -274,6 +283,7 @@ class Provisioner:
             daemon_overhead=overhead,
             limits=limits or None,
             volume_topology=vt,
+            existing_base=existing_base,
         )
         results.truncate_instance_types()
         return results

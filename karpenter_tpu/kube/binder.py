@@ -26,19 +26,32 @@ class Binder:
         self.clock = clock or Clock()
         self.registry = registry or _m.REGISTRY
 
-    def _fits(self, pod, node, available: dict) -> bool:
+    def _fits(self, pod, node, available: dict, node_view: dict,
+              pod_req, pod_reqs) -> bool:
         if not node.ready or node.unschedulable or node.metadata.deletion_timestamp:
             return False
-        if Taints(t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")).tolerates(pod):
+        view = node_view.get(node.name)
+        if view is None:
+            # per-pass memo: requirement/taint objects are per NODE, but
+            # the scan tests every (pod, node) pair — rebuilding them per
+            # pair made the binder O(pods × nodes × labels) and dominated
+            # fleet-scale benches after a consolidation wave
+            view = node_view[node.name] = (
+                Taints(t for t in node.taints
+                       if t.effect in ("NoSchedule", "NoExecute")),
+                label_requirements(node.labels),
+            )
+        taints, node_reqs = view
+        if taints.tolerates(pod):
             return False
-        node_reqs = label_requirements(node.labels)
-        if node_reqs.compatible(pod_requirements(pod), allow_undefined=wk.WELL_KNOWN_LABELS):
+        if node_reqs.compatible(pod_reqs, allow_undefined=wk.WELL_KNOWN_LABELS):
             return False
-        return resutil.fits(pod.effective_requests(), available[node.name])
+        return resutil.fits(pod_req, available[node.name])
 
     def bind_pending(self) -> int:
         """One binding pass; returns the number of pods progressed."""
         progressed = 0
+        node_view: dict = {}  # node name -> (Taints, label Requirements)
         nodes = {n.name: n for n in self.store.list("nodes")}
         # availability computed once per pass, decremented as pods bind
         used: dict = {name: {} for name in nodes}
@@ -62,11 +75,14 @@ class Binder:
                 candidates.append(nodes[pod.nominated_node_name])
             candidates.extend(n for n in nodes.values() if n.name != pod.nominated_node_name)
             placed = False
+            # pod-side objects built once per pod, not once per (pod, node)
+            pod_req = pod.effective_requests()
+            pod_reqs = pod_requirements(pod)
             for node in candidates:
-                if self._fits(pod, node, available):
+                if self._fits(pod, node, available, node_view, pod_req, pod_reqs):
                     self.store.bind(pod, node.name)
                     available[node.name] = resutil.subtract(
-                        available[node.name], pod.effective_requests()
+                        available[node.name], pod_req
                     )
                     # creation → bound latency (the reference's pod startup
                     # duration summary, controllers/metrics/pod)

@@ -45,6 +45,29 @@ class ExistingNode:
         self.host_ports = state_node.host_port_usage
         self.volumes = state_node.volume_usage
 
+    def fork(self, topology) -> "ExistingNode":
+        """Cheap per-simulation copy of a prototype built at the same
+        cluster-state generation: shares everything `add` never mutates in
+        place (the taint set, the initial requirements — `add` REPLACES
+        self.requirements with a fresh object rather than mutating — and
+        the availability dicts) and copies what it does (usage trackers,
+        the requests dict, the placed-pod list). Lets one disruption
+        round's tensorized bundle serve every confirming simulation
+        without re-running the O(E) ExistingNode constructor per solve."""
+        out = object.__new__(ExistingNode)
+        out.state_node = self.state_node
+        out.topology = topology
+        out.kube = self.kube
+        out.pods = []
+        out.requests = dict(self.requests)
+        out.cached_available = self.cached_available
+        out.taints = self.taints
+        out.requirements = self.requirements
+        out.host_ports = self.host_ports.copy()
+        out.volumes = self.volumes.copy()
+        topology.register(wk.HOSTNAME_LABEL, self.state_node.hostname)
+        return out
+
     @property
     def name(self) -> str:
         return self.state_node.name

@@ -49,6 +49,7 @@ class HostSolver(Solver):
         limits=None,
         initial_claims=(),
         volume_topology=None,
+        existing_base=None,  # tensor-derivation hint; the host loop has no tensors
     ) -> SchedulerResults:
         sched = Scheduler(
             templates,
@@ -185,6 +186,7 @@ class TPUSolver(Solver):
         limits=None,
         max_bins: int | None = None,
         volume_topology=None,
+        existing_base=None,
     ) -> SchedulerResults:
         has_topology = bool(getattr(topology, "has_groups", topology is not None and not isinstance(topology, NullTopology)))
         host_cutoff = 0
@@ -280,9 +282,17 @@ class TPUSolver(Solver):
             device_plan = None
         esnap = None
         if existing_nodes:
-            from karpenter_tpu.ops.tensorize import tensorize_existing
+            if existing_base is not None and device_plan is None:
+                # disruption fast path: slice this sub-solve's existing-node
+                # tensors out of the round's shared snapshot
+                # (ops/consolidate.py DisruptionSnapshot.derive_esnap) —
+                # None when a node or group fails to map, and the full
+                # build below runs
+                esnap = existing_base.derive_esnap(snap, existing_nodes)
+            if esnap is None:
+                from karpenter_tpu.ops.tensorize import tensorize_existing
 
-            esnap = tensorize_existing(snap, existing_nodes, device_plan)
+                esnap = tensorize_existing(snap, existing_nodes, device_plan)
         claims, retry, ecommits, bins, exhausted = self._run_and_decode(
             snap, esnap, max_bins)
         # estimated bin axis ran dry with pods left over: double and re-run

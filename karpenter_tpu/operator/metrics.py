@@ -199,6 +199,16 @@ DISRUPTION_SNAPSHOT_CACHE_MISSES = (
     f"{NAMESPACE}_disruption_snapshot_cache_misses_total"
 )
 DISRUPTION_PROBE_BATCH_SIZE = f"{NAMESPACE}_disruption_probe_batch_size"
+# confirming host simulations per consolidation method ("multi"/"single"):
+# the batched confirm ladder targets ≤1 per MultiNode round — a climbing
+# count means probe-vs-host disagreement (sequential fallbacks)
+DISRUPTION_HOST_CONFIRMS = f"{NAMESPACE}_disruption_host_confirms_total"
+DISRUPTION_CONFIRM_DURATION = (
+    f"{NAMESPACE}_disruption_confirm_duration_seconds"
+)
+# negative node availabilities clamped during tensorization — mirrored from
+# ops/tensorize.py (capacity-accounting bugs must surface, not vanish)
+TENSORIZE_NEGATIVE_AVAIL = f"{NAMESPACE}_tensorize_negative_avail_total"
 # counterfactual-rows-per-dispatch buckets (powers of two up to the probe's
 # chunk cap) — durations make no sense for a size histogram
 PROBE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)

@@ -53,23 +53,41 @@ SingleNode candidate probe, and (via ``inputs``) the confirming
 * **Generation key.** Every informer event that can change the scheduling
   answer bumps the counter (pod/node/nodeclaim updates, nodepool AND
   daemonset changes, deletion marks). A bundle whose generation no longer
-  matches is dead: the next ``get`` re-tensorizes from scratch. Executing a
-  command always bumps the generation (``mark_for_deletion``), so a
-  validation round never sees a pre-command snapshot.
-* **What delta-updates cover.** Candidate exclusion only: per-counterfactual
-  ``g_count`` (pending base + the candidates' reschedulable pods, derived
-  from the cached per-pod group index) and ``e_avail`` (the candidates'
-  node columns zeroed). Everything else — group masks, type/offering
-  tensors, existing-node admission, topology class tensors — is shared
-  read-only from the one tensorization.
-* **When full re-tensorize is mandatory.** Any generation bump; a build
-  candidate set that is not a superset of the queried one (methods pass the
-  full consolidatable pool as ``build_candidates`` so MultiNode's build
-  also serves SingleNode); and any in-place catalog mutation that bypasses
-  the informer plane (offerings flipped without a store event) — the cache
-  cannot see those, which is safe only because probe answers are seeds:
-  the confirming simulation re-tensorizes through ``tensorize``'s own
-  offering-fingerprinted type cache and rejects stale hits.
+  matches is stale; ``get`` then consults the cluster's structured delta
+  journal (``Cluster.deltas_since``) and PATCHES the bundle in place
+  (``DisruptionSnapshot.advance`` → ``ExistingSnapshot.apply_delta``,
+  tensorize.py "Existing-node delta contract") when every bump is
+  node/pod-scoped and expressible on the existing group axis; otherwise it
+  re-tensorizes from scratch. Executing a command always bumps the
+  generation (``mark_for_deletion``), so a validation round never sees a
+  pre-command snapshot — delta-advanced or rebuilt, it reflects the marks.
+* **What delta-updates cover.** Two layers. Per-QUERY (unchanged from
+  PR 2): candidate exclusion only — counterfactual ``g_count`` rows and
+  zeroed ``e_avail`` columns over the shared tensors. Per-GENERATION (this
+  PR): dirty node rows rebuilt from live state, removed nodes masked in
+  place (the E axis never shrinks, keeping the compiled shape family),
+  added nodes appended, and new pods registered onto the group axis by
+  scheduling signature so rebound replicas keep ``contribs_for`` exact.
+* **When full re-tensorize is mandatory.** An opaque journal entry
+  (nodepool/daemonset change, resync) or a journal gap; a pod whose
+  signature matches no tensorized group (new vocabulary/group set); a
+  topology-compiled plan (waves domain counts are position-dependent);
+  nodepool limits (remaining = spec − usage drifts with node churn); churn
+  above half the fleet (a rebuild also re-compacts the E axis); a build
+  candidate set that is not a superset of the queried one (methods pass
+  the full consolidatable pool as ``build_candidates`` so MultiNode's
+  build also serves SingleNode); and any in-place catalog mutation that
+  bypasses the informer plane (offerings flipped without a store event) —
+  the cache cannot see those, which is safe only because probe answers are
+  seeds: the confirming simulation re-tensorizes through ``tensorize``'s
+  own offering-fingerprinted type cache and rejects stale hits.
+* **The confirming simulations ride the bundle too.** Within one
+  generation, ``helpers.simulate_scheduling`` forks the bundle's
+  ExistingNode prototypes (``sim_enodes``) instead of re-running the O(E)
+  constructor sweep, and the solver derives the sub-solve's existing-node
+  tensors from the bundle's rows (``derive_esnap``) instead of an O(E×G)
+  re-tensorize; both decline — and the slow path runs — whenever a node or
+  group fails to map.
 
 Cache efficacy is scrapeable: ``karpenter_disruption_snapshot_cache_hits/
 misses_total`` count bundle reuse, and the
@@ -84,11 +102,13 @@ import functools
 import numpy as np
 
 from karpenter_tpu.ops.tensorize import (
+    ExistingSnapshot,
     bucket as _bucket,
     device_basic_eligible,
     group_by_signature,
     kernel_args,
     pad_to as pad,
+    pod_signature,
     tensorize,
     tensorize_existing,
 )
@@ -146,12 +166,15 @@ class DisruptionSnapshot:
     counterfactual ``g_count`` rows without re-tensorizing."""
 
     def __init__(self, generation, build_key, inputs, pending, enodes,
-                 col_by_pid, unprobeable, plan, snap, esnap, gidx_of, base):
+                 col_by_pid, unprobeable, plan, snap, esnap, gidx_of, base,
+                 topology=None, daemons=(), deleting_pods=()):
         self.generation = generation
-        self.build_key = build_key  # frozenset of build-candidate provider ids
+        self.build_key = set(build_key)  # build-candidate provider ids
         self.inputs = inputs  # (templates, its_by_pool, overhead, limits, domains)
         self.pending = pending
-        self.enodes = enodes
+        # the esnap's node list IS the prototype list — one list, kept
+        # row-aligned by apply_delta, so sims and dispatches agree on rows
+        self.enodes = esnap.nodes if esnap is not None else enodes
         self.col_by_pid = col_by_pid  # provider_id -> existing-node column
         self.unprobeable = unprobeable  # provider ids the probe cannot express
         self.plan = plan
@@ -159,6 +182,21 @@ class DisruptionSnapshot:
         self.esnap = esnap
         self.gidx_of = gidx_of  # pod uid -> group index
         self.base = base  # [G] i32: pending-pod counts (every counterfactual's floor)
+        self.topology = topology  # build-time Topology (prototype plumbing)
+        self.daemons = list(daemons)  # daemonset pod templates at build
+        self.deleting_pods = list(deleting_pods)  # reschedulable pods of
+        # deleting/marked nodes (pre-provision targets, helpers.go:340)
+        # scheduling signature -> group row, for delta-registering pods the
+        # build never saw and for mapping sub-solve groups onto this axis
+        self.sig_to_group = {}
+        for g, pods_g in enumerate(snap.groups):
+            p0 = pods_g[0]
+            sig = p0.__dict__.get("_sig_cache")
+            if sig is None and plan is None:
+                sig = p0.__dict__["_sig_cache"] = pod_signature(p0)
+            if sig is not None:
+                self.sig_to_group.setdefault(sig, g)
+        self.base = self._with_deleting(self.base)
         self.max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
         # cheapest AVAILABLE offering across the whole catalog: the lower
         # bound of any replacement claim's launch price, used by the probes'
@@ -168,6 +206,7 @@ class DisruptionSnapshot:
         self.min_price = float(avail_prices.min()) if avail_prices.size else float("inf")
         self._shared = None
         self._dims = None
+        self._claimable = None
 
     def columns_for(self, candidates):
         """Existing-node columns for the queried candidates; None when any
@@ -193,6 +232,267 @@ class DisruptionSnapshot:
                     return None
                 contrib[j, g] += 1
         return contrib
+
+    def claimable_groups(self):
+        """[G] bool — groups a fresh claim could ever be opened for
+        (template compat + requirement overlap + fit net of daemon
+        overhead + an available offering inside the group's allowed
+        zone/capacity-type sets), or None when G×T is too large to prove
+        cheaply. The prefix ladder uses it to mirror the simulation's
+        claim accounting exactly: an UNclaimable pod can never consume the
+        one fresh claim (the sim ignores it when it lands nowhere —
+        SimulationResults.all_pods_scheduled), so requiring its placement
+        would only under-estimate k. Mis-classifying a claimable group as
+        unclaimable over-estimates feasibility, which the confirming
+        simulation catches — the safe direction."""
+        if self._claimable is None:
+            s = self.snap
+            G, T = s.G, s.T
+            if G == 0 or T == 0:
+                self._claimable = np.zeros(G, dtype=bool)
+            elif G * T > (1 << 18):
+                return None  # too big to prove; callers hedge instead
+            else:
+                tmpl_ok = s.g_tmpl_ok[:, s.t_tmpl]  # [G,T]
+                shared = s.g_has[:, None, :] & s.t_has[None, :, :]
+                ov = (
+                    (s.g_mask[:, None, :, :] & s.t_mask[None, :, :, :]) != 0
+                ).any(-1)
+                both_tol = s.g_tol[:, None, :] & s.t_tol[None, :, :]
+                req_ok = (~shared | ov | both_tol).all(-1)  # [G,T]
+                alloc_eff = s.t_alloc - s.m_overhead[s.t_tmpl]
+                fit = (
+                    s.g_demand[:, None, :] <= alloc_eff[None, :, :] + 1e-6
+                ).all(-1)
+                zo, co = s.off_zone, s.off_ct
+                zok = np.where(
+                    zo[None, :, :] >= 0,
+                    s.g_zone_allowed[:, np.maximum(zo, 0)], True)
+                cok = np.where(
+                    co[None, :, :] >= 0,
+                    s.g_ct_allowed[:, np.maximum(co, 0)], True)
+                off_ok = (s.off_avail[None] & zok & cok).any(-1)  # [G,T]
+                self._claimable = (tmpl_ok & req_ok & fit & off_ok).any(1)
+        return self._claimable
+
+    def _with_deleting(self, base):
+        """Pending baseline plus drain-in-flight pods: the real simulation
+        pre-provisions deleting/marked nodes' pods (helpers.go:340) and
+        their claims count toward the m→1 rule, so a probe baseline that
+        ignored them read feasible mid-drain and burned a binary search
+        per disagreement. Pods whose signature maps to no group are simply
+        not counted — the probe then over-estimates for the round and the
+        confirming simulation catches it, never the reverse."""
+        if self.plan is not None or not self.deleting_pods:
+            return base
+        base = base.copy()
+        for p in self.deleting_pods:
+            sig = p.__dict__.get("_sig_cache")
+            if sig is None:
+                sig = p.__dict__["_sig_cache"] = pod_signature(p)
+            g = self.sig_to_group.get(sig)
+            if g is not None:
+                base[g] += 1
+        return base
+
+    # -- delta maintenance (tensorize.py "Existing-node delta contract") --
+
+    def _make_enode(self, state_node, store):
+        """One ExistingNode prototype from live state — the per-node body
+        of provisioner._existing_nodes, for dirty/added rows."""
+        from karpenter_tpu.models.existing import ExistingNode
+        from karpenter_tpu.scheduling import daemon_schedulable, label_requirements
+        from karpenter_tpu.utils import resources as resutil
+
+        sn = state_node.snapshot()
+        taints = sn.taints()
+        node_reqs = label_requirements(sn.labels()) if self.daemons else None
+        daemon_resources: dict = {}
+        for p in self.daemons:
+            if daemon_schedulable(p, taints, node_reqs):
+                daemon_resources = resutil.merge(
+                    daemon_resources, p.effective_requests())
+        return ExistingNode(sn, self.topology, daemon_resources, kube=store)
+
+    def advance(self, cluster, store, deltas, generation, registry=None) -> bool:
+        """Patch this bundle to `generation` from the cluster's structured
+        delta journal instead of rebuilding. Returns False when any delta
+        is inexpressible — opaque entries, a pod whose signature matches no
+        tensorized group, topology-compiled plans, nodepool limits (usage
+        drifts with node churn), a journal gap, or a churn so large a
+        rebuild is cheaper — and the caller re-tensorizes from scratch."""
+        from karpenter_tpu.utils import pod as pod_util
+
+        if self.plan is not None or self.topology is None:
+            return False
+        if self.inputs[3]:
+            # nodepool limits are remaining = spec - usage: every node
+            # add/delete moves usage, and the cached inputs would go stale
+            return False
+        dirty_pids: set = set()
+        pod_events = []
+        for d in deltas:
+            if d is None:
+                return False  # opaque: nodepool/daemonset/resync
+            if d[0] == "node":
+                dirty_pids.add(d[1])
+            else:  # ("pod", pod, node_name | None, gone)
+                pod_events.append(d)
+
+        # pods first: register new/refreshed pods onto the group axis (so
+        # contribs_for keeps working for rebound replicas) and attribute
+        # their nodes as dirty
+        for _, pod, node_name, gone in pod_events:
+            if node_name:
+                sn = cluster.node_by_name(node_name)
+                if sn is not None:
+                    dirty_pids.add(sn.provider_id)
+                # a vanished node has its own ("node", pid) entry
+            if gone:
+                continue
+            if not device_basic_eligible(pod):
+                if not node_name:
+                    return False  # pending pods must stay expressible
+                sn = cluster.node_by_name(node_name)
+                if sn is not None:
+                    # the candidate's pods left the device vocabulary:
+                    # queries naming it fall back to the sequential scan,
+                    # exactly like an unprobeable candidate at build
+                    self.unprobeable.add(sn.provider_id)
+                    self.col_by_pid.pop(sn.provider_id, None)
+                continue
+            sig = pod.__dict__.get("_sig_cache")
+            if sig is None:
+                sig = pod.__dict__["_sig_cache"] = pod_signature(pod)
+            g = self.sig_to_group.get(sig)
+            if g is None:
+                return False  # unseen scheduling shape: new group/vocab
+            self.gidx_of[pod.uid] = g
+
+        # node rows: rebuild dirty, append new, mask gone/ineligible
+        esnap = self.esnap
+        removed, dirty_nodes, added_nodes, added_pids = [], [], [], []
+        for pid in dirty_pids:
+            sn = cluster.node_for(pid)
+            eligible = sn is not None and not (
+                sn.marked_for_deletion or sn.deleting())
+            row = esnap.row_of.get(pid)
+            if eligible:
+                en = self._make_enode(sn, store)
+                if row is None:
+                    added_nodes.append(en)
+                    added_pids.append(pid)
+                else:
+                    dirty_nodes.append(en)  # revives masked rows too
+            else:
+                if row is not None and esnap.live[row]:
+                    removed.append(pid)
+                self.col_by_pid.pop(pid, None)
+        churn = len(dirty_nodes) + len(removed) + len(added_nodes)
+        if churn > max(16, esnap.E // 2):
+            return False  # a wave: rebuilding also re-compacts the E axis
+        esnap.apply_delta(
+            self.snap, dirty=dirty_nodes, removed=removed, added=added_nodes,
+            registry=registry,
+        )
+        for pid in added_pids:
+            self.col_by_pid[pid] = esnap.row_of[pid]
+            self.build_key.add(pid)
+        for en in dirty_nodes:
+            pid = en.state_node.provider_id
+            if pid not in self.unprobeable:
+                self.col_by_pid[pid] = esnap.row_of[pid]
+
+        # pending baseline + pre-provision targets, from live state
+        pending = [p for p in store.list("pods") if pod_util.is_provisionable(p)]
+        base = np.zeros(self.snap.G, dtype=np.int32)
+        for p in pending:
+            g = self.gidx_of.get(p.uid)
+            if g is None:
+                return False  # a pod the journal never surfaced
+            base[g] += 1
+        self.pending = pending
+        self.deleting_pods = [
+            p
+            for sn in cluster.state_nodes()
+            if sn.marked_for_deletion or sn.deleting()
+            for p in sn.reschedulable_pods()
+        ]
+        self.base = self._with_deleting(base)
+        self.generation = generation
+        self._shared = None  # padded-arg cache carries esnap rows
+        return True
+
+    # -- simulation fast path (helpers.simulate_scheduling) --------------
+
+    def sim_enodes(self, excluded):
+        """Prototype ExistingNodes for a counterfactual excluding the given
+        provider ids, row-ordered; None when an excluded candidate is
+        unknown to this bundle (the caller runs the slow path). Masked rows
+        (nodes that left the fleet) and the excluded candidates drop out —
+        exactly the `cluster minus candidates` view helpers.go:51 builds."""
+        row_of, live = self.esnap.row_of, self.esnap.live
+        for pid in excluded:
+            if pid not in row_of:
+                return None
+        return [
+            en
+            for r, en in enumerate(self.enodes)
+            if live[r] and en.state_node.provider_id not in excluded
+        ]
+
+    def sim_deleting_pods(self, seen):
+        """Reschedulable pods of deleting/marked nodes not already in the
+        sim's pod set (provisioner.deleting_node_pods over the cached
+        view)."""
+        return [p for p in self.deleting_pods if p.uid not in seen]
+
+    def derive_esnap(self, sim_snap, existing_nodes):
+        """ExistingSnapshot for a sub-solve, derived from this bundle's
+        rows instead of an O(E×G) re-tensorize. Sound only within one
+        cluster-state generation (the caller gates on that): every node
+        must map to a live row and every sim group must map — by scheduling
+        signature, which fixes its tensors — onto this snapshot's group
+        axis over the SAME interned vocabulary. Returns None when any of
+        that fails and the caller pays the full build."""
+        base_snap, base = self.snap, self.esnap
+        if self.plan is not None:
+            return None
+        if (
+            sim_snap.keys != base_snap.keys
+            or sim_snap.resources != base_snap.resources
+            or sim_snap.W != base_snap.W
+            or sim_snap.vocab != base_snap.vocab
+        ):
+            return None
+        rows = []
+        for en in existing_nodes:
+            r = base.row_of.get(en.state_node.provider_id)
+            if r is None or not base.live[r]:
+                return None
+            rows.append(r)
+        gsel = []
+        for pods_g in sim_snap.groups:
+            p0 = pods_g[0]
+            sig = p0.__dict__.get("_sig_cache")
+            if sig is None:
+                sig = p0.__dict__["_sig_cache"] = pod_signature(p0)
+            g = self.sig_to_group.get(sig)
+            if g is None:
+                return None
+            gsel.append(g)
+        rows = np.asarray(rows, dtype=np.intp)
+        gsel = np.asarray(gsel, dtype=np.intp)
+        return ExistingSnapshot(
+            nodes=list(existing_nodes),
+            e_avail=base.e_avail[rows],
+            ge_ok=base.ge_ok[np.ix_(gsel, rows)],
+            e_npods=base.e_npods[rows],
+            e_scnt=base.e_scnt[rows],
+            e_decl=base.e_decl[rows],
+            e_match=base.e_match[rows],
+            e_aff=base.e_aff[rows],
+        )
 
     def _shared_args(self):
         if self._shared is None:
@@ -379,7 +679,8 @@ def build_disruption_snapshot(provisioner, cluster, store, candidates):
     )
     if snap.G == 0:
         return None
-    esnap = tensorize_existing(snap, enodes, plan)
+    esnap = tensorize_existing(
+        snap, enodes, plan, registry=getattr(provisioner, "registry", None))
 
     gidx_of = {}
     for g, pods_g in enumerate(snap.groups):
@@ -408,6 +709,17 @@ def build_disruption_snapshot(provisioner, cluster, store, candidates):
         esnap=esnap,
         gidx_of=gidx_of,
         base=base,
+        topology=topology,
+        daemons=[
+            ds.template for ds in store.list("daemonsets")
+            if ds.template is not None
+        ],
+        deleting_pods=[
+            p
+            for sn in state_nodes
+            if sn.marked_for_deletion or sn.deleting()
+            for p in sn.reschedulable_pods()
+        ],
     )
 
 
@@ -435,6 +747,14 @@ class SnapshotCache:
                     "disruption probes served from the snapshot cache",
                 ).inc(kind="snapshot")
             return b
+        if b is not None and b.generation < generation:
+            # incremental maintenance: patch the bundle from the cluster's
+            # structured delta journal instead of re-tensorizing the fleet
+            # (tensorize.py "Existing-node delta contract"); anything the
+            # journal can't express falls through to the full rebuild below
+            b2 = self._try_advance(cluster, store, generation, registry)
+            if b2 is not None and key <= b2.build_key:
+                return b2
         if self._neg == (generation, key):
             # an inexpressible build is generation-stable: don't re-pay the
             # assembly for every method in the round. Counted under its own
@@ -460,20 +780,64 @@ class SnapshotCache:
             self._neg = (generation, key)
         return b
 
-    def inputs_for(self, cluster):
-        """The cached solver inputs when still generation-current, else
-        None — lets the confirming simulations skip re-assembling
-        templates/catalog/overhead inside one disruption round. Safe
-        because every structural input change bumps the generation and the
-        catalog objects are shared by identity."""
+    def current(self, cluster):
+        """The cached bundle when still generation-current, else None —
+        the gate every simulation fast-path consumer must pass: a bundle
+        whose generation matches the cluster's is a faithful mirror of
+        live state (every informer mutation bumps the counter)."""
         b = self._bundle
         if (
             b is not None
             and cluster is not None
             and b.generation == cluster.consolidation_state()
         ):
-            return b.inputs
+            return b
         return None
+
+    def refresh(self, provisioner, cluster, store, registry=None):
+        """`current`, but a stale bundle first gets one delta-advance
+        attempt — NEVER a rebuild (consumers here want the fast path if
+        it's cheap, not to pay a tensorization the probes didn't ask for).
+        Serves the confirming simulations and the controller's validation
+        round, which run between probe queries at generations the probes
+        never saw."""
+        if cluster is None or self._bundle is None:
+            return None
+        b = self._bundle
+        generation = cluster.consolidation_state()
+        if b.generation == generation:
+            return b
+        if b.generation < generation:
+            return self._try_advance(cluster, store, generation, registry)
+        return None
+
+    def _try_advance(self, cluster, store, generation, registry):
+        """One delta-advance attempt on the cached bundle (shared by `get`
+        and `refresh`): journal lookup → advance → delta-hit accounting.
+        Returns the advanced bundle or None (opaque/inexpressible/gap)."""
+        b = self._bundle
+        deltas = getattr(cluster, "deltas_since", lambda g: None)(b.generation)
+        if deltas is None or not b.advance(
+            cluster, store, deltas, generation, registry=registry
+        ):
+            return None
+        if registry is not None:
+            from karpenter_tpu.operator import metrics as m
+
+            registry.counter(
+                m.DISRUPTION_SNAPSHOT_CACHE_HITS,
+                "disruption probes served from the snapshot cache",
+            ).inc(kind="delta")
+        return b
+
+    def inputs_for(self, cluster):
+        """The cached solver inputs when still generation-current, else
+        None — lets the confirming simulations skip re-assembling
+        templates/catalog/overhead inside one disruption round. Safe
+        because every structural input change bumps the generation and the
+        catalog objects are shared by identity."""
+        b = self.current(cluster)
+        return b.inputs if b is not None else None
 
 
 def _bundle_for(provisioner, cluster, store, candidates, cache, registry,
@@ -487,9 +851,21 @@ def _bundle_for(provisioner, cluster, store, candidates, cache, registry,
 def batched_feasible_prefix(provisioner, cluster, store, candidates,
                             cache=None, registry=None, build_candidates=None):
     """Largest k such that candidates[:k] consolidate into the remaining
-    cluster plus at most one fresh claim, decided in one device call.
-    Returns None when the probe cannot express the scenario (the caller
-    falls back to the sequential binary search)."""
+    cluster plus at most one fresh claim, decided in one device call over
+    the WHOLE prefix ladder (every prefix is a counterfactual row, so the
+    reference's log2(k) sequential solves collapse into one dispatch).
+
+    Returns ``(k, definitive)``: ``definitive`` says the ladder's MISSES
+    may be trusted — plan-free bundles whose claim accounting provably
+    mirrored the simulation's (per-group claimability proven, or no
+    pending/drain pods rode the rows), where every modeled host check can
+    only over-estimate feasibility; the caller then pays exactly ONE
+    confirming simulation at k. Everything else (topology-compiled
+    bundles, mid-flight batches too large to prove claimability for)
+    hands k over as a seed the caller gallops/searches around — the
+    reference's answer at the reference's cost. Returns None when the
+    probe cannot express the scenario (the caller falls back to the
+    sequential binary search)."""
     bundle = _bundle_for(
         provisioner, cluster, store, candidates, cache, registry,
         build_candidates,
@@ -513,23 +889,41 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates,
     e_zero_cols = [col_arr[: k + 1] for k in range(N)]
 
     placed_g, used = bundle.dispatch(g_count_k, e_zero_cols)
-    # prefix k feasible iff EVERY group placed at least the prefix's own
-    # candidate contribution: pods within a group are spec-identical
-    # (interchangeable), so the group-wise test is exactly "all displaced
-    # pods land" — and a stuck PENDING pod, which the reference's
-    # all_pods_scheduled ignores (helpers.py SimulationResults), can never
-    # poison the batch
-    feasible = (placed_g[:, :G] >= cum).all(axis=1)
     if bundle.plan is None:
-        # price prefilter (consolidation.go filterByPrice as a batch
-        # prune): a prefix that needs the one fresh claim can only ship if
-        # SOME available offering launches strictly cheaper than the prefix
-        # costs today; the cheapest catalog offering under-estimates the
-        # replacement price. Plan-free bundles only: the kernel fills
-        # existing nodes before opening the fresh bin, so `used` is
-        # reliable there — topology tightening can inflate it, and a wrong
-        # prune would burn the binary-search simulations the batch exists
-        # to avoid
+        # plan-free ladders aim to be DEFINITIVE, so the criterion mirrors
+        # the host's whole decision, not just "the candidates' pods land":
+        # (1) every pod the simulation would open a claim for — pending
+        # and drain pods of CLAIMABLE groups included — must place within
+        # the surviving nodes plus the one fresh bin, because the
+        # reference's m→1 rule counts the claims those pods consume too
+        # (consolidation.go:164): a mid-flight batch whose pending pods
+        # need their own claim can never confirm, and rows that ignore
+        # them burn a binary search per disagreement. Pods of UNclaimable
+        # groups are exempt exactly like the sim exempts them (a pod that
+        # can land nowhere takes no claim and all_pods_scheduled ignores
+        # it) — and when claimability is too large to prove, the ladder
+        # simply stops being definitive instead of guessing.
+        claimable = bundle.claimable_groups()
+        if claimable is None:
+            required = g_count_k
+            base_exempt_ok = int(base.sum()) == 0
+        else:
+            required = cum + np.where(claimable[:G], base, 0)[None, :]
+            base_exempt_ok = True
+        feasible = (placed_g[:, :G] >= required).all(axis=1)
+        # (2) the price ladder, modeling filterByPrice AND the same-type
+        # anti-churn filter (filter_out_same_type): a prefix that needs
+        # the fresh claim only ships if some available offering is both
+        # cheaper than the prefix's total cost and — once ANY option type
+        # overlaps a deleted node — cheaper than the cheapest such node.
+        # Per-type cheapest-available prices under-estimate real option
+        # prices, which over-includes types on the OPTION side (safe) but
+        # can over-include them on the same-type CAP side too (a type
+        # whose only requirement-compatible offerings are pricier than
+        # the global cheapest would not cap the host's filter): the
+        # ladder's misses are therefore only DEFINITIVE when every type's
+        # available offerings carry one price — heterogeneous catalogs
+        # hand the caller a seed instead, and the gallop/search recovers.
         prices = np.array(
             [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64
         )
@@ -537,13 +931,52 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates,
         # path outright (candidate_prices' getCandidatePrices stance)
         prefix_known = np.logical_and.accumulate(prices > 0)
         prefix_price = np.cumsum(prices)
-        feasible &= (used == 0) | (
-            prefix_known & (bundle.min_price < prefix_price)
-        )
+        p_by_name: dict = {}
+        for t, (_, it) in enumerate(bundle.snap.type_refs):
+            avail = bundle.snap.off_price[t][bundle.snap.off_avail[t]]
+            if avail.size:
+                p = float(avail.min())
+                if p < p_by_name.get(it.name, np.inf):
+                    p_by_name[it.name] = p
+        if p_by_name:
+            p_cat = np.fromiter(p_by_name.values(), dtype=np.float64)
+            name_idx = {nm: j for j, nm in enumerate(p_by_name)}
+            # cumulative cheapest candidate price per type over the prefix
+            cheapest = np.full((N, len(p_cat)), np.inf)
+            cur = np.full(len(p_cat), np.inf)
+            for i, c in enumerate(candidates):
+                nm = getattr(getattr(c, "instance_type", None), "name", None)
+                j = name_idx.get(nm)
+                if j is not None and prices[i] > 0:
+                    cur[j] = min(cur[j], prices[i])
+                cheapest[i] = cur
+            is_option = p_cat[None, :] < prefix_price[:, None]
+            overlap = is_option & np.isfinite(cheapest)
+            max_price = np.where(overlap, cheapest, np.inf).min(axis=1)
+            claim_ok = (
+                is_option & (p_cat[None, :] < max_price[:, None])
+            ).any(axis=1)
+        else:
+            claim_ok = np.zeros(N, dtype=bool)
+        feasible &= (used == 0) | (prefix_known & claim_ok)
+        # misses are definitive when the claim accounting above mirrored
+        # the sim (claimability proven, or no pending/drain pods rode the
+        # rows at all). The same-type cap-side corner noted above is the
+        # one residual under-approximation and is benign in direction: a
+        # rare smaller-than-optimal command this round, re-examined at the
+        # next generation — never an unsafe or permanently-skipped
+        # consolidation (the k<2 path always escalates total misses to the
+        # reference's full search).
+        definitive = base_exempt_ok
+    else:
+        # topology ladders stay a SEED: per-group "the candidates' pods
+        # land" only (a stuck pending pod must not poison the batch — the
+        # waves counterfactual already makes these rows approximate)
+        feasible = (placed_g[:, :G] >= cum).all(axis=1)
+        definitive = False
     ks = np.flatnonzero(feasible)
-    if ks.size == 0:
-        return 0
-    return int(ks[-1]) + 1
+    k = 0 if ks.size == 0 else int(ks[-1]) + 1
+    return k, definitive
 
 
 def batched_single_feasible(provisioner, cluster, store, candidates,

@@ -16,6 +16,41 @@ Design notes:
 - The one-way Compatible rule (custom labels undefined on the claim are
   denied — requirements.go:174) is per (group, template) and becomes the
   g_tmpl_ok tensor.
+
+Existing-node delta contract
+----------------------------
+
+``tensorize_existing`` compiles the WHOLE fleet from scratch: O(E×G) for
+admission plus an O(E) Python loop over node state. Steady-state disruption
+rounds mutate only a handful of nodes between generations, so
+:class:`ExistingSnapshot` maintains itself by deltas instead
+(``apply_delta``), fed from the structured journal ``state/cluster.py``
+emits alongside every ``consolidation_state`` generation bump:
+
+* **What patches.** Node-scoped changes only. A *dirty* node (pod
+  bind/unbind/delete on it, label/taint/capacity update, claim flap) has
+  its row — ``e_avail``/``e_npods``/``e_scnt``/``e_decl``/``e_match``/
+  ``e_aff`` and its ``ge_ok`` column — recomputed from live state by
+  running ``tensorize_existing`` over just that node and splicing the
+  result, so a patched row is bit-identical to a from-scratch build by
+  construction. An *added* node appends a row; a *removed* node is MASKED
+  in place (``live[row] = False``, zero capacity, admission denied) rather
+  than compacted, keeping the E axis — and therefore the pow-2 padded
+  shape the kernels compile against — stable as the fleet shrinks.
+* **What invalidates.** Anything that changes the GROUP or TYPE side of
+  the snapshot the rows are indexed against: nodepool/daemonset events
+  (solver inputs), a pod whose scheduling signature matches no existing
+  group (new vocabulary/group set), topology-compiled plans (the waves
+  domain counts are position-dependent), nodepool limits (usage drifts
+  with every node change), and any opaque journal entry. Consumers
+  (ops/consolidate.py ``DisruptionSnapshot.advance``) fall back to a full
+  rebuild in every such case — the delta layer is an optimization, never
+  the only correct path.
+* **Accounting.** ``STATS`` tracks tensorize/delta wall clock and the
+  ``karpenter_tensorize_negative_avail_total`` counter records every
+  negative availability the build clamps to zero (a node whose bound pods
+  exceed its allocatable is a capacity-accounting bug that must surface,
+  not vanish into ``max(v, 0.0)``).
 """
 
 from __future__ import annotations
@@ -42,6 +77,22 @@ WORD = 32
 # native/kernel.cpp mirrors these values — keep them in sync.
 UNCAPPED = 1 << 30
 SPREAD_OWNED_MIN = 1 << 29
+
+# process-wide tensorize accounting, read by the perf harness (`python -m
+# perf --json 4`) — a plain dict instead of the metrics registry because
+# tensorize runs below the layers that carry one (the negative-avail count
+# ALSO lands on a registry counter for the scrape; see tensorize_existing)
+STATS = {
+    "existing_calls": 0,
+    "existing_ms": 0.0,
+    "delta_applies": 0,
+    "delta_rows": 0,
+    "negative_avail_total": 0,
+}
+
+# the scrape-plane family name lives in operator/metrics.py
+# (TENSORIZE_NEGATIVE_AVAIL); resolved lazily at the increment site so this
+# low-level module never imports the operator package at import time
 
 
 def _bits_for(n_values: int) -> int:
@@ -212,20 +263,99 @@ class ExistingSnapshot:
     e_decl: np.ndarray  # [E,CW] u32 anti classes declared by current pods
     e_match: np.ndarray  # [E,CW] u32 anti classes matching current pods
     e_aff: np.ndarray  # [E,A] i32 affinity-class matched-pod counts
+    # delta-maintenance bookkeeping (module docstring "Existing-node delta
+    # contract"): provider id -> row, and which rows still represent live
+    # nodes (removed nodes are masked in place, never compacted, so the E
+    # axis — and the pow-2 pad family over it — is stable as E shrinks)
+    row_of: dict = field(default_factory=dict)
+    live: np.ndarray | None = None
+
+    def __post_init__(self):
+        if self.live is None:
+            self.live = np.ones(len(self.nodes), dtype=bool)
+        if not self.row_of and self.nodes:
+            self.row_of = {
+                n.state_node.provider_id: i for i, n in enumerate(self.nodes)
+            }
 
     @property
     def E(self):
         return len(self.nodes)
 
+    def apply_delta(self, snap, dirty=(), removed=(), added=(),
+                    device_plan=None, registry=None):
+        """Patch this snapshot in place instead of re-tensorizing the fleet.
 
-def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
+        ``dirty``: ExistingNodes (already present) whose rows are rebuilt
+        from live state; ``removed``: provider ids whose rows are masked;
+        ``added``: ExistingNodes appended as new rows. Dirty and added rows
+        are computed by running :func:`tensorize_existing` over exactly
+        those nodes and splicing the result, so a patched row is
+        bit-identical to a from-scratch build by construction. Raises
+        KeyError when a dirty node was never tensorized — the caller
+        (ops/consolidate.py advance) must route such nodes through
+        ``added`` or rebuild."""
+        dirty = list(dirty)
+        added = list(added)
+        if dirty or added:
+            mini = tensorize_existing(snap, dirty + added, device_plan,
+                                      registry=registry)
+        for j, node in enumerate(dirty):
+            r = self.row_of[node.state_node.provider_id]
+            self.nodes[r] = node
+            self.e_avail[r] = mini.e_avail[j]
+            self.ge_ok[:, r] = mini.ge_ok[:, j]
+            self.e_npods[r] = mini.e_npods[j]
+            self.e_scnt[r] = mini.e_scnt[j]
+            self.e_decl[r] = mini.e_decl[j]
+            self.e_match[r] = mini.e_match[j]
+            self.e_aff[r] = mini.e_aff[j]
+            self.live[r] = True
+        for pid in removed:
+            r = self.row_of.get(pid)
+            if r is None or not self.live[r]:
+                continue
+            self.live[r] = False
+            self.e_avail[r] = 0.0
+            self.ge_ok[:, r] = False
+            self.e_npods[r] = 0
+            self.e_scnt[r] = 0
+            self.e_decl[r] = 0
+            self.e_match[r] = 0
+            self.e_aff[r] = 0
+        if added:
+            k = len(dirty)
+            E0 = len(self.nodes)
+            self.e_avail = np.concatenate([self.e_avail, mini.e_avail[k:]])
+            self.ge_ok = np.concatenate([self.ge_ok, mini.ge_ok[:, k:]], axis=1)
+            self.e_npods = np.concatenate([self.e_npods, mini.e_npods[k:]])
+            self.e_scnt = np.concatenate([self.e_scnt, mini.e_scnt[k:]])
+            self.e_decl = np.concatenate([self.e_decl, mini.e_decl[k:]])
+            self.e_match = np.concatenate([self.e_match, mini.e_match[k:]])
+            self.e_aff = np.concatenate([self.e_aff, mini.e_aff[k:]])
+            self.live = np.concatenate(
+                [self.live, np.ones(len(added), dtype=bool)])
+            for j, node in enumerate(added):
+                self.nodes.append(node)
+                self.row_of[node.state_node.provider_id] = E0 + j
+        STATS["delta_applies"] += 1
+        STATS["delta_rows"] += len(dirty) + len(removed) + len(added)
+
+
+def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None,
+                       registry=None):
     """Compile ExistingNode capacity into the kernel's pre-loaded-bin
     tensors. `snap` supplies the interned vocabulary/resource axes;
     `device_plan` (waves) supplies the conflict/spread class indices whose
-    per-node counts come from each TopologyGroup's hostname domain map."""
+    per-node counts come from each TopologyGroup's hostname domain map.
+    `registry` (optional, defaults to the process registry) receives the
+    negative-availability counter."""
+    import time
+
     from karpenter_tpu.api import labels as wk
     from karpenter_tpu.scheduling import Taints as TaintSet
 
+    t_start = time.perf_counter()
     E = len(existing_nodes)
     G = snap.G
     R = len(snap.resources)
@@ -244,10 +374,20 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
 
     e_mask = np.zeros((E, K, snap.W), dtype=np.uint32)
     e_has = np.zeros((E, K), dtype=bool)
+    negative = 0
+    neg_example = None
     for e, node in enumerate(existing_nodes):
         avail = resutil.subtract(node.cached_available, node.requests)
         for r, v in avail.items():
             if r in snap.resources:
+                if v < 0.0:
+                    # a bound-pod total exceeding allocatable is a capacity-
+                    # accounting bug upstream — clamping keeps the kernel
+                    # sound (a full node just admits nothing) but the clamp
+                    # must be VISIBLE, not a silent max()
+                    negative += 1
+                    if neg_example is None:
+                        neg_example = (node.state_node.name, r, v)
                 e_avail[e, snap.resources.index(r)] = max(v, 0.0)
         e_mask[e], e_has[e], _ = snap.mask_set(node.requirements)
         e_npods[e] = len(node.state_node.pods)
@@ -303,6 +443,25 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None):
                 if not hreqs[g].has(node.state_node.hostname):
                     ge_ok[g, e] = False
 
+    if negative:
+        import logging
+
+        from karpenter_tpu.operator import metrics as _m
+
+        STATS["negative_avail_total"] += negative
+        if registry is None:
+            registry = _m.REGISTRY
+        registry.counter(
+            _m.TENSORIZE_NEGATIVE_AVAIL,
+            "negative node availabilities clamped to zero during "
+            "tensorization (capacity-accounting bug upstream)",
+        ).inc(negative)
+        name, res, v = neg_example
+        logging.getLogger(__name__).warning(
+            "tensorize_existing clamped %d negative availabilities this "
+            "round (first: node %s %s=%s)", negative, name, res, v)
+    STATS["existing_calls"] += 1
+    STATS["existing_ms"] += (time.perf_counter() - t_start) * 1000.0
     return ExistingSnapshot(
         nodes=list(existing_nodes),
         e_avail=e_avail,

@@ -63,7 +63,13 @@ class HostPortUsage:
         return None
 
     def add(self, pod):
-        self._by_pod[pod.key()] = pod_host_ports(pod)
+        ports = pod_host_ports(pod)
+        if not ports:
+            # a port-less pod reserves nothing: storing its empty entry
+            # only bloats every snapshot/fork copy to O(pods-on-node)
+            self._by_pod.pop(pod.key(), None)
+            return
+        self._by_pod[pod.key()] = ports
 
     def remove(self, pod_key: str):
         self._by_pod.pop(pod_key, None)

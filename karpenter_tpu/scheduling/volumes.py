@@ -51,6 +51,11 @@ class VolumeUsage:
 
     def add(self, pod, kube=None):
         vols = self.pod_volumes(pod, kube)
+        if not vols:
+            # volume-less pods count nothing: an empty entry only bloats
+            # every snapshot/fork copy to O(pods-on-node)
+            self._by_pod.pop(pod.key(), None)
+            return
         self._by_pod[pod.key()] = vols
         for driver, vol in vols:
             self._by_driver.setdefault(driver, set()).add(vol)

@@ -15,6 +15,7 @@ hermetic runtime has a single watch stream).
 
 from __future__ import annotations
 
+import collections
 import itertools
 
 from karpenter_tpu.api import labels as wk
@@ -22,6 +23,13 @@ from karpenter_tpu.state.statenode import StateNode
 from karpenter_tpu.utils import pod as pod_util
 
 _anon_counter = itertools.count(1)
+
+# journal capacity: must cover every informer event between two disruption
+# snapshot reads or the consumer sees a gap and rebuilds from scratch. A
+# 1000-node consolidation wave generates ~4-5k events (pod deletes +
+# recreates + binds + node/claim deletes), so 16k leaves real headroom
+# while bounding memory to one deque of small tuples.
+DELTA_JOURNAL_CAP = 16384
 
 
 class Cluster:
@@ -36,6 +44,14 @@ class Cluster:
         self._bindings: dict = {}  # pod key -> node name
         self._antiaffinity_pods: dict = {}  # pod key -> Pod (bound, w/ required anti-affinity)
         self._state_seq: int = 0
+        # structured delta journal: one entry per generation bump, consumed
+        # by the disruption snapshot cache (ops/consolidate.py) to patch its
+        # tensorized view instead of rebuilding. Entry = (seq, delta) where
+        # delta is ("node", provider_id), ("pod", pod, node_name|None, gone)
+        # or None (opaque: the consumer must rebuild from scratch).
+        self._delta_journal: collections.deque = collections.deque(
+            maxlen=DELTA_JOURNAL_CAP
+        )
 
     # -- informer entry point -------------------------------------------
     def on_event(self, event):
@@ -73,7 +89,7 @@ class Cluster:
         self._claim_name_to_pid.clear()
         self._bindings.clear()
         self._antiaffinity_pods.clear()
-        self._state_seq += 1
+        self.mark_unconsolidated()  # opaque: a rebuilt mirror has no delta
         for claim in self.store.list("nodeclaims"):
             self.update_node_claim(claim)
         for node in self.store.list("nodes"):
@@ -99,10 +115,11 @@ class Cluster:
             if old is not None:
                 old.node = None
                 self._gc(old_pid)
+            self.mark_unconsolidated(("node", old_pid))
         sn = self._state_for(pid)
         sn.node = node
         self._node_name_to_pid[node.name] = pid
-        self.mark_unconsolidated()
+        self.mark_unconsolidated(("node", pid))
         return sn
 
     def delete_node(self, node):
@@ -113,7 +130,7 @@ class Cluster:
         if sn is not None:
             sn.node = None
             self._gc(pid)
-        self.mark_unconsolidated()
+        self.mark_unconsolidated(("node", pid))
 
     def update_node_claim(self, claim):
         pid = claim.status.provider_id or claim.name
@@ -130,10 +147,11 @@ class Cluster:
                     existing.marked_for_deletion |= old.marked_for_deletion
                 else:
                     self._nodes[pid] = old
+            self.mark_unconsolidated(("node", old_pid))
         sn = self._state_for(pid)
         sn.node_claim = claim
         self._claim_name_to_pid[claim.name] = pid
-        self.mark_unconsolidated()
+        self.mark_unconsolidated(("node", pid))
         return sn
 
     def delete_node_claim(self, claim):
@@ -144,7 +162,7 @@ class Cluster:
         if sn is not None:
             sn.node_claim = None
             self._gc(pid)
-        self.mark_unconsolidated()
+        self.mark_unconsolidated(("node", pid))
 
     def _gc(self, pid: str):
         sn = self._nodes.get(pid)
@@ -160,6 +178,9 @@ class Cluster:
         bound = self._bindings.get(key)
         if bound is not None and bound != pod.node_name:
             self._unbind(key, bound)
+            # the OLD node's usage changed too: journal it so the snapshot
+            # cache rebuilds that row as well as the new binding's
+            self.mark_unconsolidated(("pod", pod, bound, True))
             bound = None
         if pod.node_name and bound is None:
             self._bindings[key] = pod.node_name
@@ -184,7 +205,7 @@ class Cluster:
         # unbound pending pod joining the counterfactual baseline. The
         # consolidation_state() contract makes this mandatory; keeping the
         # bump unconditional means a future branch cannot silently miss it.
-        self.mark_unconsolidated()
+        self.mark_unconsolidated(("pod", pod, pod.node_name or None, False))
 
     def delete_pod(self, pod):
         key = pod.key()
@@ -192,7 +213,7 @@ class Cluster:
         if bound is not None:
             self._unbind(key, bound)
         self._antiaffinity_pods.pop(key, None)
-        self.mark_unconsolidated()
+        self.mark_unconsolidated(("pod", pod, bound, True))
 
     def _unbind(self, key: str, node_name: str):
         sn = self._node_by_name(node_name)
@@ -259,21 +280,56 @@ class Cluster:
             sn = self._nodes.get(pid)
             if sn is not None:
                 sn.marked_for_deletion = True
-        self.mark_unconsolidated()
+            self.mark_unconsolidated(("node", pid))
+        if not provider_ids:
+            self.mark_unconsolidated()
 
     def unmark_for_deletion(self, *provider_ids):
         for pid in provider_ids:
             sn = self._nodes.get(pid)
             if sn is not None:
                 sn.marked_for_deletion = False
-        self.mark_unconsolidated()
+            self.mark_unconsolidated(("node", pid))
+        if not provider_ids:
+            self.mark_unconsolidated()
 
     # -- consolidation fence (cluster.go:310-337) ------------------------
-    def mark_unconsolidated(self) -> int:
+    def mark_unconsolidated(self, delta=None) -> int:
         """Bump the state sequence. The reference uses a timestamp; a
-        sequence number gives the same fencing under a fake clock."""
+        sequence number gives the same fencing under a fake clock.
+
+        ``delta`` optionally journals a STRUCTURED description of what
+        moved — ("node", provider_id) for any node/claim-scoped change,
+        ("pod", pod, node_name|None, gone) for pod lifecycle — letting the
+        disruption snapshot cache patch its tensorized view instead of
+        rebuilding (ops/tensorize.py documents the delta contract). None
+        journals an OPAQUE bump: consumers must treat the cached view as
+        unreconstructible and rebuild. Passing no delta is therefore always
+        safe, only slower."""
         self._state_seq += 1
+        self._delta_journal.append((self._state_seq, delta))
         return self._state_seq
+
+    def deltas_since(self, generation: int) -> list | None:
+        """Journal entries for every bump in (generation, current], oldest
+        first, or None when the journal no longer covers that range (entries
+        aged out of the capped deque, or `generation` predates this process).
+        A None return — like any None entry inside the list — means the
+        consumer cannot patch and must rebuild."""
+        if generation == self._state_seq:
+            return []
+        out = []
+        for seq, delta in reversed(self._delta_journal):
+            if seq <= generation:
+                break
+            out.append(delta)
+        else:
+            # walked off the journal without reaching `generation`: entries
+            # between it and the oldest retained seq are lost
+            if not self._delta_journal or self._delta_journal[0][0] != generation + 1:
+                return None
+        out.reverse()
+        return out
 
     def consolidation_state(self) -> int:
         """Fence for consolidation decisions: if unchanged since the last

@@ -5,6 +5,9 @@ Usage:
     python -m perf 1 3 5           # a subset
     python -m perf 4               # the consolidation benchmark alone
                                    # (PERF_CONSOLIDATION_NODES=300 default)
+    python -m perf --json 4        # + per-layer consolidation breakdown
+                                   # (tensorize_existing_ms, confirm_ladder_ms,
+                                   # host_confirm_count, snapshot_delta)
     python -m perf grid            # the reference {1..5000}x400 grid
                                    # (scheduling_benchmark_test.go:77-97)
 
@@ -89,11 +92,18 @@ def run_solve_config(name, pods, pools, catalog, **solver_kw):
     print(json.dumps(out))
 
 
-def run_consolidation_config(n_nodes=None):
+def run_consolidation_config(n_nodes=None, breakdown=False):
+    import importlib
+
+    # NOT `from karpenter_tpu.ops import tensorize` — the package __init__
+    # re-exports the tensorize FUNCTION under that name, shadowing the module
+    _tz = importlib.import_module("karpenter_tpu.ops.tensorize")
+
     n_nodes = n_nodes or int(os.environ.get("PERF_CONSOLIDATION_NODES", "300"))
     env = C.config4_consolidation_env(n_nodes)
     start_nodes = len(env.store.list("nodes"))
     start_pods = len([p for p in env.store.list("pods") if p.node_name])
+    stats0 = dict(_tz.STATS)  # process-wide: delta against the env build
     t0 = time.perf_counter()
     rounds = 0
     stable = 0
@@ -110,6 +120,37 @@ def run_consolidation_config(n_nodes=None):
     from karpenter_tpu.operator import metrics as m
 
     batch_hist = env.registry.histogram(m.DISRUPTION_PROBE_BATCH_SIZE)
+    out_extra = {}
+    if breakdown:
+        # the per-layer consolidation cost split (`python -m perf --json 4`):
+        # where the disruption wall clock actually goes — host re-tensorize,
+        # confirming simulations, and how much of both the delta layer saved
+        confirm_hist = env.registry.histogram(m.DISRUPTION_CONFIRM_DURATION)
+        confirms = env.registry.counter(m.DISRUPTION_HOST_CONFIRMS)
+        hits = env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_HITS)
+        out_extra["breakdown"] = {
+            "tensorize_existing_ms": round(
+                _tz.STATS["existing_ms"] - stats0["existing_ms"], 2),
+            "tensorize_existing_calls": (
+                _tz.STATS["existing_calls"] - stats0["existing_calls"]),
+            "confirm_ladder_ms": round(1000 * (
+                confirm_hist.sum(method="multi")
+                + confirm_hist.sum(method="single")), 2),
+            "host_confirm_count": int(
+                confirms.value(method="multi") + confirms.value(method="single")),
+            "host_confirms": {
+                "multi": int(confirms.value(method="multi")),
+                "single": int(confirms.value(method="single")),
+            },
+            "snapshot_delta": {
+                "applies": _tz.STATS["delta_applies"] - stats0["delta_applies"],
+                "rows": _tz.STATS["delta_rows"] - stats0["delta_rows"],
+                "cache_hits": hits.value(kind="delta"),
+            },
+            "negative_avail_total": (
+                _tz.STATS["negative_avail_total"]
+                - stats0["negative_avail_total"]),
+        }
     print(json.dumps({
         "config": f"4-consolidation-{n_nodes}-underutilized",
         "start_nodes": start_nodes,
@@ -139,6 +180,7 @@ def run_consolidation_config(n_nodes=None):
         ),
         # reference budget: ≤60s per multi-node search (multinodeconsolidation.go:37)
         "within_1min_budget": bool(hist.sum(method="MultiNodeConsolidation") <= 60.0),
+        **out_extra,
     }))
 
 
@@ -170,6 +212,11 @@ def run_grid(min_values: int | None = None):
 
 def main():
     args = sys.argv[1:]
+    # --json: the consolidation config additionally emits its cost
+    # breakdown (tensorize_existing_ms / confirm_ladder_ms /
+    # host_confirm_count / snapshot_delta) in the result line
+    breakdown = "--json" in args
+    args = [a for a in args if a != "--json"]
     if args == ["grid"]:
         run_grid()
         return
@@ -184,7 +231,7 @@ def main():
     if 3 in picks:
         run_solve_config("3-antiaffinity-spread-5k", *C.config3_antiaffinity_spread())
     if 4 in picks:
-        run_consolidation_config()
+        run_consolidation_config(breakdown=breakdown)
     if 5 in picks:
         run_solve_config("5-burst-gpu-50k", *C.config5_burst_gpu())
 

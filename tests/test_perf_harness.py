@@ -88,7 +88,7 @@ class TestConsolidationMicroBench:
         # KARPENTER_NATIVE_CUTOFF=0 so unit tests keep the XLA kernel under
         # coverage, but the benchmark exists to track the production path
         monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
-        run_consolidation_config(300)
+        run_consolidation_config(300, breakdown=True)
         out = capsys.readouterr().out
         data = json.loads(out.strip().splitlines()[-1])
         assert data["end_nodes"] == 100, data
@@ -97,3 +97,15 @@ class TestConsolidationMicroBench:
         assert data["probe_batches"]["single"] >= 1, data
         assert data["snapshot_cache"]["hits"] >= 1, data
         assert data["within_1min_budget"], data
+        # the batched confirm ladder: on the seeded fixture every MultiNode
+        # round resolves with at most ONE confirming host simulation (the
+        # probe's definitive ladder is trusted; a regression here means the
+        # probe and the host model drifted apart and the binary search is
+        # silently back)
+        bd = data["breakdown"]
+        assert bd["host_confirms"]["multi"] <= data["multinode_evals"], data
+        # the delta layer actually served rounds (cache misses would
+        # otherwise equal every generation bump)
+        assert bd["snapshot_delta"]["applies"] >= 1, data
+        assert bd["snapshot_delta"]["cache_hits"] >= 1, data
+        assert bd["negative_avail_total"] == 0, data

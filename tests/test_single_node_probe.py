@@ -185,7 +185,12 @@ class TestSnapshotCache:
         assert misses == 1, "the round must tensorize exactly once"
         assert hits >= 1, "the second probe must ride the cached snapshot"
 
-    def test_store_mutation_bumps_generation_and_forces_retensorize(self):
+    def test_store_mutation_bumps_generation_and_updates_snapshot(self):
+        """A pod-scoped mutation flowing the informer path bumps the
+        generation; the cache must NOT serve the stale view — it either
+        delta-advances the bundle in place (this case: the deleted pod's
+        node row is rebuilt from live state) or re-tensorizes. An OPAQUE
+        bump (nodepool event) must force the full rebuild."""
         env = build_random_env(5)
         d = env.disruption
         cache = d.ctx.snapshot_cache
@@ -193,22 +198,42 @@ class TestSnapshotCache:
         b1 = cache.get(d.provisioner, d.cluster, d.store, candidates,
                        registry=env.registry)
         assert b1 is not None
+        gen1 = b1.generation
         b2 = cache.get(d.provisioner, d.cluster, d.store, candidates,
                        registry=env.registry)
         assert b2 is b1, "same generation: the bundle must be reused"
 
-        # a store mutation flowing the informer path bumps the generation
+        # a pod deletion flows the informer path: expressible delta, so the
+        # SAME bundle advances to the new generation with the node's row
+        # (its pod count, its availability) patched from live state
         pod = next(p for p in env.store.list("pods") if p.node_name)
+        node_row = b1.esnap.row_of[
+            env.cluster.node_by_name(pod.node_name).provider_id]
+        npods_before = int(b1.esnap.e_npods[node_row])
         env.store.delete("pods", pod)
         for event in env.store.drain_events():
             env.cluster.on_event(event)
 
         b3 = cache.get(d.provisioner, d.cluster, d.store, candidates,
                        registry=env.registry)
-        assert b3 is not b1, "generation bump must force a re-tensorize"
-        assert b3 is not None and b3.generation > b1.generation
-        misses = env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value()
-        assert misses == 2
+        assert b3 is b1, "pod-scoped bump must delta-advance, not rebuild"
+        assert b3.generation == env.cluster.consolidation_state() > gen1
+        assert int(b3.esnap.e_npods[node_row]) == npods_before - 1
+        hits = env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_HITS)
+        assert hits.value(kind="delta") == 1
+        assert env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value() == 1
+
+        # an opaque bump (nodepool change: solver inputs move) is
+        # inexpressible by design — the cache must rebuild from scratch
+        pool = env.store.list("nodepools")[0]
+        env.store.update("nodepools", pool)
+        for event in env.store.drain_events():
+            env.cluster.on_event(event)
+        b4 = cache.get(d.provisioner, d.cluster, d.store, candidates,
+                       registry=env.registry)
+        assert b4 is not b1, "opaque bump must force a re-tensorize"
+        assert b4 is not None and b4.generation > b3.generation
+        assert env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value() == 2
 
     def test_negative_serve_counted_separately(self, monkeypatch):
         """A generation-stable failed build is served from the negative

@@ -1,0 +1,253 @@
+"""Delta/full parity for the existing-node snapshot (this PR's tentpole).
+
+``ExistingSnapshot.apply_delta`` patches dirty rows, masks removed nodes in
+place, and appends added nodes — and the result must be BIT-IDENTICAL to a
+from-scratch ``tensorize_existing`` over the surviving fleet, because a
+drifted row silently corrupts every consolidation probe sharing the bundle.
+The randomized suite interleaves pod binds/unbinds, node deletes, node adds
+and label flips across ≥200 seeded mutation sequences; the cache suite
+proves the inexpressible-delta paths actually fall back to a rebuild.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import Node, ObjectMeta, Pod, Taint, Toleration
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.models import ClaimTemplate
+from karpenter_tpu.models.existing import ExistingNode
+from karpenter_tpu.models.scheduler import NullTopology
+from karpenter_tpu.operator.metrics import (
+    TENSORIZE_NEGATIVE_AVAIL as NEGATIVE_AVAIL_METRIC,
+)
+from karpenter_tpu.ops.tensorize import (
+    STATS,
+    tensorize,
+    tensorize_existing,
+)
+from karpenter_tpu.state.statenode import StateNode
+
+GIB = 2**30
+ZONES = ("zone-1", "zone-2")
+
+
+def build_snap():
+    """Small device snapshot with a few distinct group shapes (plain, zone
+    selector, toleration) so ge_ok has real structure to drift on."""
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    catalog = [
+        make_instance_type("small", 4, 16, zones=ZONES),
+        make_instance_type("large", 16, 64, zones=ZONES),
+    ]
+    pods = [
+        Pod(metadata=ObjectMeta(name="plain"), requests={"cpu": 1.0, "memory": GIB}),
+        Pod(metadata=ObjectMeta(name="zonal"), requests={"cpu": 2.0, "memory": GIB},
+            node_selector={wk.TOPOLOGY_ZONE_LABEL: "zone-2"}),
+        Pod(metadata=ObjectMeta(name="tol"), requests={"cpu": 0.5, "memory": GIB},
+            tolerations=[Toleration(key="dedicated", operator="Equal",
+                                    value="batch", effect="NoSchedule")]),
+    ]
+    return tensorize(pods, [ClaimTemplate(pool)], {"default": catalog})
+
+
+def make_state_node(name, rng):
+    sn = StateNode(provider_id=f"pid-{name}")
+    node = Node(metadata=ObjectMeta(name=name, labels={
+        wk.NODEPOOL_LABEL: "default",
+        wk.TOPOLOGY_ZONE_LABEL: rng.choice(ZONES),
+        wk.INSTANCE_TYPE_LABEL: rng.choice(["small", "large"]),
+        wk.CAPACITY_TYPE_LABEL: "on-demand",
+        wk.HOSTNAME_LABEL: name,
+    }))
+    node.allocatable = {
+        "cpu": float(rng.choice([4, 8, 16])),
+        "memory": float(rng.choice([16, 32])) * GIB,
+        "pods": 110.0,
+    }
+    if rng.random() < 0.25:
+        node.taints = [Taint("dedicated", "batch", "NoSchedule")]
+    sn.node = node
+    return sn
+
+
+def make_enode(sn):
+    return ExistingNode(sn, NullTopology())
+
+
+FIELDS = ("e_avail", "ge_ok", "e_npods", "e_scnt", "e_decl", "e_match", "e_aff")
+
+
+def assert_parity(snap, esnap, by_pid, seed, step):
+    """The delta-maintained snapshot's LIVE projection must be bit-identical
+    to a from-scratch tensorize_existing over the same nodes in row order."""
+    live_rows = np.flatnonzero(esnap.live)
+    live_nodes = [by_pid[esnap.nodes[r].state_node.provider_id] for r in live_rows]
+    fresh = tensorize_existing(snap, live_nodes)
+    for f in FIELDS:
+        got = getattr(esnap, f)
+        got = got[:, live_rows] if f == "ge_ok" else got[live_rows]
+        want = getattr(fresh, f)
+        assert got.dtype == want.dtype, (seed, step, f)
+        assert np.array_equal(got, want), (
+            f"seed={seed} step={step} field={f} diverged:\n{got}\nvs\n{want}"
+        )
+
+
+def run_sequence(seed, steps=8):
+    rng = random.Random(seed)
+    snap = build_snap()
+    n0 = rng.randint(2, 5)
+    state_by_pid = {}
+    for i in range(n0):
+        sn = make_state_node(f"n{seed}-{i}", rng)
+        state_by_pid[sn.provider_id] = sn
+    enode_by_pid = {pid: make_enode(sn) for pid, sn in state_by_pid.items()}
+    esnap = tensorize_existing(snap, list(enode_by_pid.values()))
+    counter = [n0]
+
+    def live_pids():
+        return [
+            esnap.nodes[r].state_node.provider_id
+            for r in np.flatnonzero(esnap.live)
+        ]
+
+    for step in range(steps):
+        op = rng.choice(["bind", "unbind", "delete", "add", "relabel"])
+        pids = live_pids()
+        if op == "add" or not pids:
+            sn = make_state_node(f"n{seed}-{counter[0]}", rng)
+            counter[0] += 1
+            state_by_pid[sn.provider_id] = sn
+            en = make_enode(sn)
+            enode_by_pid[sn.provider_id] = en
+            esnap.apply_delta(snap, added=[en])
+        elif op == "delete":
+            pid = rng.choice(pids)
+            esnap.apply_delta(snap, removed=[pid])
+        else:
+            pid = rng.choice(pids)
+            sn = state_by_pid[pid]
+            if op == "bind":
+                # occasionally overflow allocatable so the negative-avail
+                # clamp path stays under parity coverage too
+                cpu = float(rng.choice([1, 2, 64 if rng.random() < 0.1 else 4]))
+                p = Pod(metadata=ObjectMeta(name=f"b{seed}-{step}"),
+                        requests={"cpu": cpu, "memory": GIB})
+                p.node_name = sn.name
+                sn.pods[p.key()] = p
+            elif op == "unbind" and sn.pods:
+                sn.pods.pop(next(iter(sn.pods)))
+            elif op == "relabel":
+                lbl = sn.node.metadata.labels
+                lbl[wk.TOPOLOGY_ZONE_LABEL] = (
+                    "zone-1" if lbl[wk.TOPOLOGY_ZONE_LABEL] == "zone-2"
+                    else "zone-2"
+                )
+            en = make_enode(sn)
+            enode_by_pid[pid] = en
+            esnap.apply_delta(snap, dirty=[en])
+        assert_parity(snap, esnap, enode_by_pid, seed, step)
+    return esnap
+
+
+class TestDeltaFullParity:
+    @pytest.mark.parametrize("block", range(8))
+    def test_randomized_mutation_sequences(self, block):
+        """≥200 seeded sequences (8 blocks × 25), parity asserted after
+        EVERY mutation — bit-identical tensors, exact dtypes."""
+        for seed in range(block * 25, block * 25 + 25):
+            run_sequence(seed)
+
+    def test_removed_rows_are_masked_not_compacted(self):
+        rng = random.Random(0)
+        snap = build_snap()
+        sns = [make_state_node(f"m{i}", rng) for i in range(4)]
+        ens = [make_enode(sn) for sn in sns]
+        esnap = tensorize_existing(snap, ens)
+        E0 = esnap.E
+        pid = sns[1].provider_id
+        row = esnap.row_of[pid]
+        esnap.apply_delta(snap, removed=[pid])
+        # the E axis must NOT shrink (compile-family stability) and the
+        # masked row must be inert: no capacity, no admission, no counts
+        assert esnap.E == E0
+        assert not esnap.live[row]
+        assert not esnap.e_avail[row].any()
+        assert not esnap.ge_ok[:, row].any()
+        assert esnap.e_npods[row] == 0
+        # removing twice is a no-op, and a revive (dirty) restores the row
+        esnap.apply_delta(snap, removed=[pid])
+        esnap.apply_delta(snap, dirty=[ens[1]])
+        assert esnap.live[row]
+        fresh = tensorize_existing(snap, [ens[1]])
+        assert np.array_equal(esnap.e_avail[row], fresh.e_avail[0])
+        assert np.array_equal(esnap.ge_ok[:, row], fresh.ge_ok[:, 0])
+
+    def test_unseen_pod_signature_forces_full_rebuild(self):
+        """A pod whose scheduling signature matches no tensorized group is
+        inexpressible on the cached group axis: the cache must re-tensorize
+        (miss), never delta-advance onto a stale vocabulary."""
+        from karpenter_tpu.api.nodepool import (
+            NodePool as NP,
+        )
+        from karpenter_tpu.controllers.disruption.helpers import get_candidates
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator import metrics as m
+
+        env = Environment(
+            instance_types=[make_instance_type("small", 4, 16)],
+            enable_disruption=True,
+        )
+        env.disruption.poll_period = float("inf")
+        pool = NP(metadata=ObjectMeta(name="default"))
+        pool.spec.disruption.consolidate_after = 0.0
+        env.create("nodepools", pool)
+        env.provision(
+            Pod(metadata=ObjectMeta(name="p1"), requests={"cpu": 1.0}),
+            Pod(metadata=ObjectMeta(name="p2"), requests={"cpu": 1.0}),
+        )
+        d = env.disruption
+        cache = d.ctx.snapshot_cache
+        cands = get_candidates(d.cluster, d.store, d.cloud, d.clock)
+        b1 = cache.get(d.provisioner, d.cluster, d.store, cands,
+                       registry=env.registry)
+        assert b1 is not None
+
+        # a pending pod with a BRAND NEW selector shape: no existing group
+        # can absorb it, so the journal is inexpressible by definition
+        env.store.create("pods", Pod(
+            metadata=ObjectMeta(name="odd"),
+            requests={"cpu": 0.25},
+            node_selector={"accelerator": "tpu-v5e"},
+        ))
+        for event in env.store.drain_events():
+            env.cluster.on_event(event)
+        misses0 = env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value()
+        b2 = cache.get(d.provisioner, d.cluster, d.store, cands,
+                       registry=env.registry)
+        assert b2 is not b1, "unseen signature must force a full rebuild"
+        assert env.registry.counter(
+            m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value() == misses0 + 1
+
+    def test_negative_availability_is_counted_not_silent(self):
+        from karpenter_tpu.operator.metrics import Registry
+
+        rng = random.Random(1)
+        snap = build_snap()
+        sn = make_state_node("over", rng)
+        sn.node.allocatable = {"cpu": 2.0, "memory": 4 * GIB, "pods": 110.0}
+        p = Pod(metadata=ObjectMeta(name="fat"), requests={"cpu": 8.0,
+                                                           "memory": GIB})
+        p.node_name = sn.name
+        sn.pods[p.key()] = p
+        reg = Registry()
+        before = STATS["negative_avail_total"]
+        esnap = tensorize_existing(snap, [make_enode(sn)], registry=reg)
+        assert reg.counter(NEGATIVE_AVAIL_METRIC).value() >= 1
+        assert STATS["negative_avail_total"] > before
+        # and the tensor itself is clamped, never negative
+        assert (esnap.e_avail >= 0).all()
