@@ -285,6 +285,23 @@ class Provisioner:
             volume_topology=vt,
             existing_base=existing_base,
         )
+        # host-routed accounting (live batches only — disruption
+        # counterfactuals must not inflate the counter, helpers.go:84
+        # stance): pods the device compiler handed to the host engine,
+        # by reason, so a grid regression is attributable from the scrape
+        if live_batch:
+            routed = getattr(
+                self.solver, "last_device_stats", None
+            ) or {}
+            routed = routed.get("host_routed") or {}
+            if routed:
+                ctr = self.registry.counter(
+                    m.PROVISIONING_HOST_ROUTED,
+                    "pods routed to the host engine per live solve, by reason",
+                )
+                for reason, count in routed.items():
+                    if count:
+                        ctr.inc(count, reason=reason)
         results.truncate_instance_types()
         return results
 

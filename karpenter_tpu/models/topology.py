@@ -242,6 +242,15 @@ class Topology:
         self.topologies: dict = {}
         self.inverse_topologies: dict = {}
         self.excluded_pods = {p.uid for p in pods}
+        # (namespace, labels) -> [tg...] whose selector matches; selects()
+        # is a pure function of those two, so pods sharing a label
+        # signature share one registry scan (the record path is
+        # per-(pod, tg) otherwise — the dominant cost of committing a
+        # device solve). update() invalidates (it can add groups).
+        self._sel_memo: dict = {}
+        # uid -> [tg...] the pod currently owns: update() un-registers via
+        # this index instead of sweeping every registry group per pod
+        self._owner_tgs: dict = {}
         if cluster is not None:
             self._update_inverse_affinities()
         for p in pods:
@@ -251,12 +260,14 @@ class Topology:
     def update(self, pod):
         """(Re)register pod as owner of its topologies; called initially and
         after each relaxation (topology.go Update:105)."""
-        for tg in self.topologies.values():
+        self._sel_memo.clear()  # may add groups below
+        for tg in self._owner_tgs.pop(pod.uid, ()):
             tg.owners.discard(pod.uid)
 
         if has_pod_anti_affinity(pod):
             self._update_inverse_anti_affinity(pod, None)
 
+        owned = []
         for tg in self._new_for_topologies(pod) + self._new_for_affinities(pod):
             key = tg.hash_key()
             existing = self.topologies.get(key)
@@ -265,6 +276,9 @@ class Topology:
                 self.topologies[key] = tg
                 existing = tg
             existing.owners.add(pod.uid)
+            owned.append(existing)
+        if owned:
+            self._owner_tgs[pod.uid] = owned
         return None
 
     def register(self, topology_key: str, domain: str):
@@ -297,11 +311,22 @@ class Topology:
         """Commit domain usage after a pod lands (topology.go Record:141)."""
         self.record_many(pod, requirements, 1)
 
+    def _selecting(self, pod) -> list:
+        """Registry groups whose selector matches this pod, memoized by
+        (namespace, labels) — the pure inputs of TopologyGroup.selects."""
+        key = (pod.namespace, tuple(sorted(pod.metadata.labels.items())))
+        sel = self._sel_memo.get(key)
+        if sel is None:
+            sel = self._sel_memo[key] = [
+                tg for tg in self.topologies.values() if tg.selects(pod)
+            ]
+        return sel
+
     def record_many(self, pod, requirements: Requirements, n: int):
         """record() with multiplicity: the device decoder lands a group of
         n identical pods in one commit; `pod` is the group representative."""
-        for tg in self.topologies.values():
-            if tg.counts(pod, requirements):
+        for tg in self._selecting(pod):
+            if tg.node_filter.matches_requirements(requirements):
                 domains = requirements.get_req(tg.key)
                 if tg.type == TYPE_ANTI_AFFINITY:
                     for v in domains.values:

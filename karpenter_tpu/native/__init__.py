@@ -50,6 +50,20 @@ def _build(so: str) -> bool:
         return False
 
 
+# shared (non-output) argtype blocks; the solve and probe-batch entries
+# differ only in the leading N, the g_count/e_avail axes, and the outputs
+_MID_ARGTYPES = (
+    [_u32p, _u8p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p,
+     _u8p, _u32p, _u32p]                                # group side
+    + [ctypes.c_int, _i32p, _u8p]                       # spread classes
+    + [ctypes.c_int, _u8p, _u8p]                        # affinity classes
+    + [ctypes.c_int, _f32p, _u8p, _i32p, _i32p, _u32p, _u32p, _i32p]  # existing
+    + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]          # type side
+    + [_i32p, _i32p, _u8p]                              # offerings
+    + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]          # templates
+)
+
+
 def load():
     """Bound karpenter_solve(), or None if the native engine is unusable."""
     global _lib, _load_failed
@@ -76,30 +90,44 @@ def load():
         fn.restype = ctypes.c_int
         fn.argtypes = (
             [ctypes.c_int] * 11
-            + [_u32p, _u8p, _u8p, _f32p, _i32p, _u8p, _u8p, _u8p, _i32p,
-               _u8p, _u32p, _u32p]                                # group side
-            + [ctypes.c_int, _i32p, _u8p]                         # spread classes
-            + [ctypes.c_int, _u8p, _u8p]                          # affinity classes
-            + [ctypes.c_int, _f32p, _u8p, _i32p, _i32p, _u32p, _u32p, _i32p]  # existing nodes
-            + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]            # type side
-            + [_i32p, _i32p, _u8p]                                # offerings
-            + [_u32p, _u8p, _u8p, _f32p, _f32p, _i32p]            # templates
+            + _MID_ARGTYPES
             + [_i32p, _i32p, _u8p, _i32p, _u8p]                   # outputs
         )
+        try:
+            bfn = lib.karpenter_solve_probe_batch
+            bfn.restype = ctypes.c_int
+            bfn.argtypes = (
+                [ctypes.c_int] * 12   # N + the 11 dims
+                + _MID_ARGTYPES
+                + [_i32p, _i32p]      # placed_g [N*G], used [N]
+            )
+        except AttributeError:
+            pass  # stale library without the batch entry: solve_step only
         _lib = lib
         return fn
+
+
+def load_probe_batch():
+    """Bound karpenter_solve_probe_batch(), or None when the library (or
+    the symbol, on a stale cached build) is unavailable."""
+    if load() is None:
+        return None
+    try:
+        return _lib.karpenter_solve_probe_batch
+    except AttributeError:
+        return None
 
 
 def available() -> bool:
     return load() is not None
 
 
-def solve_step(args: dict, max_bins: int) -> dict:
-    """Drop-in for ops.kernels.solve_step on the host: same snapshot arg
-    dict, same output dict (assign/used/tmpl/F), numpy throughout."""
-    fn = load()
-    if fn is None:
-        raise RuntimeError("native kernel unavailable (no g++?)")
+def _prep(args: dict, max_bins: int, g_count, e_avail):
+    """Shared argument marshalling for the solve and probe-batch entries:
+    (dims, mid) where dims = [G,T,K,W,R,M,O,B,Vz,Vc,CW] and mid is the
+    ctypes argument block between the dims and the outputs. ``g_count`` and
+    ``e_avail`` are passed explicitly — the batch entry feeds [N,...] rows
+    through the same positions."""
     g_mask = np.ascontiguousarray(args["g_mask"], dtype=np.uint32)
     G, K, W = g_mask.shape
     t_mask = np.ascontiguousarray(args["t_mask"], dtype=np.uint32)
@@ -144,11 +172,10 @@ def solve_step(args: dict, max_bins: int) -> dict:
     if g_amatch.shape != g_aneed.shape:
         raise ValueError(f"g_aneed/g_amatch shape mismatch: {g_aneed.shape} vs {g_amatch.shape}")
     B = int(max_bins)
-    # existing-node tensors (default: one inert zero-capacity node)
-    e_avail = np.ascontiguousarray(
-        args.get("e_avail", np.zeros((1, R), dtype=np.float32)), dtype=np.float32
-    )
-    E = e_avail.shape[0]
+    # existing-node tensors (default: one inert zero-capacity node); the
+    # probe batch passes [N,E,R] rows, so E comes from the TRAILING axes
+    e_avail = np.ascontiguousarray(e_avail, dtype=np.float32)
+    E = e_avail.shape[-2]
     ge_ok = np.ascontiguousarray(
         args.get("ge_ok", np.zeros((G, E), dtype=np.uint8)), dtype=np.uint8
     )
@@ -170,21 +197,15 @@ def solve_step(args: dict, max_bins: int) -> dict:
     if e_aff.shape != (E, A):
         raise ValueError(f"e_aff shape mismatch: {e_aff.shape} vs {(E, A)}")
 
-    assign = np.zeros((G, B), dtype=np.int32)
-    assign_e = np.zeros((G, E), dtype=np.int32)
-    used = np.zeros(B, dtype=np.uint8)
-    tmpl = np.zeros(B, dtype=np.int32)
-    F = np.zeros((G, T), dtype=np.uint8)
-
-    rc = fn(
-        G, T, K, W, R, M, O, B, gza.shape[1], gca.shape[1], CW,
+    dims = [G, T, K, W, R, M, O, B, gza.shape[1], gca.shape[1], CW]
+    mid = [
         g_mask,
         np.ascontiguousarray(args["g_has"], dtype=np.uint8),
         np.ascontiguousarray(
             args.get("g_tol", np.zeros((G, K), dtype=np.uint8)), dtype=np.uint8
         ),
         g_demand,
-        np.ascontiguousarray(args["g_count"], dtype=np.int32),
+        np.ascontiguousarray(g_count, dtype=np.int32),
         gza, gca,
         np.ascontiguousarray(args["g_tmpl_ok"], dtype=np.uint8),
         np.ascontiguousarray(
@@ -218,8 +239,31 @@ def solve_step(args: dict, max_bins: int) -> dict:
         np.ascontiguousarray(
             args.get("m_minv", np.zeros(M, dtype=np.int32)), dtype=np.int32
         ),
-        assign, assign_e, used, tmpl, F,
-    )
+    ]
+    return dims, mid
+
+
+def solve_step(args: dict, max_bins: int) -> dict:
+    """Drop-in for ops.kernels.solve_step on the host: same snapshot arg
+    dict, same output dict (assign/used/tmpl/F), numpy throughout."""
+    fn = load()
+    if fn is None:
+        raise RuntimeError("native kernel unavailable (no g++?)")
+    R = np.asarray(args["g_demand"]).shape[1]
+    e_avail = args.get("e_avail")
+    if e_avail is None:
+        e_avail = np.zeros((1, R), dtype=np.float32)
+    dims, mid = _prep(args, max_bins, args["g_count"], e_avail)
+    G, T, B = dims[0], dims[1], dims[7]
+    E = np.asarray(e_avail).shape[0]
+
+    assign = np.zeros((G, B), dtype=np.int32)
+    assign_e = np.zeros((G, E), dtype=np.int32)
+    used = np.zeros(B, dtype=np.uint8)
+    tmpl = np.zeros(B, dtype=np.int32)
+    F = np.zeros((G, T), dtype=np.uint8)
+
+    rc = fn(*dims, *mid, assign, assign_e, used, tmpl, F)
     if rc != 0:
         raise RuntimeError(f"native kernel failed: rc={rc}")
     return {
@@ -229,3 +273,33 @@ def solve_step(args: dict, max_bins: int) -> dict:
         "tmpl": tmpl,
         "F": F.astype(bool),
     }
+
+
+def solve_probe_batch(args: dict, g_count_rows, e_avail_rows, max_bins: int):
+    """Batched consolidation probe: N counterfactual rows over ONE shared
+    snapshot in a single native call. ``args`` is the kernel_args dict
+    WITHOUT g_count/e_avail (ops/tensorize.kernel_args include_counts=False);
+    ``g_count_rows`` is [N, G] i32, ``e_avail_rows`` [N, E, R] f32. The
+    engine builds feasibility once and packs per row, returning the probe
+    reductions (placed_g [N, G], used [N]) — the per-row assign/F tensors
+    never materialize host-side."""
+    fn = load_probe_batch()
+    if fn is None:
+        raise RuntimeError(
+            "native probe-batch entry unavailable (stale library or no g++)")
+    g_count_rows = np.ascontiguousarray(g_count_rows, dtype=np.int32)
+    e_avail_rows = np.ascontiguousarray(e_avail_rows, dtype=np.float32)
+    N, G = g_count_rows.shape
+    if e_avail_rows.shape[0] != N:
+        raise ValueError(
+            f"row-count mismatch: g_count {N} vs e_avail {e_avail_rows.shape[0]}")
+    dims, mid = _prep(args, max_bins, g_count_rows, e_avail_rows)
+    if dims[0] != G:
+        raise ValueError(f"g_count_rows axis {G} != snapshot G {dims[0]}")
+
+    placed_g = np.zeros((N, G), dtype=np.int32)
+    used = np.zeros(N, dtype=np.int32)
+    rc = fn(N, *dims, *mid, placed_g, used)
+    if rc != 0:
+        raise RuntimeError(f"native probe batch failed: rc={rc}")
+    return placed_g, used

@@ -142,79 +142,99 @@ inline int cap_for(const float* alloc, const float* load, const float* d, int R)
     return f <= 0.0f ? 0 : (int)f;
 }
 
-}  // namespace
+// every array the pack consults EXCEPT g_count/e_avail — the probe batch
+// entry varies those two per counterfactual row over one shared snapshot
+struct SolveShared {
+    int G, T, K, W, R, M, O, B, Vz, Vc, CW, C, A, E;
+    const uint32_t* g_mask; const uint8_t* g_has; const uint8_t* g_tol;
+    const float* g_demand;
+    const uint8_t* g_zone_allowed; const uint8_t* g_ct_allowed;
+    const uint8_t* g_tmpl_ok;
+    const int32_t* g_bin_cap; const uint8_t* g_single;
+    const uint32_t* g_decl; const uint32_t* g_match;
+    const int32_t* g_sown; const uint8_t* g_smatch;
+    const uint8_t* g_aneed; const uint8_t* g_amatch;
+    const uint8_t* ge_ok;
+    const int32_t* e_npods; const int32_t* e_scnt;
+    const uint32_t* e_decl; const uint32_t* e_match; const int32_t* e_aff;
+    const uint32_t* t_mask; const uint8_t* t_has; const uint8_t* t_tol;
+    const float* t_alloc; const float* t_cap; const int32_t* t_tmpl;
+    const int32_t* off_zone; const int32_t* off_ct; const uint8_t* off_avail;
+    const uint32_t* m_mask; const uint8_t* m_has; const uint8_t* m_tol;
+    const float* m_overhead; const float* m_limits; const int32_t* m_minv;
+};
 
-extern "C" {
-
-// Returns 0 on success. Output arrays: assign [G*B] i32 (zeroed by callee),
-// used [B] u8, tmpl_out [B] i32, F_out [G*T] u8.
-int karpenter_solve(
-    int G, int T, int K, int W, int R, int M, int O, int B, int Vz, int Vc,
-    int CW,
-    const uint32_t* g_mask, const uint8_t* g_has, const uint8_t* g_tol,
-    const float* g_demand,
-    const int32_t* g_count, const uint8_t* g_zone_allowed,
-    const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
-    const int32_t* g_bin_cap, const uint8_t* g_single,
-    const uint32_t* g_decl, const uint32_t* g_match,
-    int C, const int32_t* g_sown, const uint8_t* g_smatch,
-    int A, const uint8_t* g_aneed, const uint8_t* g_amatch,
-    int E, const float* e_avail, const uint8_t* ge_ok,
-    const int32_t* e_npods, const int32_t* e_scnt,
-    const uint32_t* e_decl, const uint32_t* e_match,
-    const int32_t* e_aff,
-    const uint32_t* t_mask, const uint8_t* t_has, const uint8_t* t_tol,
-    const float* t_alloc,
-    const float* t_cap, const int32_t* t_tmpl,
-    const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
-    const uint32_t* m_mask, const uint8_t* m_has, const uint8_t* m_tol,
-    const float* m_overhead, const float* m_limits, const int32_t* m_minv,
-    int32_t* assign, int32_t* assign_e, uint8_t* used, int32_t* tmpl_out,
-    uint8_t* F_out) {
-
-    // ---- feasibility: F[g,t] = requirement ∧ fit-one ∧ offering ----
-    std::vector<uint8_t> F((size_t)G * T, 0);
+// ---- feasibility: F[g,t] = requirement ∧ fit-one ∧ offering ----
+static void build_feasibility(const SolveShared& s, std::vector<uint8_t>& F) {
+    const int G = s.G, T = s.T, K = s.K, W = s.W, R = s.R, O = s.O;
+    const int Vz = s.Vz, Vc = s.Vc;
     for (int g = 0; g < G; ++g) {
-        const uint32_t* gm = g_mask + (size_t)g * K * W;
-        const uint8_t* gh = g_has + (size_t)g * K;
-        const float* d = g_demand + (size_t)g * R;
-        const uint8_t* gt = g_tol + (size_t)g * K;
+        const uint32_t* gm = s.g_mask + (size_t)g * K * W;
+        const uint8_t* gh = s.g_has + (size_t)g * K;
+        const float* d = s.g_demand + (size_t)g * R;
+        const uint8_t* gt = s.g_tol + (size_t)g * K;
         for (int t = 0; t < T; ++t) {
-            if (!masks_compatible(gm, gh, t_mask + (size_t)t * K * W,
-                                  t_has + (size_t)t * K, K, W,
-                                  gt, t_tol + (size_t)t * K))
+            if (!masks_compatible(gm, gh, s.t_mask + (size_t)t * K * W,
+                                  s.t_has + (size_t)t * K, K, W,
+                                  gt, s.t_tol + (size_t)t * K))
                 continue;
-            if (cap_for(t_alloc + (size_t)t * R, nullptr, d, R) < 1) continue;
+            if (cap_for(s.t_alloc + (size_t)t * R, nullptr, d, R) < 1) continue;
             bool off_ok = false;
             for (int o = 0; o < O; ++o) {
                 size_t i = (size_t)t * O + o;
-                if (!off_avail[i]) continue;
-                int z = off_zone[i], c = off_ct[i];
-                if (z >= 0 && !g_zone_allowed[(size_t)g * Vz + z]) continue;
-                if (c >= 0 && !g_ct_allowed[(size_t)g * Vc + c]) continue;
+                if (!s.off_avail[i]) continue;
+                int z = s.off_zone[i], c = s.off_ct[i];
+                if (z >= 0 && !s.g_zone_allowed[(size_t)g * Vz + z]) continue;
+                if (c >= 0 && !s.g_ct_allowed[(size_t)g * Vc + c]) continue;
                 off_ok = true;
                 break;
             }
             if (off_ok) F[(size_t)g * T + t] = 1;
         }
     }
-    std::memcpy(F_out, F.data(), (size_t)G * T);
+}
 
-    // ---- template-level overlap for new-bin placement ----
-    std::vector<uint8_t> tmpl_full((size_t)G * M, 0);
+// ---- template-level overlap for new-bin placement ----
+static void build_tmpl_full(const SolveShared& s, std::vector<uint8_t>& tmpl_full) {
+    const int G = s.G, K = s.K, W = s.W, M = s.M;
     for (int g = 0; g < G; ++g) {
-        const uint32_t* gm = g_mask + (size_t)g * K * W;
-        const uint8_t* gh = g_has + (size_t)g * K;
+        const uint32_t* gm = s.g_mask + (size_t)g * K * W;
+        const uint8_t* gh = s.g_has + (size_t)g * K;
         for (int m = 0; m < M; ++m) {
-            if (!g_tmpl_ok[(size_t)g * M + m]) continue;
-            if (masks_compatible(gm, gh, m_mask + (size_t)m * K * W,
-                                 m_has + (size_t)m * K, K, W,
-                                 g_tol + (size_t)g * K, m_tol + (size_t)m * K))
+            if (!s.g_tmpl_ok[(size_t)g * M + m]) continue;
+            if (masks_compatible(gm, gh, s.m_mask + (size_t)m * K * W,
+                                 s.m_has + (size_t)m * K, K, W,
+                                 s.g_tol + (size_t)g * K, s.m_tol + (size_t)m * K))
                 tmpl_full[(size_t)g * M + m] = 1;
         }
     }
+}
 
-    // ---- grouped greedy pack ----
+// ---- grouped greedy pack (the body of the original karpenter_solve) ----
+static void pack_bins(const SolveShared& s, const std::vector<uint8_t>& F,
+                      const std::vector<uint8_t>& tmpl_full,
+                      const int32_t* g_count, const float* e_avail,
+                      int32_t* assign, int32_t* assign_e, uint8_t* used,
+                      int32_t* tmpl_out) {
+    const int G = s.G, T = s.T, K = s.K, W = s.W, R = s.R, M = s.M;
+    const int B = s.B, CW = s.CW, C = s.C, A = s.A, E = s.E;
+    const uint32_t* g_mask = s.g_mask; const uint8_t* g_has = s.g_has;
+    const uint8_t* g_tol = s.g_tol; const float* g_demand = s.g_demand;
+    const uint8_t* g_tmpl_ok = s.g_tmpl_ok;
+    const int32_t* g_bin_cap = s.g_bin_cap; const uint8_t* g_single = s.g_single;
+    const uint32_t* g_decl = s.g_decl; const uint32_t* g_match = s.g_match;
+    const int32_t* g_sown = s.g_sown; const uint8_t* g_smatch = s.g_smatch;
+    const uint8_t* g_aneed = s.g_aneed; const uint8_t* g_amatch = s.g_amatch;
+    const uint8_t* ge_ok = s.ge_ok;
+    const int32_t* e_npods = s.e_npods; const int32_t* e_scnt = s.e_scnt;
+    const uint32_t* e_decl = s.e_decl; const uint32_t* e_match = s.e_match;
+    const int32_t* e_aff = s.e_aff;
+    const float* t_alloc = s.t_alloc; const float* t_cap = s.t_cap;
+    const int32_t* t_tmpl = s.t_tmpl;
+    const uint32_t* m_mask = s.m_mask; const uint8_t* m_has = s.m_has;
+    const float* m_overhead = s.m_overhead; const float* m_limits = s.m_limits;
+    const int32_t* m_minv = s.m_minv;
+    (void)g_tol;
     std::vector<Bin> bins;
     bins.reserve(256);
     std::vector<float> rem((size_t)M * R);
@@ -483,6 +503,150 @@ int karpenter_solve(
     for (size_t i = 0; i < bins.size(); ++i) {
         used[i] = 1;
         tmpl_out[i] = bins[i].tmpl;
+    }
+}
+
+static SolveShared make_shared_args(
+    int G, int T, int K, int W, int R, int M, int O, int B, int Vz, int Vc,
+    int CW, int C, int A, int E,
+    const uint32_t* g_mask, const uint8_t* g_has, const uint8_t* g_tol,
+    const float* g_demand, const uint8_t* g_zone_allowed,
+    const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
+    const int32_t* g_bin_cap, const uint8_t* g_single,
+    const uint32_t* g_decl, const uint32_t* g_match,
+    const int32_t* g_sown, const uint8_t* g_smatch,
+    const uint8_t* g_aneed, const uint8_t* g_amatch,
+    const uint8_t* ge_ok, const int32_t* e_npods, const int32_t* e_scnt,
+    const uint32_t* e_decl, const uint32_t* e_match, const int32_t* e_aff,
+    const uint32_t* t_mask, const uint8_t* t_has, const uint8_t* t_tol,
+    const float* t_alloc, const float* t_cap, const int32_t* t_tmpl,
+    const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
+    const uint32_t* m_mask, const uint8_t* m_has, const uint8_t* m_tol,
+    const float* m_overhead, const float* m_limits, const int32_t* m_minv) {
+    SolveShared s;
+    s.G = G; s.T = T; s.K = K; s.W = W; s.R = R; s.M = M; s.O = O; s.B = B;
+    s.Vz = Vz; s.Vc = Vc; s.CW = CW; s.C = C; s.A = A; s.E = E;
+    s.g_mask = g_mask; s.g_has = g_has; s.g_tol = g_tol; s.g_demand = g_demand;
+    s.g_zone_allowed = g_zone_allowed; s.g_ct_allowed = g_ct_allowed;
+    s.g_tmpl_ok = g_tmpl_ok; s.g_bin_cap = g_bin_cap; s.g_single = g_single;
+    s.g_decl = g_decl; s.g_match = g_match; s.g_sown = g_sown;
+    s.g_smatch = g_smatch; s.g_aneed = g_aneed; s.g_amatch = g_amatch;
+    s.ge_ok = ge_ok; s.e_npods = e_npods; s.e_scnt = e_scnt;
+    s.e_decl = e_decl; s.e_match = e_match; s.e_aff = e_aff;
+    s.t_mask = t_mask; s.t_has = t_has; s.t_tol = t_tol;
+    s.t_alloc = t_alloc; s.t_cap = t_cap; s.t_tmpl = t_tmpl;
+    s.off_zone = off_zone; s.off_ct = off_ct; s.off_avail = off_avail;
+    s.m_mask = m_mask; s.m_has = m_has; s.m_tol = m_tol;
+    s.m_overhead = m_overhead; s.m_limits = m_limits; s.m_minv = m_minv;
+    return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. Output arrays: assign [G*B] i32 (zeroed by callee),
+// used [B] u8, tmpl_out [B] i32, F_out [G*T] u8.
+int karpenter_solve(
+    int G, int T, int K, int W, int R, int M, int O, int B, int Vz, int Vc,
+    int CW,
+    const uint32_t* g_mask, const uint8_t* g_has, const uint8_t* g_tol,
+    const float* g_demand,
+    const int32_t* g_count, const uint8_t* g_zone_allowed,
+    const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
+    const int32_t* g_bin_cap, const uint8_t* g_single,
+    const uint32_t* g_decl, const uint32_t* g_match,
+    int C, const int32_t* g_sown, const uint8_t* g_smatch,
+    int A, const uint8_t* g_aneed, const uint8_t* g_amatch,
+    int E, const float* e_avail, const uint8_t* ge_ok,
+    const int32_t* e_npods, const int32_t* e_scnt,
+    const uint32_t* e_decl, const uint32_t* e_match,
+    const int32_t* e_aff,
+    const uint32_t* t_mask, const uint8_t* t_has, const uint8_t* t_tol,
+    const float* t_alloc,
+    const float* t_cap, const int32_t* t_tmpl,
+    const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
+    const uint32_t* m_mask, const uint8_t* m_has, const uint8_t* m_tol,
+    const float* m_overhead, const float* m_limits, const int32_t* m_minv,
+    int32_t* assign, int32_t* assign_e, uint8_t* used, int32_t* tmpl_out,
+    uint8_t* F_out) {
+    SolveShared s = make_shared_args(
+        G, T, K, W, R, M, O, B, Vz, Vc, CW, C, A, E,
+        g_mask, g_has, g_tol, g_demand, g_zone_allowed, g_ct_allowed,
+        g_tmpl_ok, g_bin_cap, g_single, g_decl, g_match, g_sown, g_smatch,
+        g_aneed, g_amatch, ge_ok, e_npods, e_scnt, e_decl, e_match, e_aff,
+        t_mask, t_has, t_tol, t_alloc, t_cap, t_tmpl, off_zone, off_ct,
+        off_avail, m_mask, m_has, m_tol, m_overhead, m_limits, m_minv);
+    std::vector<uint8_t> F((size_t)G * T, 0);
+    build_feasibility(s, F);
+    std::memcpy(F_out, F.data(), (size_t)G * T);
+    std::vector<uint8_t> tmpl_full((size_t)G * M, 0);
+    build_tmpl_full(s, tmpl_full);
+    pack_bins(s, F, tmpl_full, g_count, e_avail, assign, assign_e, used,
+              tmpl_out);
+    return 0;
+}
+
+// Batched probe entry (ops/consolidate.py _dispatch_native): N
+// counterfactual rows over ONE shared snapshot — feasibility and the
+// template overlap build once, then the pack runs per row with that row's
+// g_count [N*G] and e_avail [N*E*R]. Outputs are the probe's reductions:
+// placed_g [N*G] (fresh-bin + existing placements per group) and
+// used_out [N] (fresh claims opened). The per-row full outputs the single
+// entry would emit never materialize host-side.
+int karpenter_solve_probe_batch(
+    int N,
+    int G, int T, int K, int W, int R, int M, int O, int B, int Vz, int Vc,
+    int CW,
+    const uint32_t* g_mask, const uint8_t* g_has, const uint8_t* g_tol,
+    const float* g_demand,
+    const int32_t* g_count_rows, const uint8_t* g_zone_allowed,
+    const uint8_t* g_ct_allowed, const uint8_t* g_tmpl_ok,
+    const int32_t* g_bin_cap, const uint8_t* g_single,
+    const uint32_t* g_decl, const uint32_t* g_match,
+    int C, const int32_t* g_sown, const uint8_t* g_smatch,
+    int A, const uint8_t* g_aneed, const uint8_t* g_amatch,
+    int E, const float* e_avail_rows, const uint8_t* ge_ok,
+    const int32_t* e_npods, const int32_t* e_scnt,
+    const uint32_t* e_decl, const uint32_t* e_match,
+    const int32_t* e_aff,
+    const uint32_t* t_mask, const uint8_t* t_has, const uint8_t* t_tol,
+    const float* t_alloc,
+    const float* t_cap, const int32_t* t_tmpl,
+    const int32_t* off_zone, const int32_t* off_ct, const uint8_t* off_avail,
+    const uint32_t* m_mask, const uint8_t* m_has, const uint8_t* m_tol,
+    const float* m_overhead, const float* m_limits, const int32_t* m_minv,
+    int32_t* placed_g, int32_t* used_out) {
+    SolveShared s = make_shared_args(
+        G, T, K, W, R, M, O, B, Vz, Vc, CW, C, A, E,
+        g_mask, g_has, g_tol, g_demand, g_zone_allowed, g_ct_allowed,
+        g_tmpl_ok, g_bin_cap, g_single, g_decl, g_match, g_sown, g_smatch,
+        g_aneed, g_amatch, ge_ok, e_npods, e_scnt, e_decl, e_match, e_aff,
+        t_mask, t_has, t_tol, t_alloc, t_cap, t_tmpl, off_zone, off_ct,
+        off_avail, m_mask, m_has, m_tol, m_overhead, m_limits, m_minv);
+    std::vector<uint8_t> F((size_t)G * T, 0);
+    build_feasibility(s, F);
+    std::vector<uint8_t> tmpl_full((size_t)G * M, 0);
+    build_tmpl_full(s, tmpl_full);
+    std::vector<int32_t> assign((size_t)G * B);
+    std::vector<int32_t> assign_e((size_t)G * E);
+    std::vector<uint8_t> used((size_t)B);
+    std::vector<int32_t> tmpl_out((size_t)B);
+    for (int i = 0; i < N; ++i) {
+        pack_bins(s, F, tmpl_full,
+                  g_count_rows + (size_t)i * G,
+                  e_avail_rows + (size_t)i * E * R,
+                  assign.data(), assign_e.data(), used.data(),
+                  tmpl_out.data());
+        for (int g = 0; g < G; ++g) {
+            int64_t total = 0;
+            for (int b = 0; b < B; ++b) total += assign[(size_t)g * B + b];
+            for (int e = 0; e < E; ++e) total += assign_e[(size_t)g * E + e];
+            placed_g[(size_t)i * G + g] = (int32_t)total;
+        }
+        int32_t u = 0;
+        for (int b = 0; b < B; ++b) u += used[b] ? 1 : 0;
+        used_out[i] = u;
     }
     return 0;
 }

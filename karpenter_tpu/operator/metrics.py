@@ -209,6 +209,10 @@ DISRUPTION_CONFIRM_DURATION = (
 # negative node availabilities clamped during tensorization — mirrored from
 # ops/tensorize.py (capacity-accounting bugs must surface, not vanish)
 TENSORIZE_NEGATIVE_AVAIL = f"{NAMESPACE}_tensorize_negative_avail_total"
+# pods each live solve routed to the host engine instead of the device
+# path, by reason label (waves compiler inexpressibles, spec ineligibility,
+# small-batch cutoff) — a grid regression shows up here as a reason spike
+PROVISIONING_HOST_ROUTED = f"{NAMESPACE}_provisioning_host_routed_pods_total"
 # counterfactual-rows-per-dispatch buckets (powers of two up to the probe's
 # chunk cap) — durations make no sense for a size histogram
 PROBE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)

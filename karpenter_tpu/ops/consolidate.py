@@ -589,6 +589,11 @@ class DisruptionSnapshot:
             return False
 
     def _dispatch_native(self, g_count_k, e_zero_cols):
+        """One native call per chunk (ROADMAP's open lever closed): the C++
+        engine builds feasibility once per chunk and packs every
+        counterfactual row in-process, returning only the per-row
+        reductions — the old path re-entered the engine (and re-derived
+        F/template overlap, and marshalled the full snapshot) once per row."""
         from karpenter_tpu import native
 
         shared, (Gp, Ep) = self._shared_args()
@@ -596,20 +601,22 @@ class DisruptionSnapshot:
         rows = g_count_k.shape[0]
         placed_g = np.empty((rows, Gp), dtype=np.int64)
         used = np.empty(rows, dtype=np.int64)
-        for i in range(rows):
-            e_row = self.esnap.e_avail.copy()
-            cols = e_zero_cols[i]
-            if cols is not None and len(cols):
-                e_row[cols, :] = 0.0
-            args = dict(shared)
-            args["g_count"] = pad(g_count_k[i], (Gp,))
-            args["e_avail"] = pad(e_row, (Ep, R))
-            out = native.solve_step(args, 1)
-            placed_g[i] = (
-                np.asarray(out["assign"]).sum(axis=1)
-                + np.asarray(out["assign_e"]).sum(axis=1)
+        for lo in range(0, rows, PROBE_CHUNK_ROWS):
+            hi = min(lo + PROBE_CHUNK_ROWS, rows)
+            n = hi - lo
+            e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
+            for i in range(n):
+                cols = e_zero_cols[lo + i]
+                if cols is not None and len(cols):
+                    e_chunk[i, cols, :] = 0.0
+            pg, u = native.solve_probe_batch(
+                shared,
+                pad(np.asarray(g_count_k[lo:hi], dtype=np.int32), (n, Gp)),
+                pad(e_chunk.astype(np.float32, copy=False), (n, Ep, R)),
+                1,
             )
-            used[i] = int(np.asarray(out["used"]).sum())
+            placed_g[lo:hi] = pg
+            used[lo:hi] = u
         return placed_g, used
 
 

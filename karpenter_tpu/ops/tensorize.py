@@ -51,6 +51,37 @@ emits alongside every ``consolidation_state`` generation bump:
   negative availability the build clamps to zero (a node whose bound pods
   exceed its allocatable is a capacity-accounting bug that must surface,
   not vanish into ``max(v, 0.0)``).
+
+Group-row cache contract
+------------------------
+
+``tensorize`` additionally caches each group's packed requirement rows
+(``g_mask``/``g_has``/``g_tol``/``g_tmpl_ok``/``g_zone_allowed``/
+``g_ct_allowed``) keyed on **(pod scheduling signature, waves
+extra-requirement fingerprint)** — the provisioning-side analog of the
+existing-node delta layer: most pod signatures recur between batcher
+ticks, so steady-state rounds (and the doubled re-runs within one solve)
+skip the per-group mask/template build entirely.
+
+* **Where it lives.** Inside the type-side cache entry (``_TYPE_CACHE``),
+  whose key already fingerprints templates (requirements, weights,
+  taints), catalog identity AND mutable offering state, the group
+  requirement-value universe, and the resource axis. Any change on those
+  axes resolves to a DIFFERENT type-side entry whose row cache starts
+  empty — rows can never be served across a vocabulary change; that is
+  the entire invalidation contract, enforced by
+  tests/test_tensorize_cache.py.
+* **What keys a row.** The raw-spec signature (:func:`pod_signature`,
+  which covers selectors, affinity, resources, tolerations, labels and
+  topology fields) plus the compiled plan's per-group extra requirements
+  (zone pins / IN-sets), so the same deployment template landing in
+  different zone subgroups keys different rows.
+* **Safety.** Cached rows are COPIES both ways (stored from and assigned
+  into the snapshot arrays), so mutating a snapshot never corrupts the
+  cache; the cache is bounded (``_ROW_CACHE_MAX``) with FIFO eviction.
+* **Accounting.** ``STATS["group_row_hits"/"group_row_misses"]``, echoed
+  per solve in ``TPUSolver.last_device_stats`` and per grid row by the
+  perf harness.
 """
 
 from __future__ import annotations
@@ -88,6 +119,10 @@ STATS = {
     "delta_applies": 0,
     "delta_rows": 0,
     "negative_avail_total": 0,
+    # signature-keyed group-row cache (see tensorize): packed requirement
+    # rows reused across provisioning rounds/batches
+    "group_row_hits": 0,
+    "group_row_misses": 0,
 }
 
 # the scrape-plane family name lives in operator/metrics.py
@@ -705,6 +740,12 @@ def _template_fingerprint(tpl) -> tuple:
 # to the catalog objects, keeping the id()-based fingerprint stable.
 _TYPE_CACHE: dict = {}
 _TYPE_CACHE_MAX = 8
+# per-type-side-entry group-row cache bound (signatures, not bytes: each
+# row tuple is a few hundred bytes)
+_ROW_CACHE_MAX = 8192
+# per-type-side-entry decoder compat-entry bound (models/solver.py
+# _compat_entry): distinct (template, group-signature-set) bins
+_COMPAT_CACHE_MAX = 8192
 
 
 def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
@@ -887,12 +928,26 @@ def tensorize(
         device_groups = device_plan.device_groups
         groups = [dg.pods for dg in device_groups]
         group_reqs = []
+        row_keys = []
         for dg in device_groups:
-            reqs = pod_requirements(dg.pods[0])
+            rep = dg.pods[0]
+            reqs = pod_requirements(rep)
             if dg.extra_reqs:
                 reqs = reqs.copy()
                 reqs.add(*dg.extra_reqs)
             group_reqs.append(reqs)
+            sig = rep.__dict__.get("_sig_cache")
+            if sig is None:
+                sig = rep.__dict__["_sig_cache"] = pod_signature(rep)
+            # waves extra reqs (zone pins/IN-sets) key the row alongside
+            # the spec signature: the same deployment template lands in
+            # different zone subgroups with different packed rows
+            extras_fp = tuple(
+                (r.key, r.complement, tuple(sorted(r.values)),
+                 r.greater_than, r.less_than, r.min_values)
+                for r in dg.extra_reqs
+            )
+            row_keys.append((sig, extras_fp))
         g_bin_cap_list = [dg.bin_cap for dg in device_groups]
         g_single_list = [dg.single_bin for dg in device_groups]
         g_decl, g_match = device_plan.class_masks()
@@ -912,6 +967,8 @@ def tensorize(
             ),
         )
         group_reqs = [pod_requirements(g[0]) for g in groups]
+        # group_by_signature cached the signature on every rep
+        row_keys = [(g[0].__dict__["_sig_cache"], ()) for g in groups]
         g_bin_cap_list = [1 << 30] * len(groups)
         g_single_list = [False] * len(groups)
         g_decl = np.zeros((len(groups), 1), dtype=np.uint32)
@@ -968,10 +1025,25 @@ def tensorize(
     g_bin_cap = np.asarray(g_bin_cap_list, dtype=np.int32).reshape(G)
     g_single = np.asarray(g_single_list, dtype=bool).reshape(G)
 
+    # signature-keyed row cache: the packed requirement rows are a pure
+    # function of (pod signature, waves extra reqs) GIVEN this type-side
+    # entry — vocabulary, templates, catalog, and the resource axis are all
+    # pinned by the ts cache key, so any change there lands in a fresh ts
+    # dict with an empty row cache (the invalidation contract; see the
+    # module docstring). Most pod signatures recur between batcher ticks,
+    # so steady-state rounds skip the whole per-group mask/template build.
+    row_cache = ts.setdefault("row_cache", {})
     for g, (pods_g, reqs) in enumerate(zip(groups, group_reqs)):
         for r, v in group_demand[g].items():
             g_demand[g, r_index[r]] = v
         g_count[g] = len(pods_g)
+        rk = row_keys[g]
+        cached_row = row_cache.get(rk)
+        if cached_row is not None:
+            (g_mask[g], g_has[g], g_tol[g], g_tmpl_ok[g],
+             g_zone_allowed[g], g_ct_allowed[g]) = cached_row
+            STATS["group_row_hits"] += 1
+            continue
         g_mask[g], g_has[g] = build_mask_set(reqs)
         for r in reqs.values():
             if r.key in key_index:
@@ -1000,8 +1072,16 @@ def tensorize(
             cr = reqs.get_req(wk.CAPACITY_TYPE_LABEL)
             for v, bit in ct_vocab.items():
                 g_ct_allowed[g, bit] = cr.has(v)
+        STATS["group_row_misses"] += 1
+        if len(row_cache) >= _ROW_CACHE_MAX:
+            row_cache.pop(next(iter(row_cache)))
+        row_cache[rk] = (
+            g_mask[g].copy(), g_has[g].copy(), g_tol[g].copy(),
+            g_tmpl_ok[g].copy(), g_zone_allowed[g].copy(),
+            g_ct_allowed[g].copy(),
+        )
 
-    return DeviceSnapshot(
+    snap = DeviceSnapshot(
         keys=keys,
         key_index=key_index,
         vocab=vocab,
@@ -1045,3 +1125,11 @@ def tensorize(
         m_overhead=m_overhead,
         m_limits=m_limits,
     )
+    # decoder fast-path state: per-group signature keys plus the type-side
+    # entry's persistent compat cache. Entries are pure functions of
+    # (template index, group signature set) GIVEN this ts entry — the same
+    # invalidation contract as the group-row cache above — so the decoder
+    # can reuse a bin's candidate-type set across solves and rounds.
+    snap.row_keys = row_keys
+    snap.compat_cache = ts.setdefault("compat_cache", {})
+    return snap

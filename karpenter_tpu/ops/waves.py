@@ -46,7 +46,40 @@ remainder routes to the host engine, which stays the semantic oracle.
 Anything else — zone anti-affinity (the Schrödinger case records every
 candidate domain), preferred terms, minDomains, same-selector spreads with
 different parameters, hostname affinity onto pre-existing cluster matches —
-routes to the host engine.
+routes to the host engine. Every host routing carries a REASON
+(WavesPlan.host_reasons), exported as
+karpenter_provisioning_host_routed_pods_total and surfaced per grid row by
+the perf harness.
+
+Vectorized-overlay contract
+---------------------------
+
+The default compiler (:class:`_VecCompiler`) and the sequential oracle
+(:class:`_Compiler`) share ONE copy of the overlay scan: the scan consults
+constraints only through predicate hooks (``_tg_selects`` /
+``_zone_inverse_any`` / ``_cls_match`` / ``_cls_smatch`` / ``_cls_amatch``
+/ ``_rec_tgs`` / ``_water``), and the vectorized compiler overrides those
+hooks with batched numpy tables — groups dedup to distinct (namespace,
+labels) signatures, match_labels-only selectors evaluate as one bitwise
+subset test over an interned label-pair matrix, expression selectors fall
+back to the exact Python matcher once per signature, ownership inverts the
+registry's owner sets in one pass, and zone water-filling runs in closed
+form over the [domains] axis (:func:`_water_fill_np`). Plans are therefore
+bit-identical BY CONSTRUCTION, and tests/test_waves_parity.py enforces it
+over 120+ seeded random mixes. KARPENTER_WAVES_SEQUENTIAL=1 (or
+``compile_topology(..., vectorized=False)``) selects the oracle for A/B
+debugging.
+
+Downstream cache invalidation
+-----------------------------
+
+The tensorizer caches packed group rows keyed on (pod signature, this
+plan's per-group extra requirements) inside the type-side cache entry
+(ops/tensorize.py). Waves therefore participates in that contract through
+the extra-req fingerprint alone: a group that lands in a different zone
+subgroup (different pin/IN-set) keys a different row, while the OVERLAY
+state itself (domain counts) never leaks into the cache — it only shapes
+which extra reqs each subgroup carries.
 """
 
 from __future__ import annotations
@@ -97,6 +130,9 @@ class WavesPlan:
     anti_tgs_by_class: list = field(default_factory=list)  # (direct, inverse|None)
     spread_tgs_by_class: list = field(default_factory=list)
     aff_tgs_by_class: list = field(default_factory=list)
+    # why pods routed to the host engine: reason -> pod count, feeding the
+    # karpenter_provisioning_host_routed_pods_total metric family
+    host_reasons: dict = field(default_factory=dict)
 
     @property
     def device_pod_count(self):
@@ -160,9 +196,10 @@ def _group_key(g0):
             for c in g0.topology_spread_constraints
         )
     )
+    req = g0.effective_requests()
     return (
-        -g0.effective_requests().get(resutil.CPU, 0.0),
-        -g0.effective_requests().get(resutil.MEMORY, 0.0),
+        -req.get(resutil.CPU, 0.0),
+        -req.get(resutil.MEMORY, 0.0),
         0 if capped else 1,
     )
 
@@ -224,10 +261,7 @@ class _Compiler:
         self.groups = groups
         self.topology = topology
         self.reps = [g[0] for g in groups]
-        self.own_by_gid = [
-            [tg for tg in topology.topologies.values() if rep.uid in tg.owners]
-            for rep in self.reps
-        ]
+        self.own_by_gid = self._compute_owns()
         self.spread_conflicted = _spread_conflicts(topology)
         # inverse anti groups whose declarers are NOT in this batch and whose
         # key is not hostname constrain allowed domains invisibly → host
@@ -254,6 +288,13 @@ class _Compiler:
         self.anti_tgs = {hk: T[hk] for hk in self.anti_classes}
         self.spread_tgs = {hk: T[hk] for hk in self.spread_classes}
         self.aff_tgs = {hk: T[hk] for hk in self.aff_classes}
+        # zone-keyed spread/affinity groups in registry order: the bump
+        # targets (Topology.Record's singleton-domain commit mirror)
+        self.zone_rec_tgs = [
+            tg for tg in topology.topologies.values()
+            if tg.key == wk.TOPOLOGY_ZONE_LABEL
+            and tg.type in (TYPE_SPREAD, TYPE_AFFINITY)
+        ]
         # compile-local domain counts for every ZONE-keyed spread/affinity
         # group; later groups see earlier groups' pinned landings exactly as
         # the host loop would
@@ -263,12 +304,77 @@ class _Compiler:
         self.aff_cnt = [0] * len(self.aff_classes)
         self.device_groups: list = []
         self.host_pods: list = []
+        self.host_reasons: dict = {}
+        self._pz_memo: dict = {}
 
     def _counts(self, tg) -> dict:
         c = self.overlay.get(id(tg))
         if c is None:
             c = self.overlay[id(tg)] = dict(tg.domains)
         return c
+
+    def _route_host(self, pods, reason: str):
+        self.host_pods.extend(pods)
+        self.host_reasons[reason] = self.host_reasons.get(reason, 0) + len(pods)
+        return _HOST
+
+    def _compute_owns(self) -> list:
+        """own_by_gid: every registry group owning gid's rep, in registry
+        order (the scan handles constraints in registration order)."""
+        return [
+            [tg for tg in self.topology.topologies.values()
+             if rep.uid in tg.owners]
+            for rep in self.reps
+        ]
+
+    # ---- per-group predicates -------------------------------------------
+    # The scan consults constraint predicates ONLY through these hooks, so
+    # the sequential oracle and the vectorized compiler share one copy of
+    # the overlay logic and can only differ in how predicates are evaluated.
+
+    def _tg_selects(self, tg, gid) -> bool:
+        return tg.selects(self.reps[gid])
+
+    def _zone_inverse_any(self, gid) -> bool:
+        rep = self.reps[gid]
+        return any(tg.selects(rep) for tg in self.zone_inverse)
+
+    def _cls_match(self, gid) -> frozenset:
+        rep = self.reps[gid]
+        return frozenset(
+            c for hk, c in self.anti_classes.items()
+            if self.anti_tgs[hk].selects(rep)
+        )
+
+    def _cls_smatch(self, gid) -> frozenset:
+        rep = self.reps[gid]
+        return frozenset(
+            c for hk, c in self.spread_classes.items()
+            if self.spread_tgs[hk].selects(rep)
+        )
+
+    def _cls_amatch(self, gid) -> frozenset:
+        rep = self.reps[gid]
+        return frozenset(
+            c for hk, c in self.aff_classes.items()
+            if self.aff_tgs[hk].selects(rep)
+        )
+
+    def _rec_tgs(self, gid) -> list:
+        rep = self.reps[gid]
+        return [tg for tg in self.zone_rec_tgs if tg.selects(rep)]
+
+    def _pod_zone(self, gid):
+        """pod's allowed-zone requirement, memoized per group (pure
+        function of the rep's spec — semantically free in both modes)."""
+        pz = self._pz_memo.get(gid)
+        if pz is None:
+            pz = self._pz_memo[gid] = pod_requirements(
+                self.reps[gid]).get_req(wk.TOPOLOGY_ZONE_LABEL)
+        return pz
+
+    def _water(self, counts: dict, n: int) -> dict:
+        return _water_fill(counts, n)
 
     def run(self) -> WavesPlan:
         pending = list(range(len(self.groups)))
@@ -286,7 +392,7 @@ class _Compiler:
         for gid in pending:
             # affinity targets never materialized: the host queue fails these
             # the same way after its own retry cycle (queue.go:76 staleness)
-            self.host_pods.extend(self.groups[gid])
+            self._route_host(self.groups[gid], "affinity-unresolved")
         anti_by_class = [None] * len(self.anti_classes)
         for hk, c in self.anti_classes.items():
             anti_by_class[c] = (
@@ -306,6 +412,7 @@ class _Compiler:
             anti_tgs_by_class=anti_by_class,
             spread_tgs_by_class=spread_by_class,
             aff_tgs_by_class=aff_by_class,
+            host_reasons=dict(self.host_reasons),
         )
 
     def _compile_one(self, gid):
@@ -313,9 +420,8 @@ class _Compiler:
         rep = self.reps[gid]
         own = self.own_by_gid[gid]
 
-        if any(tg.selects(rep) for tg in self.zone_inverse):
-            self.host_pods.extend(pods)
-            return _HOST
+        if self._zone_inverse_any(gid):
+            return self._route_host(pods, "zone-inverse-anti")
 
         extra_reqs: list = []
         bin_cap = UNCAPPED
@@ -329,10 +435,9 @@ class _Compiler:
 
         for tg in own:
             if tg.type == TYPE_SPREAD and tg.key == wk.TOPOLOGY_ZONE_LABEL:
-                split = self._zone_spread(tg, rep, len(pods), zone_constrained)
+                split = self._zone_spread(tg, gid, len(pods), zone_constrained)
                 if split is None:
-                    self.host_pods.extend(pods)
-                    return _HOST
+                    return self._route_host(pods, "zone-spread")
                 zone_split, zone_constrained = split, True
             elif tg.type == TYPE_SPREAD and tg.key == wk.HOSTNAME_LABEL:
                 cls = self.spread_classes[tg.hash_key()]
@@ -341,10 +446,9 @@ class _Compiler:
             elif tg.type == TYPE_ANTI_AFFINITY and tg.key == wk.HOSTNAME_LABEL:
                 decl.add(self.anti_classes[tg.hash_key()])
             elif tg.type == TYPE_AFFINITY and tg.key == wk.TOPOLOGY_ZONE_LABEL:
-                res = self._zone_affinity(tg, rep, len(pods), zone_constrained)
+                res = self._zone_affinity(tg, gid, len(pods), zone_constrained)
                 if res is _HOST:
-                    self.host_pods.extend(pods)
-                    return _HOST
+                    return self._route_host(pods, "zone-affinity")
                 if res is _DEFER:
                     return _DEFER
                 req, pinned = res
@@ -357,23 +461,18 @@ class _Compiler:
                     # pre-existing cluster matches: the host engine's
                     # exact-domain bootstrap onto registered hostnames is
                     # not expressible as class counts
-                    self.host_pods.extend(pods)
-                    return _HOST
+                    return self._route_host(pods, "hostname-affinity-existing")
                 cls = self.aff_classes[tg.hash_key()]
                 aff_need.add(cls)
-                if not tg.selects(rep) and self.aff_cnt[cls] == 0:
+                if not self._tg_selects(tg, gid) and self.aff_cnt[cls] == 0:
                     # target labels haven't landed yet: retry after the
                     # rest of the batch (the host requeue-to-back)
                     return _DEFER
             else:
-                self.host_pods.extend(pods)
-                return _HOST
+                return self._route_host(pods, "unsupported-constraint")
 
         # classes whose selector matches this group (the inverse direction)
-        match = {
-            c for hk, c in self.anti_classes.items()
-            if self.anti_tgs[hk].selects(rep)
-        }
+        match = self._cls_match(gid)
         if decl & match:
             # self-matching anti-affinity: at most one pod of the group per
             # bin, the classic one-replica-per-node shape
@@ -382,25 +481,19 @@ class _Compiler:
         # topologygroup.go:167 — ownership not required; an owner whose own
         # labels don't match its selector contributes nothing, exactly like
         # the host count)
-        smatch = {
-            c for hk, c in self.spread_classes.items()
-            if self.spread_tgs[hk].selects(rep)
-        }
-        amatch = {
-            c for hk, c in self.aff_classes.items()
-            if self.aff_tgs[hk].selects(rep)
-        }
+        smatch = self._cls_smatch(gid)
+        amatch = self._cls_amatch(gid)
 
         self._emit(
             pods, extra_reqs, bin_cap, zone_split,
-            frozenset(decl), frozenset(match), dict(spread_caps),
-            frozenset(smatch), frozenset(aff_need), frozenset(amatch),
+            frozenset(decl), match, dict(spread_caps),
+            smatch, frozenset(aff_need), amatch,
         )
-        self._bump_landings(rep, pods, zone_split)
+        self._bump_landings(gid, pods, zone_split)
         return "emit"
 
     # ---- per-constraint compile steps ----------------------------------
-    def _zone_spread(self, tg, rep, n, zone_constrained):
+    def _zone_spread(self, tg, gid, n, zone_constrained):
         """domain -> count, or None for host."""
         if (
             tg.min_domains is not None
@@ -409,12 +502,12 @@ class _Compiler:
         ):
             return None
         counts = self._counts(tg)
-        pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+        pod_zone = self._pod_zone(gid)
         allowed = {d: c for d, c in counts.items() if pod_zone.has(d)}
         if not allowed:
             return None
-        if tg.selects(rep):
-            split = _water_fill(allowed, n)
+        if self._tg_selects(tg, gid):
+            split = self._water(allowed, n)
             return {d: c for d, c in split.items() if c > 0}
         # non-self-selecting owner: counts never move, so every pod takes
         # the same min-count domain (sorted tie-break, topology.py:196);
@@ -423,12 +516,12 @@ class _Compiler:
         d_star = sorted(d for d in allowed if allowed[d] == lo)[0]
         return {d_star: n}
 
-    def _zone_affinity(self, tg, rep, n, zone_constrained):
+    def _zone_affinity(self, tg, gid, n, zone_constrained):
         """(Requirement, pinned_zone|None) | _DEFER | _HOST."""
         if zone_constrained:
             return _HOST  # composed zone constraints: host engine
         counts = self._counts(tg)
-        pod_zone = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+        pod_zone = self._pod_zone(gid)
         nonzero = sorted(d for d, c in counts.items() if c > 0 and pod_zone.has(d))
         if nonzero:
             if len(nonzero) == 1:
@@ -436,7 +529,7 @@ class _Compiler:
             # several match domains: the pod may land in any (host records
             # nothing for non-singleton domains, topology.py:309)
             return (Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, nonzero), None)
-        if not tg.selects(rep):
+        if not self._tg_selects(tg, gid):
             return _DEFER
         # self-affinity bootstrap: deterministic sorted-first allowed domain
         # (the host engine's tie-break, topology.py:211-221)
@@ -467,7 +560,7 @@ class _Compiler:
                 dict(spread_caps), smatch, aff_need, amatch,
             ))
 
-    def _bump_landings(self, rep, pods, zone_split):
+    def _bump_landings(self, gid, pods, zone_split):
         """Commit this group's pinned landings into the overlay so later
         groups (and later compile rounds) see them — the compile-time
         mirror of Topology.Record's singleton-domain commit."""
@@ -475,32 +568,246 @@ class _Compiler:
         if pinned is None:
             # a plain node-selector zone pin also counts (the claim's zone
             # set is a singleton, so the host records it)
-            pz = pod_requirements(rep).get_req(wk.TOPOLOGY_ZONE_LABEL)
+            pz = self._pod_zone(gid)
             if not pz.complement and len(pz.values) == 1:
                 pinned = {next(iter(pz.values)): len(pods)}
         if pinned:
-            for tg in self.topology.topologies.values():
-                if tg.key != wk.TOPOLOGY_ZONE_LABEL:
-                    continue
-                if tg.type not in (TYPE_SPREAD, TYPE_AFFINITY):
-                    continue
-                if not tg.selects(rep):
-                    continue
+            for tg in self._rec_tgs(gid):
                 counts = self._counts(tg)
                 for d, c in pinned.items():
                     counts[d] = counts.get(d, 0) + c
-        for hk, cls in self.aff_classes.items():
-            if self.aff_tgs[hk].selects(rep):
-                self.aff_cnt[cls] += len(pods)
+        for cls in self._cls_amatch(gid):
+            self.aff_cnt[cls] += len(pods)
 
 
-def compile_topology(groups: list, topology) -> WavesPlan:
+def _col_sets(m: np.ndarray) -> list:
+    """Per-column frozensets of the true rows of a [C, G] bool table —
+    one nonzero pass instead of G flatnonzero calls."""
+    C, G = m.shape
+    out = [frozenset()] * G
+    if m.size:
+        gs, cs = np.nonzero(m.T)
+        starts = np.searchsorted(gs, np.arange(G + 1))
+        for g in range(G):
+            lo, hi = int(starts[g]), int(starts[g + 1])
+            if hi > lo:
+                out[g] = frozenset(cs[lo:hi].tolist())
+    return out
+
+
+def _water_fill_np(counts: dict, n: int) -> dict:
+    """Closed-form water fill over the [domains] axis — bit-identical to
+    :func:`_water_fill` (the sequential oracle; the parity suite pins it):
+    the final state raises every participating domain to a common level L*
+    (the largest level affordable within n), then hands the remainder out
+    one pod each to the first sorted-name domains at that level."""
+    names = sorted(counts)
+    c = np.array([counts[d] for d in names], dtype=np.int64)
+    order = np.argsort(c, kind="stable")  # ascending counts, name tie-break
+    cs = c[order]
+    pre = np.concatenate([[0], np.cumsum(cs)])
+    D = len(cs)
+    # cost(k) = lift the k lowest to the (k+1)-th count; the last bracket
+    # is unbounded. Find the bracket n lands in, then the level within it.
+    ks = np.arange(1, D + 1)
+    # the last bracket is unbounded: a level past every count + budget can
+    # never be reached, so it serves as the +inf sentinel without overflow
+    nxt = np.concatenate([cs[1:], [cs[-1] + n + 1]])
+    cost_to_next = ks * nxt - pre[1:]  # cost to reach the NEXT count level
+    k = int(np.searchsorted(cost_to_next, n, side="right"))
+    k = min(k + 1, D)  # number of participating (lowest) domains
+    level = (pre[k] + n) // k
+    spent = level * k - pre[k]
+    rem = int(n - spent)
+    out = {d: 0 for d in names}
+    lows = sorted(names[i] for i in order[:k])
+    for i, d in enumerate(lows):
+        add = int(level) - counts[d] + (1 if i < rem else 0)
+        if add > 0:
+            out[d] = add
+    return out
+
+
+class _VecCompiler(_Compiler):
+    """The default compiler: the SAME sequential overlay scan as
+    :class:`_Compiler` (one copy of the logic — the scan consults
+    constraints only through the predicate hooks), with every predicate
+    precomputed as batched numpy tables instead of per-group Python loops:
+
+    - selector matching: groups dedup to distinct (namespace, labels)
+      signatures; match_labels-only selectors evaluate as one bitwise
+      subset test over an interned label-pair matrix [signatures × pairs],
+      expression selectors fall back to the exact Python matcher once per
+      signature; rows broadcast back to [classes × groups] by fancy index.
+    - ownership: one inversion pass over the topology registry's owner
+      sets replaces the per-group registry scan.
+    - zone water-filling: the closed-form [domains]-axis fill
+      (:func:`_water_fill_np`).
+
+    Bit-identical plans to the sequential oracle by construction; the
+    seeded parity suite (tests/test_waves_parity.py) enforces it."""
+
+    def __init__(self, groups, topology):
+        super().__init__(groups, topology)
+        reps = self.reps
+        G = len(reps)
+        sig_of: dict = {}
+        lab_ids = np.zeros(G, dtype=np.intp)
+        distinct: list = []
+        for g, rep in enumerate(reps):
+            key = (rep.namespace, tuple(sorted(rep.metadata.labels.items())))
+            i = sig_of.get(key)
+            if i is None:
+                i = sig_of[key] = len(distinct)
+                distinct.append(rep)
+            lab_ids[g] = i
+        D = len(distinct)
+
+        # the tgs whose per-group selection the scan consults, one row each
+        anti_list = [None] * len(self.anti_classes)
+        for hk, c in self.anti_classes.items():
+            anti_list[c] = self.anti_tgs[hk]
+        spread_list = [None] * len(self.spread_classes)
+        for hk, c in self.spread_classes.items():
+            spread_list[c] = self.spread_tgs[hk]
+        aff_list = [None] * len(self.aff_classes)
+        for hk, c in self.aff_classes.items():
+            aff_list[c] = self.aff_tgs[hk]
+        all_tgs: list = []
+        row_of: dict = {}
+        for tg in (*anti_list, *spread_list, *aff_list, *self.zone_inverse,
+                   *self.zone_rec_tgs):
+            if id(tg) not in row_of:
+                row_of[id(tg)] = len(all_tgs)
+                all_tgs.append(tg)
+
+        # interned (key, value) pairs of every match_labels-only selector
+        pair_idx: dict = {}
+        for tg in all_tgs:
+            sel = tg.selector
+            if sel is not None and not sel.match_expressions:
+                for kv in sel.match_labels.items():
+                    pair_idx.setdefault(kv, len(pair_idx))
+        enc = np.zeros((D, max(len(pair_idx), 1)), dtype=bool)
+        for d, rep in enumerate(distinct):
+            for kv in rep.metadata.labels.items():
+                p = pair_idx.get(kv)
+                if p is not None:
+                    enc[d, p] = True
+
+        # distinct namespaces intern too: the namespace gate evaluates per
+        # (tg, namespace), not per (tg, signature)
+        ns_names = []
+        ns_pos: dict = {}
+        ns_ids = np.zeros(D, dtype=np.intp)
+        for d, rep in enumerate(distinct):
+            i = ns_pos.get(rep.namespace)
+            if i is None:
+                i = ns_pos[rep.namespace] = len(ns_names)
+                ns_names.append(rep.namespace)
+            ns_ids[d] = i
+
+        S = np.zeros((max(len(all_tgs), 1), D), dtype=bool)
+        for i, tg in enumerate(all_tgs):
+            sel = tg.selector
+            if sel is None:
+                continue  # selects() is False without a selector
+            ns_row = np.array(
+                [ns in tg.namespaces for ns in ns_names], dtype=bool
+            )[ns_ids]
+            if sel.match_expressions:
+                # exact Python matcher, once per distinct signature
+                row = np.array(
+                    [sel.matches(rep.metadata.labels) for rep in distinct],
+                    dtype=bool,
+                )
+            elif sel.match_labels:
+                need = np.zeros(enc.shape[1], dtype=bool)
+                for kv in sel.match_labels.items():
+                    need[pair_idx[kv]] = True
+                row = ~((need[None, :] & ~enc).any(axis=1))
+            else:
+                row = np.ones(D, dtype=bool)  # empty selector matches all
+            S[i] = row & ns_row
+
+        SG = S[:, lab_ids]
+        self._row_of = row_of
+        self._SG = SG
+
+        def cls_rows(tg_list):
+            if not tg_list:
+                return np.zeros((0, G), dtype=bool)
+            return SG[[row_of[id(tg)] for tg in tg_list]]
+
+        anti_m = cls_rows(anti_list)
+        spread_m = cls_rows(spread_list)
+        aff_m = cls_rows(aff_list)
+        zi = cls_rows(self.zone_inverse)
+        self._zi_any = zi.any(axis=0) if zi.size else np.zeros(G, dtype=bool)
+        # per-gid class sets / bump-target lists, one nonzero pass per table
+        self._match_sets = _col_sets(anti_m)
+        self._smatch_sets = _col_sets(spread_m)
+        self._amatch_sets = _col_sets(aff_m)
+        rec_m = cls_rows(self.zone_rec_tgs)
+        self._rec_lists = [
+            [self.zone_rec_tgs[i] for i in sorted(s)] for s in _col_sets(rec_m)
+        ]
+
+    def _compute_owns(self) -> list:
+        """Registry-owner inversion: one pass over each group's owner set
+        replaces the per-gid registry scan — same per-gid lists, in the
+        same registry order (each tg appends once per owning gid)."""
+        uid2gid = {rep.uid: g for g, rep in enumerate(self.reps)}
+        own: list = [[] for _ in self.reps]
+        for tg in self.topology.topologies.values():
+            gids = {uid2gid[u] for u in tg.owners if u in uid2gid}
+            for g in gids:
+                own[g].append(tg)
+        return own
+
+    # -- predicate hooks over the precomputed tables ----------------------
+    def _tg_selects(self, tg, gid) -> bool:
+        row = self._row_of.get(id(tg))
+        if row is None:  # not a scan-relevant tg; exact fallback
+            return tg.selects(self.reps[gid])
+        return bool(self._SG[row, gid])
+
+    def _zone_inverse_any(self, gid) -> bool:
+        return bool(self._zi_any[gid])
+
+    def _cls_match(self, gid) -> frozenset:
+        return self._match_sets[gid]
+
+    def _cls_smatch(self, gid) -> frozenset:
+        return self._smatch_sets[gid]
+
+    def _cls_amatch(self, gid) -> frozenset:
+        return self._amatch_sets[gid]
+
+    def _rec_tgs(self, gid) -> list:
+        return self._rec_lists[gid]
+
+    def _water(self, counts: dict, n: int) -> dict:
+        return _water_fill_np(counts, n)
+
+
+def compile_topology(groups: list, topology, vectorized: bool | None = None) -> WavesPlan:
     """groups: list[list[Pod]] (identical pods per list, any order).
     Returns the device plan; pods whose constraints the device cannot
-    express are returned in host_pods."""
+    express are returned in host_pods (with per-reason counts in
+    host_reasons). ``vectorized=False`` (or KARPENTER_WAVES_SEQUENTIAL=1)
+    compiles through the sequential oracle — same plan, per-group Python
+    predicate evaluation; the parity suite diffs the two."""
     groups = sorted(groups, key=lambda g: _group_key(g[0]))  # FFD order
 
     if topology is None or not getattr(topology, "has_groups", False):
         return WavesPlan([DeviceGroup(list(g)) for g in groups], [])
 
-    return _Compiler(groups, topology).run()
+    if vectorized is None:
+        import os
+
+        vectorized = os.environ.get(
+            "KARPENTER_WAVES_SEQUENTIAL", ""
+        ).strip().lower() not in ("1", "true", "yes", "on")
+    cls = _VecCompiler if vectorized else _Compiler
+    return cls(groups, topology).run()

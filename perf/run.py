@@ -71,6 +71,18 @@ def run_solve_config(name, pods, pools, catalog, **solver_kw):
     res, elapsed = _solve_timed(solver, pods, pools, catalog, **solver_kw)
     nodes = res.node_count()
     pps = len(pods) / elapsed
+    # per-stage attribution of the timed solve (mirrors the PR-3
+    # consolidation breakdown): where the wall clock went, how many pods
+    # the device path refused (by reason), and whether the signature-keyed
+    # group-row cache carried the round
+    stats = solver.last_device_stats
+    breakdown = {
+        k: round(stats[k], 2)
+        for k in ("waves_compile_ms", "tensorize_ms", "solve_ms", "decode_ms")
+        if k in stats
+    }
+    breakdown["cache_hits"] = stats.get("group_row_cache_hits", 0)
+    breakdown["cache_misses"] = stats.get("group_row_cache_misses", 0)
     out = {
         "config": name,
         "pods": len(pods),
@@ -80,6 +92,9 @@ def run_solve_config(name, pods, pools, catalog, **solver_kw):
         "nodes": nodes,
         "scheduled": res.scheduled_pod_count(),
         "floor_ok": bool(pps >= 100.0) if len(pods) > 100 else True,
+        "engine": stats.get("engine"),
+        "host_routed": stats.get("host_routed") or {},
+        "breakdown": breakdown,
     }
     if len(pods) <= ORACLE_POD_CAP or os.environ.get("PERF_FULL_ORACLE"):
         ffd, ffd_elapsed = _solve_timed(HostSolver(), pods, pools, catalog)

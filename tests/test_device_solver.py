@@ -342,3 +342,28 @@ class TestIntersectsTolerance:
                                 [ClaimTemplate(pool)], {pool.name: its})
         assert not host.all_pods_scheduled()
         assert not dev.all_pods_scheduled()
+
+
+class TestBinAxisDoubling:
+    """The pipelined doubled re-run: when the estimated bin axis runs dry
+    (every bin used, pods left over), the solver dispatches the doubled
+    axis and decodes against it — speculatively overlapped with the decode
+    on the async device path. Distinct instance-type selectors force one
+    bin per pod while the resource estimate stays tiny, so the initial
+    64-bin floor must grow to place everyone."""
+
+    def test_doubled_rerun_places_everyone(self):
+        catalog = benchmark_catalog(160)
+        names = [it.name for it in catalog]
+        pods = [
+            pod(f"p{i}", cpu=0.1,
+                node_selector={wk.INSTANCE_TYPE_LABEL: names[i % len(names)]})
+            for i in range(130)
+        ]
+        s = TPUSolver()
+        res = s.solve(pods, [ClaimTemplate(nodepool())],
+                      {"default": catalog})
+        assert res.scheduled_pod_count() == 130
+        assert s.last_device_stats["retry_pods"] == 0
+        # one bin per distinct selector cohort
+        assert res.node_count() == 130

@@ -245,6 +245,9 @@ class TestSmallBatchRouting:
 
         monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
         monkeypatch.setenv("KARPENTER_DEVICE_MIN_WORK", "0")
+        # pin the accelerator stance: this box's jax backend is CPU, where
+        # backend-aware routing would (correctly) prefer the C++ engine
+        monkeypatch.setenv("KARPENTER_ASSUME_ACCELERATOR", "1")
         s = TPUSolver()
         pool = nodepool()
         s.solve([pod(f"p{i}") for i in range(1000)], [ClaimTemplate(pool)],
@@ -259,6 +262,7 @@ class TestSmallBatchRouting:
         from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
 
         monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        monkeypatch.setenv("KARPENTER_ASSUME_ACCELERATOR", "1")
         cat = benchmark_catalog(64)
         s = TPUSolver()
         pool = nodepool()
@@ -278,3 +282,71 @@ class TestSmallBatchRouting:
         res = s.solve([pod("p1")], [ClaimTemplate(pool)], {pool.name: catalog})
         assert s.last_device_stats["engine"] == "host"
         assert res.scheduled_pod_count() == 1
+
+
+class TestProbeBatchEntry:
+    """The batched probe entry (karpenter_solve_probe_batch): one native
+    call over N counterfactual rows must reproduce per-row solve_step
+    reductions exactly — same pack, feasibility built once."""
+
+    def test_batch_matches_per_row(self):
+        import numpy as np
+
+        from karpenter_tpu import native
+        from karpenter_tpu.ops.tensorize import bucket, kernel_args, tensorize
+
+        if not native.available() or native.load_probe_batch() is None:
+            pytest.skip("native engine unavailable")
+        pool = nodepool()
+        cat = benchmark_catalog(24)
+        pods = [pod(f"p{i}", cpu=0.25 + (i % 5) * 0.5) for i in range(60)]
+        snap = tensorize(pods, [ClaimTemplate(pool)], {pool.name: cat})
+        Gp, Tp = bucket(snap.G), bucket(snap.T)
+        shared = kernel_args(snap, None, Gp=Gp, Tp=Tp, include_counts=False)
+        E, R = 5, len(snap.resources)
+        shared.update(
+            ge_ok=np.ones((Gp, E), dtype=bool),
+            e_npods=np.zeros(E, dtype=np.int32),
+            e_scnt=np.zeros((E, shared["g_sown"].shape[1]), dtype=np.int32),
+            e_decl=np.zeros((E, shared["g_decl"].shape[1]), dtype=np.uint32),
+            e_match=np.zeros((E, shared["g_decl"].shape[1]), dtype=np.uint32),
+            e_aff=np.zeros((E, shared["g_aneed"].shape[1]), dtype=np.int32),
+        )
+        rng = np.random.RandomState(3)
+        N = 23
+        g_rows = rng.randint(0, 6, size=(N, Gp)).astype(np.int32)
+        g_rows[:, snap.G:] = 0
+        e_rows = (rng.rand(N, E, R) * 6).astype(np.float32)
+        for max_bins in (1, 4):
+            ref_pg = np.zeros((N, Gp), dtype=np.int64)
+            ref_used = np.zeros(N, dtype=np.int64)
+            for i in range(N):
+                args = dict(shared)
+                args["g_count"] = g_rows[i]
+                args["e_avail"] = e_rows[i]
+                out = native.solve_step(args, max_bins)
+                ref_pg[i] = out["assign"].sum(axis=1) + out["assign_e"].sum(axis=1)
+                ref_used[i] = out["used"].sum()
+            pg, used = native.solve_probe_batch(shared, g_rows, e_rows, max_bins)
+            assert (pg == ref_pg).all()
+            assert (used == ref_used).all()
+
+    def test_row_count_mismatch_rejected(self):
+        import numpy as np
+
+        from karpenter_tpu import native
+        from karpenter_tpu.ops.tensorize import bucket, kernel_args, tensorize
+
+        if not native.available() or native.load_probe_batch() is None:
+            pytest.skip("native engine unavailable")
+        pool = nodepool()
+        snap = tensorize([pod("p0")], [ClaimTemplate(pool)],
+                         {pool.name: benchmark_catalog(4)})
+        shared = kernel_args(snap, None, Gp=bucket(snap.G),
+                             Tp=bucket(snap.T), include_counts=False)
+        R = len(snap.resources)
+        with pytest.raises(ValueError):
+            native.solve_probe_batch(
+                shared,
+                np.zeros((2, bucket(snap.G)), dtype=np.int32),
+                np.zeros((3, 1, R), dtype=np.float32), 1)

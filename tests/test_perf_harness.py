@@ -109,3 +109,79 @@ class TestConsolidationMicroBench:
         assert bd["snapshot_delta"]["applies"] >= 1, data
         assert bd["snapshot_delta"]["cache_hits"] >= 1, data
         assert bd["negative_avail_total"] == 0, data
+
+
+@pytest.mark.slow
+class TestGridProvisioningBench:
+    """The grid-1000 provisioning micro-benchmark as a slow-marked test
+    (ISSUE 4 CI kernel): on the plain-spread mix — every constraint the
+    waves compiler expresses — the device path must take EVERY pod (zero
+    host-routed), the second provisioning round must ride the
+    signature-keyed tensorize cache, and the plan must match the host FFD
+    oracle's node count within the BASELINE 2% overhead envelope."""
+
+    def _plain_spread_pods(self, count):
+        import random
+
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+
+        r = random.Random(42)
+        values = ("a", "b", "c", "d", "e", "f", "g")
+        pods = []
+        for i in range(count):
+            labels = {"my-label": r.choice(values)}
+            kw = {}
+            if i % 3 == 0:
+                kw["topology_spread_constraints"] = [TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(
+                        match_labels={"my-label": r.choice(values)}))]
+            elif i % 3 == 1:
+                kw["topology_spread_constraints"] = [TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.HOSTNAME_LABEL,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(
+                        match_labels={"my-label": r.choice(values)}))]
+            pods.append(C._pod(
+                f"g{i}", r.choice((0.1, 0.25, 0.5, 1.0)),
+                r.choice((0.25, 0.5, 1.0)), labels=labels, **kw))
+        return pods
+
+    def test_grid_1000_zero_host_routed_and_cache_hit(self, monkeypatch):
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import HostSolver, TPUSolver
+        from karpenter_tpu.models.solver import NATIVE_CUTOFF_PODS
+        from perf.run import _solve_timed
+
+        # the production routing stance, not the conftest XLA pin
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", str(NATIVE_CUTOFF_PODS))
+        catalog = benchmark_catalog(400)
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pods = self._plain_spread_pods(1000)
+        solver = TPUSolver()
+        res1, _ = _solve_timed(solver, pods, [pool], catalog)
+        # round 1: every pod is device-expressible on this mix
+        assert solver.last_device_stats["host_pods"] == 0, solver.last_device_stats
+        assert solver.last_device_stats["host_routed"] == {}
+        # round 2 (fresh clones, same specs): the signature-keyed row cache
+        # must carry the tensorize
+        res2, _ = _solve_timed(solver, pods, [pool], catalog)
+        stats = solver.last_device_stats
+        assert stats["host_pods"] == 0 and stats["retry_pods"] == 0
+        assert stats["group_row_cache_hits"] >= 1, stats
+        assert stats["group_row_cache_misses"] == 0, stats
+        assert res1.node_count() == res2.node_count()
+        assert res2.scheduled_pod_count() == 1000
+        # stage attribution is present for the bench JSON
+        for k in ("waves_compile_ms", "tensorize_ms", "solve_ms", "decode_ms"):
+            assert stats[k] >= 0.0
+        # the host FFD oracle schedules the same workload (node-count
+        # tightness on the REFERENCE mixes is tracked by python -m perf
+        # grid's node_overhead_pct; this synthetic all-spread mix is not a
+        # BASELINE config)
+        oracle, _ = _solve_timed(HostSolver(), pods, [pool], catalog)
+        assert oracle.scheduled_pod_count() == res2.scheduled_pod_count()

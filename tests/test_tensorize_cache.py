@@ -1,0 +1,197 @@
+"""Signature-keyed group-row cache: reuse and invalidation contract.
+
+The cache (ops/tensorize.py `tensorize`) keys packed group rows on
+(pod scheduling signature, waves extra-requirement fingerprint) INSIDE one
+type-side cache entry. Anything that changes the type side — templates,
+catalog identity or offering state, the group requirement universe, the
+resource axis — lands in a fresh type-side entry whose row cache starts
+empty, which IS the invalidation: rows can never be served across a
+vocabulary change. This suite pins both directions (reuse where legal,
+rebuild where anything relevant moved) in the style of
+tests/test_tensorize_delta.py."""
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    Taint,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+from karpenter_tpu.models import ClaimTemplate
+from karpenter_tpu.models.topology import Topology
+from karpenter_tpu.ops import waves
+from karpenter_tpu.ops.tensorize import (
+    STATS,
+    device_basic_eligible,
+    group_by_signature,
+    tensorize,
+)
+
+GIB = 2**30
+
+
+def make_pods(n=20, sigs=4):
+    return [
+        Pod(
+            metadata=ObjectMeta(name=f"p{i}", labels={"app": f"a{i % sigs}"}),
+            requests={"cpu": 0.5 + (i % sigs) * 0.25, "memory": GIB},
+        )
+        for i in range(n)
+    ]
+
+
+def counts():
+    return STATS["group_row_hits"], STATS["group_row_misses"]
+
+
+def snap_group_tensors(snap):
+    return (
+        snap.g_mask.copy(), snap.g_has.copy(), snap.g_tol.copy(),
+        snap.g_tmpl_ok.copy(), snap.g_zone_allowed.copy(),
+        snap.g_ct_allowed.copy(),
+    )
+
+
+@pytest.fixture
+def pool():
+    return NodePool(metadata=ObjectMeta(name="default"))
+
+
+@pytest.fixture
+def catalog():
+    return benchmark_catalog(12)
+
+
+class TestReuse:
+    def test_second_round_hits_and_is_bit_identical(self, pool, catalog):
+        pods = make_pods()
+        tpl = [ClaimTemplate(pool)]
+        its = {"default": catalog}
+        s1 = tensorize(pods, tpl, its)
+        ref = snap_group_tensors(s1)
+        h0, m0 = counts()
+        # a provisioning round later: same specs, fresh clones (new uids,
+        # no cached signature attribute)
+        s2 = tensorize([p.clone() for p in pods], tpl, its)
+        h1, m1 = counts()
+        assert m1 == m0  # zero rebuilds
+        assert h1 - h0 == s2.G
+        for a, b in zip(ref, snap_group_tensors(s2)):
+            assert (a == b).all()
+
+    def test_new_signature_misses_only_itself(self, pool, catalog):
+        tpl = [ClaimTemplate(pool)]
+        its = {"default": catalog}
+        tensorize(make_pods(), tpl, its)
+        h0, m0 = counts()
+        # new signature via requests only: the requirement universe (and so
+        # the type-side entry) is untouched — a node_selector would widen
+        # the vocabulary and correctly rebuild everything instead
+        extra = Pod(
+            metadata=ObjectMeta(name="new", labels={"app": "new"}),
+            requests={"cpu": 3.0, "memory": GIB},
+        )
+        tensorize(make_pods() + [extra], tpl, its)
+        h1, m1 = counts()
+        assert m1 - m0 == 1  # only the unseen signature rebuilt
+        assert h1 - h0 >= 4
+
+    def test_cached_rows_are_copies(self, pool, catalog):
+        """Mutating a snapshot's tensors must not corrupt the cache."""
+        pods = make_pods()
+        tpl = [ClaimTemplate(pool)]
+        its = {"default": catalog}
+        s1 = tensorize(pods, tpl, its)
+        s1.g_mask[:] = 0xFFFFFFFF
+        s1.g_tmpl_ok[:] = False
+        s2 = tensorize(make_pods(), tpl, its)
+        assert s2.g_tmpl_ok.any()
+        assert not (s2.g_mask == 0xFFFFFFFF).all()
+
+
+class TestInvalidation:
+    def test_template_taint_change_rebuilds(self, pool, catalog):
+        its = {"default": catalog}
+        tensorize(make_pods(), [ClaimTemplate(pool)], its)
+        tainted = NodePool(metadata=ObjectMeta(name="default"))
+        tainted.spec.template.taints = [
+            Taint(key="dedicated", value="x", effect="NoSchedule")]
+        h0, m0 = counts()
+        s2 = tensorize(make_pods(), [ClaimTemplate(tainted)], its)
+        h1, m1 = counts()
+        assert m1 - m0 == s2.G  # fresh type-side entry: every row rebuilt
+        assert not s2.g_tmpl_ok.any()  # and the rebuild saw the taint
+
+    def test_offering_state_change_rebuilds(self, pool, catalog):
+        its = {"default": catalog}
+        tensorize(make_pods(), [ClaimTemplate(pool)], its)
+        # the standard ICE pattern: flip an offering in place
+        catalog[0].offerings[0].available = not catalog[0].offerings[0].available
+        h0, m0 = counts()
+        s2 = tensorize(make_pods(), [ClaimTemplate(pool)], its)
+        _, m1 = counts()
+        assert m1 - m0 == s2.G
+
+    def test_catalog_identity_change_rebuilds(self, pool):
+        its1 = {"default": benchmark_catalog(8)}
+        tensorize(make_pods(), [ClaimTemplate(pool)], its1)
+        its2 = {"default": benchmark_catalog(8)}  # equal content, new objects
+        h0, m0 = counts()
+        s2 = tensorize(make_pods(), [ClaimTemplate(pool)], its2)
+        _, m1 = counts()
+        assert m1 - m0 == s2.G
+
+    def test_resource_axis_change_rebuilds(self, pool, catalog):
+        its = {"default": catalog}
+        tensorize(make_pods(), [ClaimTemplate(pool)], its)
+        pods = make_pods()
+        pods[0].requests["example.com/gpu"] = 1.0  # widens the R axis
+        h0, m0 = counts()
+        s2 = tensorize(pods, [ClaimTemplate(pool)], its)
+        _, m1 = counts()
+        assert m1 - m0 == s2.G
+
+
+class TestWavesExtras:
+    def test_zone_pin_distinguishes_rows(self, pool):
+        """The same pod signature lands in different zone subgroups; their
+        packed rows must differ (the extra-req fingerprint keys them)."""
+        catalog = benchmark_catalog(6, zones=("zone-1", "zone-2", "zone-3"))
+        sel = LabelSelector(match_labels={"app": "s"})
+        pods = [
+            Pod(
+                metadata=ObjectMeta(name=f"p{i}", labels={"app": "s"}),
+                requests={"cpu": 0.5, "memory": GIB},
+                topology_spread_constraints=[TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                    when_unsatisfiable="DoNotSchedule", label_selector=sel)],
+            )
+            for i in range(9)
+        ]
+        domains = {wk.TOPOLOGY_ZONE_LABEL: {"zone-1", "zone-2", "zone-3"}}
+        tpl = [ClaimTemplate(pool)]
+        its = {"default": catalog}
+
+        def compile_plan(ps):
+            topo = Topology(domains=domains, pods=ps)
+            basic = [p for p in ps if device_basic_eligible(p)]
+            return waves.compile_topology(group_by_signature(basic), topo)
+
+        plan = compile_plan(pods)
+        assert len(plan.device_groups) == 3  # one subgroup per zone
+        s1 = tensorize(None, tpl, its, device_plan=plan)
+        # the three zone-pinned rows differ in their allowed-zone sets
+        assert len({tuple(r) for r in s1.g_zone_allowed.tolist()}) == 3
+        h0, m0 = counts()
+        s2 = tensorize(
+            None, tpl, its,
+            device_plan=compile_plan([p.clone() for p in pods]),
+        )
+        h1, m1 = counts()
+        assert m1 == m0 and h1 - h0 == s2.G  # all three subgroup rows reused
+        assert (s1.g_zone_allowed == s2.g_zone_allowed).all()

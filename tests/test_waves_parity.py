@@ -1,0 +1,232 @@
+"""Waves-compiler parity suite: the vectorized overlay compiler must be
+bit-identical to the sequential oracle.
+
+The vectorized compiler (ops/waves.py _VecCompiler) shares the sequential
+scan verbatim and precomputes every predicate as batched numpy tables, so
+any drift can only come from those tables (selector matching, ownership
+inversion, class sets, water fill). This suite compiles ≥100 seeded random
+topology mixes — spread/affinity/anti-affinity over the 7-value label
+universe, zone and hostname keyed, expression selectors included — through
+both compilers and asserts plan identity down to pod ordering, extra
+requirements, caps, and class wiring."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.models.topology import Topology
+from karpenter_tpu.ops import waves
+from karpenter_tpu.ops.tensorize import device_basic_eligible, group_by_signature
+
+GIB = 2**30
+VALUES = ("a", "b", "c", "d", "e", "f", "g")
+ZONES = ("zone-1", "zone-2", "zone-3", "zone-4")
+
+
+def plan_signature(plan):
+    """Structural identity of a WavesPlan: pods by object id and order,
+    group structure field by field, host routing, class wiring."""
+    return (
+        [
+            (
+                [id(p) for p in dg.pods],
+                sorted(
+                    (r.key, r.complement, tuple(sorted(r.values)),
+                     r.greater_than, r.less_than)
+                    for r in dg.extra_reqs
+                ),
+                dg.bin_cap,
+                dg.single_bin,
+                sorted(dg.decl_classes),
+                sorted(dg.match_classes),
+                sorted(dg.spread_caps.items()),
+                sorted(dg.spread_matches),
+                sorted(dg.aff_need),
+                sorted(dg.aff_match),
+            )
+            for dg in plan.device_groups
+        ],
+        [id(p) for p in plan.host_pods],
+        plan.n_classes,
+        plan.n_spread_classes,
+        plan.n_aff_classes,
+        [(id(d), id(i)) for d, i in plan.anti_tgs_by_class],
+        [id(x) for x in plan.spread_tgs_by_class],
+        [id(x) for x in plan.aff_tgs_by_class],
+        dict(plan.host_reasons),
+    )
+
+
+def random_mix(r: random.Random, n_pods: int, kinds=range(8)):
+    """One seeded topology mix in the reference benchmark's shape, plus the
+    corner shapes the compiler routes to the host (zone anti-affinity,
+    minDomains, expression selectors)."""
+    pods = []
+    for i in range(n_pods):
+        labels = {"my-label": r.choice(VALUES)}
+        kw = {}
+        kind = r.choice(kinds)
+        if kind == 0:  # zone spread, random selector (often cross-group)
+            kw["topology_spread_constraints"] = [TopologySpreadConstraint(
+                max_skew=r.choice((1, 2)),
+                topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                min_domains=r.choice((None, None, None, 2)),
+                label_selector=LabelSelector(
+                    match_labels={"my-label": r.choice(VALUES)}),
+            )]
+        elif kind == 1:  # hostname spread
+            kw["topology_spread_constraints"] = [TopologySpreadConstraint(
+                max_skew=r.choice((1, 2, 3)),
+                topology_key=wk.HOSTNAME_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(
+                    match_labels={"my-label": r.choice(VALUES)}),
+            )]
+        elif kind == 2:  # hostname affinity (cross-group chains)
+            kw["affinity"] = Affinity(pod_affinity=PodAffinity(required=[
+                PodAffinityTerm(
+                    topology_key=wk.HOSTNAME_LABEL,
+                    label_selector=LabelSelector(
+                        match_labels={"my-label": r.choice(VALUES)}))
+            ]))
+        elif kind == 3:  # zone affinity
+            kw["affinity"] = Affinity(pod_affinity=PodAffinity(required=[
+                PodAffinityTerm(
+                    topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                    label_selector=LabelSelector(
+                        match_labels={"my-label": r.choice(VALUES)}))
+            ]))
+        elif kind == 4:  # hostname anti-affinity (self or cross cohort)
+            sel = {"my-label": labels["my-label"] if r.random() < 0.5
+                   else r.choice(VALUES)}
+            kw["affinity"] = Affinity(pod_anti_affinity=PodAffinity(required=[
+                PodAffinityTerm(
+                    topology_key=wk.HOSTNAME_LABEL,
+                    label_selector=LabelSelector(match_labels=sel))
+            ]))
+        elif kind == 5:  # zone anti-affinity: must route to the host engine
+            kw["affinity"] = Affinity(pod_anti_affinity=PodAffinity(required=[
+                PodAffinityTerm(
+                    topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                    label_selector=LabelSelector(
+                        match_labels={"my-label": r.choice(VALUES)}))
+            ]))
+        elif kind == 6:  # expression selector: Python-matcher fallback path
+            kw["topology_spread_constraints"] = [TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_expressions=[
+                    NodeSelectorRequirement(
+                        "my-label",
+                        r.choice(("In", "NotIn", "Exists")),
+                        [r.choice(VALUES)]),
+                ]),
+            )]
+        # kind 7: plain pod, counts for other groups' selectors
+        if r.random() < 0.2:
+            kw["node_selector"] = {
+                wk.TOPOLOGY_ZONE_LABEL: r.choice(ZONES[:3])}
+        pods.append(Pod(
+            metadata=ObjectMeta(name=f"p{i}", labels=dict(labels)),
+            requests={"cpu": r.choice((0.1, 0.25, 0.5, 1.0)),
+                      "memory": r.choice((0.25, 0.5, 1.0)) * GIB},
+            **kw,
+        ))
+    return pods
+
+
+def compile_both(pods, domains):
+    basic = [p for p in pods if device_basic_eligible(p)]
+    topo = Topology(domains=domains, pods=pods)
+    groups = group_by_signature(basic)
+    seq = waves.compile_topology(groups, topo, vectorized=False)
+    vec = waves.compile_topology(groups, topo, vectorized=True)
+    return seq, vec
+
+
+class TestSeededParity:
+    @pytest.mark.parametrize("seed", range(120))
+    def test_random_mix_plan_identical(self, seed):
+        r = random.Random(1000 + seed)
+        pods = random_mix(r, r.randrange(20, 120))
+        domains = {wk.TOPOLOGY_ZONE_LABEL: set(ZONES[: r.choice((2, 3, 4))])}
+        seq, vec = compile_both(pods, domains)
+        assert plan_signature(seq) == plan_signature(vec)
+
+    def test_large_mix_plan_identical(self):
+        # no zone anti-affinity in the big mix: a single declarer's inverse
+        # selector would route every matching pod host and the device side
+        # would go empty (covered by the seeded cases above)
+        r = random.Random(7)
+        pods = random_mix(r, 1500, kinds=(0, 1, 2, 3, 4, 6, 7))
+        domains = {wk.TOPOLOGY_ZONE_LABEL: set(ZONES[:3])}
+        seq, vec = compile_both(pods, domains)
+        assert plan_signature(seq) == plan_signature(vec)
+        # the mix must actually exercise both sides of the split
+        assert seq.device_groups and seq.host_pods
+
+    def test_host_reasons_populated(self):
+        r = random.Random(11)
+        pods = random_mix(r, 300)
+        domains = {wk.TOPOLOGY_ZONE_LABEL: set(ZONES[:3])}
+        seq, vec = compile_both(pods, domains)
+        assert seq.host_reasons == vec.host_reasons
+        # zone anti-affinity is in the mix: the reason ledger must name it
+        # and account for every host-routed pod
+        assert sum(seq.host_reasons.values()) == len(seq.host_pods)
+        if seq.host_pods:
+            assert set(seq.host_reasons) <= {
+                "zone-inverse-anti", "zone-spread", "zone-affinity",
+                "hostname-affinity-existing", "unsupported-constraint",
+                "affinity-unresolved",
+            }
+
+
+class TestWaterFillParity:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_closed_form_matches_sequential(self, seed):
+        r = random.Random(seed)
+        for _ in range(2000):
+            counts = {
+                f"z{chr(97 + i)}": r.randint(0, 15)
+                for i in range(r.randint(1, 7))
+            }
+            n = r.randint(0, 80)
+            assert waves._water_fill(counts, n) == waves._water_fill_np(counts, n)
+
+    def test_large_counts(self):
+        r = random.Random(99)
+        for _ in range(500):
+            counts = {f"z{i}": r.randint(0, 10**6) for i in range(r.randint(1, 5))}
+            n = r.randint(0, 10**7)
+            assert waves._water_fill(counts, n) == waves._water_fill_np(counts, n)
+
+
+class TestSequentialEnvSwitch:
+    def test_env_forces_sequential(self, monkeypatch):
+        """KARPENTER_WAVES_SEQUENTIAL=1 routes compile_topology through the
+        oracle (debug/A-B lever); the default is the vectorized compiler."""
+        r = random.Random(3)
+        pods = random_mix(r, 60)
+        domains = {wk.TOPOLOGY_ZONE_LABEL: set(ZONES[:3])}
+        basic = [p for p in pods if device_basic_eligible(p)]
+        topo = Topology(domains=domains, pods=pods)
+        groups = group_by_signature(basic)
+        monkeypatch.setenv("KARPENTER_WAVES_SEQUENTIAL", "1")
+        seq = waves.compile_topology(groups, topo)
+        monkeypatch.delenv("KARPENTER_WAVES_SEQUENTIAL")
+        vec = waves.compile_topology(groups, topo)
+        assert plan_signature(seq) == plan_signature(vec)
