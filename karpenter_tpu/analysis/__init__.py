@@ -1,11 +1,16 @@
 """graftlint: repo-native static analysis for the jax_graft codebase.
 
-Three rule families over the package AST (stdlib-only, no jax import —
+Rule families over the package AST (stdlib-only, no jax import —
 cheap enough to run as a tier-1 gate and as bench.py's preflight):
 
 - GL1xx tracing safety (tracing.py): host syncs, traced-value branching,
   trace-time side effects, and jit-in-loop recompilation storms in code
   reachable from ``jax.jit`` / ``pl.pallas_call`` entries.
+- GL4xx observability safety (tracing.py, riding the same GL1xx
+  reachability pass): no obs flight-recorder span enter/exit
+  (``span``/``round_trace``) or anomaly/recorder mutation may be
+  reachable from jit/pallas-traced code — the tracer stays
+  safe-by-construction on the solve path.
 - GL2xx lock discipline (locks.py): unguarded mutation of lock-guarded
   state, ABBA lock-order cycles, and plain-Lock re-entry deadlocks.
 - GL3xx drift (drift.py): stale/dead ``__init__`` export surface and

@@ -1,4 +1,4 @@
-"""GL1xx — tracing safety for jit/pallas hot paths.
+"""GL1xx tracing safety + GL4xx observability safety for jit/pallas paths.
 
 The solve path compiles through ``jax.jit`` / ``pl.pallas_call`` wrappers
 (models/solver.py, ops/consolidate.py, parallel/mesh.py, ops/pallas_kernels.py).
@@ -16,6 +16,22 @@ Inside anything reachable from those entries, the silent failure modes are:
 - GL104 jit-in-loop: constructing ``jax.jit(...)`` / ``pl.pallas_call(...)``
   inside a loop body — a fresh wrapper per iteration recompiles every time
   (the recompilation-storm class the module-level kernel caches exist for).
+
+The GL4xx family rides the same inter-procedural reachability pass and
+keeps the reconcile flight recorder (``karpenter_tpu/obs``) safe by
+construction — a span enter/exit or anomaly mark that becomes reachable
+from a jit/pallas entry would execute ONCE at trace time (freezing one
+batch's timing into the compiled program and corrupting every later
+round's trace) while its perf_counter/thread-local machinery races XLA's
+runtime:
+
+- GL401 span-in-trace: ``span(...)`` / ``round_trace(...)`` (bare or as
+  the last attribute of any chain — ``obs.span``, ``TRACER.span``) inside
+  jit-reachable code.
+- GL402 recorder-in-trace: ``anomaly(...)`` / ``record_anomaly(...)``
+  anywhere jit-reachable, plus ``record``/``dump`` invoked on an
+  obs-plane object (``obs.*``, ``RECORDER``/``recorder``/``TRACER``/
+  ``tracer``/``FLIGHT_RECORDER``).
 
 Reachability is an inter-procedural taint pass: entry functions are those
 handed to jit/pallas_call (as decorator, call argument, or via
@@ -38,6 +54,8 @@ RULES = {
     "GL102": "Python branch (if/while/assert) on a traced value in jit-reachable code",
     "GL103": "host side effect (print/logging/os.environ/global) in jit-reachable code freezes at trace time",
     "GL104": "jax.jit/pl.pallas_call constructed inside a loop recompiles every iteration",
+    "GL401": "obs tracer span enter/exit (span/round_trace) in jit-reachable code executes at trace time",
+    "GL402": "obs flight-recorder mutation (anomaly/record/dump) in jit-reachable code executes at trace time",
 }
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
@@ -47,6 +65,15 @@ _NUMPY_ALIASES = {"np", "_np", "numpy", "onp"}
 _JIT_NAMES = {"jax.jit", "jit"}
 _PALLAS_NAMES = {"pl.pallas_call", "pallas.pallas_call", "pallas_call"}
 _PARTIAL_NAMES = {"functools.partial", "partial"}
+# GL4xx — the obs flight-recorder surface (karpenter_tpu/obs). Span entry
+# is matched by final name so `obs.span`, `TRACER.span`, and a bare
+# imported `span` all hit; the generic `record`/`dump` verbs only count
+# when invoked on an unmistakably obs-plane receiver.
+_SPAN_FUNCS = {"span", "round_trace"}
+_ANOMALY_FUNCS = {"anomaly", "record_anomaly"}
+_RECORDER_VERBS = {"record", "dump"}
+_OBS_BASES = {"obs", "TRACER", "tracer", "RECORDER", "recorder",
+              "FLIGHT_RECORDER"}
 
 
 def _const_names(node) -> set:
@@ -465,6 +492,31 @@ class _TaintVisitor:
                 node.lineno,
                 f"`{fname}()` on a traced value inside jit-reachable "
                 f"`{self.fn.name}` pulls the array to host",
+            )
+
+        # GL4xx — obs flight recorder reachable from a jit/pallas entry:
+        # the span/anomaly machinery (perf_counter, thread-local stacks,
+        # ring mutation) would run once at trace time and race XLA's
+        # runtime thereafter. Matches the module-level helpers AND any
+        # attribute spelling (obs.span / TRACER.span / self._tracer.span).
+        last = fname.split(".")[-1] if fname else ""
+        if last in _SPAN_FUNCS:
+            self._flag(
+                "GL401",
+                node.lineno,
+                f"tracer span `{fname}(...)` inside jit-reachable "
+                f"`{self.fn.name}` executes at trace time (hoist the span "
+                "to the host-side dispatch site)",
+            )
+        elif last in _ANOMALY_FUNCS or (
+            last in _RECORDER_VERBS and base in _OBS_BASES
+        ):
+            self._flag(
+                "GL402",
+                node.lineno,
+                f"flight-recorder call `{fname}(...)` inside jit-reachable "
+                f"`{self.fn.name}` executes at trace time (mark anomalies "
+                "from the host-side caller)",
             )
 
         # GL103 side effects

@@ -12,6 +12,7 @@ blocking TTL wait as a pending-command slot re-checked on later polls.
 
 from __future__ import annotations
 
+from karpenter_tpu import obs
 from karpenter_tpu.api.nodepool import REASON_EMPTY
 from karpenter_tpu.controllers.disruption.helpers import (
     build_disruption_budgets,
@@ -119,10 +120,16 @@ class DisruptionController:
         self._last_run = now
         if not self.cluster.synced():
             return progressed
-        self._cleanup_orphan_taints()
-        if self._pending is not None:
-            return self._handle_pending() or progressed
-        return self._compute_round() or progressed
+        # one trace per disruption round: the method ladder, every probe
+        # dispatch, and every confirming simulation nest under it, so an
+        # anomalous round (probe fallback, >1 MultiNode confirm, snapshot
+        # rebuild) dumps with its full causal span tree
+        with obs.round_trace("disrupt", registry=self.registry):
+            with obs.span("disrupt.taint_cleanup"):
+                self._cleanup_orphan_taints()
+            if self._pending is not None:
+                return self._handle_pending() or progressed
+            return self._compute_round() or progressed
 
     # -- watchdog (logAbnormalRuns, controller.go:274-283) ---------------
     def _log_abnormal_run(self, now: float):
@@ -168,13 +175,16 @@ class DisruptionController:
     def _compute_round(self) -> bool:
         from karpenter_tpu.operator import metrics as m
 
-        candidates = get_candidates(
-            self.cluster, self.store, self.cloud, self.clock, queue=self.queue,
-            catalog_cache=self._catalog_cache,
-        )
+        with obs.span("disrupt.candidates"):
+            candidates = get_candidates(
+                self.cluster, self.store, self.cloud, self.clock,
+                queue=self.queue, catalog_cache=self._catalog_cache,
+            )
         self.registry.gauge(m.DISRUPTION_ELIGIBLE_NODES, "disruptable candidates").set(
             len(candidates))
-        budgets = build_disruption_budgets(self.cluster, self.store, self.clock)
+        with obs.span("disrupt.budgets"):
+            budgets = build_disruption_budgets(
+                self.cluster, self.store, self.clock)
         # allowed-disruptions gauge per (nodepool, reason), refreshed every
         # round — including candidate-free ones, so closed budget windows
         # and deleted pools never serve stale values
@@ -185,12 +195,18 @@ class DisruptionController:
             for reason, allowed in by_reason.items():
                 bg.set(allowed, nodepool=pool, reason=reason)
         if not candidates:
+            obs.discard_round()  # idle tick: nothing disruptable
             return False
         fence = self.cluster.consolidation_state()
+        ran_search = False
         for method in self.methods:
             if method.is_consolidation and fence == self._noop_fence:
                 continue  # nothing moved since the last fruitless search
-            with self.registry.measure(m.DISRUPTION_EVAL_DURATION, method=type(method).__name__):
+            ran_search = ran_search or method.is_consolidation
+            with obs.span(f"method.{type(method).__name__}"), \
+                    self.registry.measure(
+                        m.DISRUPTION_EVAL_DURATION,
+                        method=type(method).__name__):
                 cmd = method.compute_command(list(candidates), budgets)
             if cmd is None or not cmd.candidates:
                 continue
@@ -199,15 +215,25 @@ class DisruptionController:
                 return True
             return self._execute(cmd)
         self._noop_fence = fence
+        if not ran_search:
+            # candidates exist but every consolidation search sat behind
+            # the noop fence and the cheap filters (Drift/Emptiness) found
+            # nothing — this tick carries no story; recording it every
+            # poll_period would churn the one interesting round out of
+            # the flight-recorder ring
+            obs.discard_round()
         return False
 
     # -- validation TTL (validation.go:55-212) ---------------------------
     def _handle_pending(self) -> bool:
         cmd, method, computed_at = self._pending
         if self.clock.now() - computed_at < self.validation_ttl:
+            obs.discard_round()  # idle tick: waiting out the TTL
             return False  # still inside the TTL window
         self._pending = None
-        if not self._validate(cmd, method):
+        with obs.span("disrupt.validate", method=type(method).__name__):
+            ok = self._validate(cmd, method)
+        if not ok:
             return True  # dropped; next round recomputes
         return self._execute(cmd)
 
@@ -268,6 +294,10 @@ class DisruptionController:
 
     # -- execution (controller.go executeCommand:188) --------------------
     def _execute(self, cmd) -> bool:
+        with obs.span("disrupt.execute", action=cmd.action, reason=cmd.reason):
+            return self._execute_inner(cmd)
+
+    def _execute_inner(self, cmd) -> bool:
         # 1. taint candidates so nothing schedules onto them (:196)
         for c in cmd.candidates:
             node = self.store.try_get("nodes", c.name)

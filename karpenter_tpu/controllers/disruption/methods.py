@@ -12,6 +12,7 @@ and ≥15 cheaper types to prevent churn).
 
 from __future__ import annotations
 
+from karpenter_tpu import obs
 from karpenter_tpu.api import labels as wk
 from karpenter_tpu.api.nodeclaim import COND_DRIFTED, COND_EMPTY
 from karpenter_tpu.api.nodepool import (
@@ -87,10 +88,11 @@ class Drift(Method):
         )
         inputs = cache.inputs_for(ctx.cluster) if cache is not None else None
         for c in drifted:
-            sim = simulate_scheduling(
-                self.ctx.provisioner, self.ctx.cluster, self.ctx.store, [c],
-                inputs=inputs, bundle=bundle,
-            )
+            with obs.span("confirm.simulate", method="drift"):
+                sim = simulate_scheduling(
+                    self.ctx.provisioner, self.ctx.cluster, self.ctx.store,
+                    [c], inputs=inputs, bundle=bundle,
+                )
             if not sim.all_pods_scheduled():
                 continue
             return Command([c], replacements=sim.new_claims, reason=self.reason)
@@ -299,14 +301,15 @@ def _device_probe(ctx, probe_fn, method_label, cands, pool):
     if not isinstance(getattr(ctx.provisioner, "solver", None), TPUSolver):
         return None
     try:
-        out = probe_fn(
-            ctx.provisioner, ctx.cluster, ctx.store, cands,
-            cache=getattr(ctx, "snapshot_cache", None),
-            registry=ctx.registry,
-            # the snapshot is built over the FULL consolidatable pool so
-            # MultiNode's and SingleNode's probes share one tensorization
-            build_candidates=pool,
-        )
+        with obs.span("probe", method=method_label, candidates=len(cands)):
+            out = probe_fn(
+                ctx.provisioner, ctx.cluster, ctx.store, cands,
+                cache=getattr(ctx, "snapshot_cache", None),
+                registry=ctx.registry,
+                # the snapshot is built over the FULL consolidatable pool so
+                # MultiNode's and SingleNode's probes share one tensorization
+                build_candidates=pool,
+            )
     except Exception:
         import logging
 
@@ -317,6 +320,11 @@ def _device_probe(ctx, probe_fn, method_label, cands, pool):
             "device consolidation probes that fell back to the "
             "sequential search",
         ).inc(method=method_label)
+        # anomaly trigger: a fallback costs the round its batched dispatch
+        # — the flight recorder keeps this round's span tree so the
+        # failing stage is attributable from the dump, not just counted
+        obs.anomaly("probe-fallback", registry=ctx.registry,
+                    method=method_label)
         logging.getLogger(__name__).warning(
             "device consolidation probe (%s) failed; using the sequential "
             "search", method_label, exc_info=True)
@@ -377,13 +385,30 @@ class MultiNodeConsolidation(Method):
     last_host_confirms: int = 0  # host simulations this round (tests + perf)
 
     def compute_command(self, candidates, budgets):
+        # reset BEFORE the search: an early return inside _compute (fewer
+        # than 2 candidates) must not leave last round's counter behind to
+        # fire a spurious anomaly on a quiet round
+        self.last_host_confirms = 0
+        self.last_probe = ""
+        cmd = self._compute(candidates, budgets)
+        if self.last_host_confirms > 1:
+            # anomaly trigger: the batched confirm ladder targets exactly
+            # one host simulation per round (ROADMAP PR 3) — more means
+            # probe-vs-host disagreement or a non-definitive ladder, and
+            # the round's trace shows which confirm burned the time
+            obs.anomaly(
+                "multi-host-confirms", registry=self.ctx.registry,
+                confirms=self.last_host_confirms, probe=self.last_probe,
+            )
+        return cmd
+
+    def _compute(self, candidates, budgets):
         pool = _consolidatable(candidates)
         pool.sort(key=lambda c: c.disruption_cost)
         cands = within_budget(budgets, self.reason, pool)[:MULTI_NODE_CANDIDATE_CAP]
         if len(cands) < 2:
             return None
         self._deadline = self.ctx.clock.now() + MULTI_NODE_TIMEOUT
-        self.last_host_confirms = 0
 
         probed = self._probe(cands, pool)
         if probed is not None:
@@ -436,8 +461,10 @@ class MultiNodeConsolidation(Method):
             m.DISRUPTION_HOST_CONFIRMS,
             "confirming host simulations run by consolidation methods",
         ).inc(method="multi")
-        with self.ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
-                                       method="multi"):
+        with obs.span("confirm.simulate", method="multi",
+                      prefix=len(prefix)), \
+                self.ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
+                                          method="multi"):
             cmd = compute_consolidation(self.ctx, prefix)
         if cmd is None or cmd.action == "no-op":
             return None
@@ -582,8 +609,9 @@ class SingleNodeConsolidation(Method):
             m.DISRUPTION_HOST_CONFIRMS,
             "confirming host simulations run by consolidation methods",
         ).inc(method="single")
-        with self.ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
-                                       method="single"):
+        with obs.span("confirm.simulate", method="single"), \
+                self.ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
+                                          method="single"):
             return compute_consolidation(self.ctx, [c])
 
     def _timed_out(self, deadline) -> bool:

@@ -10,6 +10,7 @@ types (:363), then create NodeClaims and nominate the pods (:149-160).
 
 from __future__ import annotations
 
+from karpenter_tpu import obs
 from karpenter_tpu.api import labels as wk
 from karpenter_tpu.controllers.provisioning.batcher import Batcher
 from karpenter_tpu.models import ClaimTemplate
@@ -176,11 +177,16 @@ class Provisioner:
                 self.batcher.trigger()  # retry next round
                 return False
 
-        with self.registry.measure(m.SCHEDULING_DURATION):
-            results = self.schedule()
-        if results is None:
-            return False
-        return self.create_node_claims(results)
+        # one trace per solve round: the flight recorder keeps the span
+        # tree and dumps it if the round trips an anomaly (host-routed
+        # pods being the provisioning trigger)
+        with obs.round_trace("provision", registry=self.registry):
+            with self.registry.measure(m.SCHEDULING_DURATION):
+                results = self.schedule()
+            if results is None:
+                return False
+            with obs.span("provision.create"):
+                return self.create_node_claims(results)
 
     def pending_pods(self) -> list:
         """Provisionable pods, excluding ones nominated onto capacity that
@@ -214,17 +220,22 @@ class Provisioner:
         if state_nodes is None:
             state_nodes = self.cluster.nodes() if self.cluster is not None else []
         if pods is None:
-            pods = self.pending_pods()
-            pods.extend(self.deleting_node_pods(state_nodes, pods))
+            with obs.span("provision.pending"):
+                pods = self.pending_pods()
+                pods.extend(self.deleting_node_pods(state_nodes, pods))
             if not pods:
                 return None
         # disruption simulations may hand in the round's cached solver
         # inputs (ops/consolidate.py SnapshotCache.inputs_for) — identical
         # content to a fresh assembly within one cluster-state generation,
         # which the cache verifies before releasing them
-        templates, its_by_pool, overhead, limits, domains = (
-            inputs if inputs is not None else self.solver_inputs()
-        )
+        if inputs is not None:
+            templates, its_by_pool, overhead, limits, domains = inputs
+        else:
+            with obs.span("provision.inputs", kind="cache"):
+                templates, its_by_pool, overhead, limits, domains = (
+                    self.solver_inputs()
+                )
 
         # pods with unresolvable PVCs can't schedule: report and drop from
         # the batch (ValidatePersistentVolumeClaims, volumetopology.go:155)
@@ -233,13 +244,14 @@ class Provisioner:
 
         vt = VolumeTopology(self.store)
         valid_pods = []
-        for p in pods:
-            try:
-                vt.validate(p)
-                valid_pods.append(p)
-            except PVCError as e:
-                if self.recorder is not None:
-                    self.recorder.publish("FailedScheduling", str(e), obj=p)
+        with obs.span("provision.volumes", pods=len(pods)):
+            for p in pods:
+                try:
+                    vt.validate(p)
+                    valid_pods.append(p)
+                except PVCError as e:
+                    if self.recorder is not None:
+                        self.recorder.publish("FailedScheduling", str(e), obj=p)
         # provisioning/metrics.go: queue depth at solve entry + pods the
         # batch dropped as unresolvable. Only the LIVE batch reports —
         # disruption counterfactuals must not clobber the gauges (the
@@ -264,16 +276,18 @@ class Provisioner:
             if self.cluster is not None
             else StoreClusterView(self.store)
         )
-        topology = Topology(cluster=view, domains=domains, pods=pods)
-        if enodes_base is not None:
-            # disruption fast path (helpers.simulate_scheduling): the
-            # round's snapshot bundle supplies generation-current
-            # ExistingNode prototypes; forking re-binds them to THIS
-            # solve's topology and fresh mutable state, skipping the O(E)
-            # constructor sweep per confirming simulation
-            existing_nodes = [en.fork(topology) for en in enodes_base]
-        else:
-            existing_nodes = self._existing_nodes(state_nodes, topology)
+        with obs.span("provision.topology"):
+            topology = Topology(cluster=view, domains=domains, pods=pods)
+        with obs.span("provision.existing"):
+            if enodes_base is not None:
+                # disruption fast path (helpers.simulate_scheduling): the
+                # round's snapshot bundle supplies generation-current
+                # ExistingNode prototypes; forking re-binds them to THIS
+                # solve's topology and fresh mutable state, skipping the O(E)
+                # constructor sweep per confirming simulation
+                existing_nodes = [en.fork(topology) for en in enodes_base]
+            else:
+                existing_nodes = self._existing_nodes(state_nodes, topology)
         results = self.solver.solve(
             pods,
             templates,
@@ -302,6 +316,25 @@ class Provisioner:
                 for reason, count in routed.items():
                     if count:
                         ctr.inc(count, reason=reason)
+                # anomaly trigger: a live batch leaving the device path is
+                # the grid-regression signature — keep this round's span
+                # tree (obs flight recorder) so the reason is causal, not
+                # just a counter spike. The CALIBRATED crossovers are
+                # exempt: routing a tiny batch to the host/C++ engine
+                # (small-batch) or having no ready nodepool (no-templates)
+                # is by-design, and flagging them would dump every quiet
+                # production round
+                refused = {
+                    r: n for r, n in routed.items()
+                    if r not in ("small-batch", "no-templates")
+                }
+                total_refused = sum(refused.values())
+                if total_refused:
+                    obs.anomaly(
+                        "host-routed", registry=self.registry,
+                        pods=total_refused,
+                        reasons=",".join(sorted(refused)),
+                    )
         results.truncate_instance_types()
         return results
 

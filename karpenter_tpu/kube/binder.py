@@ -50,23 +50,40 @@ class Binder:
 
     def bind_pending(self) -> int:
         """One binding pass; returns the number of pods progressed."""
-        progressed = 0
-        node_view: dict = {}  # node name -> (Taints, label Requirements)
-        nodes = {n.name: n for n in self.store.list("nodes")}
-        # availability computed once per pass, decremented as pods bind
-        used: dict = {name: {} for name in nodes}
-        for p in self.store.list("pods"):
-            if p.node_name in used and p.metadata.deletion_timestamp is None:
-                used[p.node_name] = resutil.merge(used[p.node_name], p.effective_requests())
-        available = {
-            name: resutil.subtract(nodes[name].allocatable, used[name]) for name in nodes
-        }
-
         pending = [
             p
             for p in self.store.list("pods")
             if not p.node_name and p.metadata.deletion_timestamp is None
         ]
+        if not pending:
+            return 0  # idle tick: no trace, no work
+        # a binding pass is the root of its own reconcile round (obs
+        # flight recorder) — the scheduler stand-in's analog of the
+        # provisioner's solve round
+        from karpenter_tpu import obs
+
+        with obs.round_trace("bind", registry=self.registry,
+                             pending=len(pending)):
+            return self._bind(pending)
+
+    def _bind(self, pending: list) -> int:
+        from karpenter_tpu import obs
+
+        progressed = 0
+        node_view: dict = {}  # node name -> (Taints, label Requirements)
+        with obs.span("bind.availability"):
+            nodes = {n.name: n for n in self.store.list("nodes")}
+            # availability computed once per pass, decremented as pods bind
+            used: dict = {name: {} for name in nodes}
+            for p in self.store.list("pods"):
+                if p.node_name in used and p.metadata.deletion_timestamp is None:
+                    used[p.node_name] = resutil.merge(
+                        used[p.node_name], p.effective_requests())
+            available = {
+                name: resutil.subtract(nodes[name].allocatable, used[name])
+                for name in nodes
+            }
+
         # nominated pods get first crack at their reserved capacity
         pending.sort(key=lambda p: not p.nominated_node_name)
         for pod in pending:

@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from karpenter_tpu import obs
 from karpenter_tpu.api import labels as wk
 from karpenter_tpu.models.inflight import InFlightNodeClaim
 from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
@@ -62,7 +63,11 @@ class HostSolver(Solver):
             volume_topology=volume_topology,
         )
         sched.new_claims = list(initial_claims)
-        return sched.solve(pods)
+        # the host FFD loop is one opaque leaf in the round's span tree:
+        # grid regressions that route pods here show up as this span
+        # dominating the trace (obs flight recorder)
+        with obs.span("solve.host", pods=len(pods)):
+            return sched.solve(pods)
 
 
 
@@ -519,8 +524,14 @@ class TPUSolver(Solver):
         pull = None
         while True:
             t0 = time.perf_counter()
-            host = pull() if pull is not None else self._invoke(
-                args, base_key + (Bp, level_bits, max_minv), Bp)
+            # "solve.kernel" brackets the whole dispatch+materialize pair;
+            # _invoke's children ("solve.dispatch"/"solve.block"/
+            # "solve.native") separate host dispatch cost from the device
+            # wait — a speculative pull() spends its time here as pure
+            # block (the dispatch already happened last iteration)
+            with obs.span("solve.kernel", kind="device", bins=Bp):
+                host = pull() if pull is not None else self._invoke(
+                    args, base_key + (Bp, level_bits, max_minv), Bp)
             if stages is not None:
                 stages["solve_ms"] = stages.get("solve_ms", 0.0) + (
                     time.perf_counter() - t0) * 1000.0
@@ -544,8 +555,10 @@ class TPUSolver(Solver):
             feas = host["F"][:G, :T]
             assign_e = host["assign_e"][:G, :E] if esnap is not None else None
             t0 = time.perf_counter()
-            claims, retry, ecommits = self._decode(
-                snap, esnap, assign, assign_e, used, feas, tmpl, compat_cache)
+            with obs.span("solve.decode"):
+                claims, retry, ecommits = self._decode(
+                    snap, esnap, assign, assign_e, used, feas, tmpl,
+                    compat_cache)
             if stages is not None:
                 stages["decode_ms"] = stages.get("decode_ms", 0.0) + (
                     time.perf_counter() - t0) * 1000.0
@@ -592,7 +605,8 @@ class TPUSolver(Solver):
             if native_ok:
                 try:
                     self._last_engine = "native"
-                    return native.solve_step(args, max_bins)
+                    with obs.span("solve.native", kind="device"):
+                        return native.solve_step(args, max_bins)
                 except Exception:
                     # a real native-engine failure (rc!=0, shape mismatch)
                     # must be visible, not silently eaten by the fallback
@@ -617,11 +631,21 @@ class TPUSolver(Solver):
         if mesh is not None and G * T * K * W >= SHARD_MIN_WORK:
             from karpenter_tpu.parallel import sharded_solve
 
-            out = sharded_solve(mesh, args, max_bins, level_bits=key[-2])
-            return jax.device_get(
-                {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}
-            )
-        flat = np.asarray(self._kernel(key)(args))  # one device->host pull
+            with obs.span("solve.dispatch", kind="device", engine="mesh"):
+                out = sharded_solve(mesh, args, max_bins, level_bits=key[-2])
+            with obs.span("solve.block", kind="device", engine="mesh"):
+                return jax.device_get(
+                    {k: out[k]
+                     for k in ("assign", "assign_e", "used", "tmpl", "F")}
+                )
+        # dispatch vs block bracketed separately: JAX dispatch is async, so
+        # the first span is host-side launch cost (plus any compile) and
+        # the second is the actual device wait — the trace's host/device
+        # attribution hinges on this split
+        with obs.span("solve.dispatch", kind="device"):
+            fut = self._kernel(key)(args)
+        with obs.span("solve.block", kind="device"):
+            flat = np.asarray(fut)  # one device->host pull
         return self._unpack(flat, args, max_bins)
 
     @staticmethod
@@ -666,7 +690,11 @@ class TPUSolver(Solver):
             and not os.environ.get("KARPENTER_PROFILE_DIR")
         ):
             try:
-                fut = self._kernel(key)(args)  # async dispatch, no block
+                # async dispatch, no block: only the host-side launch cost
+                # lands in this span — the wait surfaces later under the
+                # next iteration's "solve.kernel"
+                with obs.span("solve.dispatch_spec", kind="device"):
+                    fut = self._kernel(key)(args)
             except Exception:
                 return lambda: self._invoke(args, key, max_bins)
             return lambda: self._unpack(np.asarray(fut), args, max_bins)

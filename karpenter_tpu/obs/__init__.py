@@ -1,0 +1,49 @@
+"""obs: the reconcile flight recorder (span tracing + anomaly dumps).
+
+Contract (cross-referenced from ops/consolidate.py and ops/tensorize.py):
+
+- ``round_trace(name, registry=...)`` opens one traced reconcile round;
+  ``span(name, kind=...)`` nests timed regions under it (``kind`` in
+  {host, device, cache}); ``anomaly(kind, ...)`` marks the round so the
+  flight recorder dumps its Chrome trace-event JSON. See
+  :mod:`karpenter_tpu.obs.trace` for the full model and env knobs, and
+  :mod:`karpenter_tpu.obs.recorder` for the dump format.
+- Span enter/exit is host-only by construction: graftlint's GL4xx rules
+  (``karpenter_tpu/analysis/tracing.py``) fail the tier-1 gate if a span
+  or anomaly call becomes reachable from jit/pallas-traced code.
+"""
+
+from karpenter_tpu.obs.recorder import FlightRecorder, chrome_events
+from karpenter_tpu.obs.trace import (
+    RECORDER,
+    TRACER,
+    Span,
+    Trace,
+    Tracer,
+    anomaly,
+    attach,
+    configure,
+    current_trace_id,
+    discard_round,
+    reset,
+    round_trace,
+    span,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "chrome_events",
+    "RECORDER",
+    "TRACER",
+    "Span",
+    "Trace",
+    "Tracer",
+    "anomaly",
+    "attach",
+    "configure",
+    "current_trace_id",
+    "discard_round",
+    "reset",
+    "round_trace",
+    "span",
+]

@@ -20,6 +20,26 @@ import time
 LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
 _ALIASES = {"warning": "warn", "err": "error"}
 
+# ambient key=value contributors consulted on every emission — the tracing
+# plane (karpenter_tpu/obs) injects trace=<id> here so a grep for one round's
+# trace id yields its full log slice. Providers are lowest-precedence
+# (with_values context and per-call kv override them) and must be cheap;
+# a raising provider is ignored rather than breaking the record.
+_CONTEXT_PROVIDERS: list = []
+
+
+def add_context_provider(fn) -> None:
+    """Register ``fn() -> dict`` as an ambient context source."""
+    if fn not in _CONTEXT_PROVIDERS:
+        _CONTEXT_PROVIDERS.append(fn)
+
+
+def remove_context_provider(fn) -> None:
+    try:
+        _CONTEXT_PROVIDERS.remove(fn)
+    except ValueError:
+        pass
+
 
 def _resolve_level(level) -> int:
     """Normalize case and common spellings; unknown values fall back to
@@ -71,7 +91,13 @@ class Logger:
             return
         now = self._clock.now() if self._clock is not None else time.time()
         parts = [f"ts={now:.3f}", f"level={level}"]
-        for k, v in {**self._values, **kv}.items():
+        ambient: dict = {}
+        for fn in _CONTEXT_PROVIDERS:
+            try:
+                ambient.update(fn() or {})
+            except Exception:
+                pass  # ambient context must never break a record
+        for k, v in {**ambient, **self._values, **kv}.items():
             parts.append(f"{k}={_fmt_value(v)}")
         parts.append(f'msg="{_escape(msg)}"')
         line = " ".join(parts)

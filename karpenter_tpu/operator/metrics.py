@@ -6,6 +6,12 @@ plays the role of the reference's `metrics.Measure` closure helper, and a
 text exposition dump compatible with the Prometheus format so an operator
 can scrape or snapshot it. No client library dependency — the registry is
 a couple of dicts guarded by a lock, cheap enough to sit on the solve path.
+
+Readers (``value``/``count``/``sum``) and the per-family ``expose`` hold
+the same registry lock the writers do: an unlocked read racing ``inc``
+can observe a half-applied sweep (clear-then-set gauges, a histogram
+whose bucket counts moved but whose ``_sum`` hasn't) — exposition must be
+a consistent snapshot, not a torn one.
 """
 
 from __future__ import annotations
@@ -54,11 +60,14 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(_labels_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_labels_key(labels), 0.0)
 
     def expose(self) -> list:
         out = self._expose_header("counter")
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
 
@@ -78,7 +87,8 @@ class Gauge(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(_labels_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_labels_key(labels), 0.0)
 
     def clear(self):
         """Exporters rebuild the full gauge family each sweep (the
@@ -88,7 +98,9 @@ class Gauge(_Metric):
 
     def expose(self) -> list:
         out = self._expose_header("gauge")
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
 
@@ -112,20 +124,27 @@ class Histogram(_Metric):
             self._total[key] = self._total.get(key, 0) + 1
 
     def count(self, **labels) -> int:
-        return self._total.get(_labels_key(labels), 0)
+        with self._lock:
+            return self._total.get(_labels_key(labels), 0)
 
     def sum(self, **labels) -> float:
-        return self._sum.get(_labels_key(labels), 0.0)
+        with self._lock:
+            return self._sum.get(_labels_key(labels), 0.0)
 
     def expose(self) -> list:
         out = self._expose_header("histogram")
-        for key in sorted(self._total):
+        with self._lock:
+            snapshot = [
+                (key, list(self._counts[key]), self._sum[key], self._total[key])
+                for key in sorted(self._total)
+            ]
+        for key, counts, total_sum, total in snapshot:
             for i, b in enumerate(self.buckets):
                 bkey = key + (("le", str(b)),)
-                out.append(f"{self.name}_bucket{_fmt_labels(bkey)} {self._counts[key][i]}")
-            out.append(f"{self.name}_bucket{_fmt_labels(key + (('le', '+Inf'),))} {self._total[key]}")
-            out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sum[key]}")
-            out.append(f"{self.name}_count{_fmt_labels(key)} {self._total[key]}")
+                out.append(f"{self.name}_bucket{_fmt_labels(bkey)} {counts[i]}")
+            out.append(f"{self.name}_bucket{_fmt_labels(key + (('le', '+Inf'),))} {total}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {total_sum}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {total}")
         return out
 
 
@@ -230,6 +249,13 @@ NODE_TERMINATION_DURATION = f"{NAMESPACE}_nodes_termination_duration_seconds"
 NODECLAIM_TERMINATION_DURATION = (
     f"{NAMESPACE}_nodeclaims_termination_duration_seconds"
 )
+# span-derived families fed by the reconcile flight recorder
+# (karpenter_tpu/obs): per-span self time, round durations, anomaly
+# trigger counts, and trace files written
+TRACE_SPAN_SECONDS = f"{NAMESPACE}_trace_span_self_seconds"
+TRACE_ROUND_SECONDS = f"{NAMESPACE}_trace_round_duration_seconds"
+TRACE_ANOMALIES = f"{NAMESPACE}_trace_anomalies_total"
+TRACE_DUMPS = f"{NAMESPACE}_trace_dumps_total"
 NODES_ALLOCATABLE = f"{NAMESPACE}_nodes_allocatable"
 NODES_TOTAL = f"{NAMESPACE}_nodes_count"
 NODEPOOL_USAGE = f"{NAMESPACE}_nodepool_usage"

@@ -92,7 +92,12 @@ SingleNode candidate probe, and (via ``inputs``) the confirming
 Cache efficacy is scrapeable: ``karpenter_disruption_snapshot_cache_hits/
 misses_total`` count bundle reuse, and the
 ``karpenter_disruption_probe_batch_size`` histogram records how many
-counterfactuals each dispatch ranked.
+counterfactuals each dispatch ranked. The same stages also speak the
+reconcile flight recorder's span protocol (:mod:`karpenter_tpu.obs`):
+snapshot builds/advances open ``cache``-kind spans, probe dispatches open
+``device``-kind spans, and a full rebuild that displaces a held bundle
+marks the round anomalous (``snapshot-rebuild``) so its Chrome trace
+dumps — the causal complement to the counters above.
 """
 
 from __future__ import annotations
@@ -101,6 +106,7 @@ import functools
 
 import numpy as np
 
+from karpenter_tpu import obs
 from karpenter_tpu.ops.tensorize import (
     ExistingSnapshot,
     bucket as _bucket,
@@ -321,6 +327,14 @@ class DisruptionSnapshot:
         tensorized group, topology-compiled plans, nodepool limits (usage
         drifts with node churn), a journal gap, or a churn so large a
         rebuild is cheaper — and the caller re-tensorizes from scratch."""
+        with obs.span("snapshot.advance", kind="cache",
+                      deltas=len(deltas)) as sp:
+            ok = self._advance(cluster, store, deltas, generation, registry)
+            if sp is not None:
+                sp.attrs["applied"] = ok
+            return ok
+
+    def _advance(self, cluster, store, deltas, generation, registry) -> bool:
         from karpenter_tpu.utils import pod as pod_util
 
         if self.plan is not None or self.topology is None:
@@ -546,23 +560,27 @@ class DisruptionSnapshot:
         rows = g_count_k.shape[0]
         placed_g = np.empty((rows, Gp), dtype=np.int64)
         used = np.empty(rows, dtype=np.int64)
-        for lo in range(0, rows, PROBE_CHUNK_ROWS):
-            hi = min(lo + PROBE_CHUNK_ROWS, rows)
-            n = hi - lo
-            Np = _pow2(n, lo=4)
-            e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
-            for i in range(n):
-                cols = e_zero_cols[lo + i]
-                if cols is not None and len(cols):
-                    e_chunk[i, cols, :] = 0.0
-            varying = dict(
-                g_count=pad(g_count_k[lo:hi], (Np, Gp)),
-                e_avail=pad(e_chunk, (Np, Ep, R)),
-            )
-            out_placed, out_used = _batched_kernel(1, self.max_minv)(
-                varying, shared)
-            placed_g[lo:hi] = np.asarray(out_placed)[:n]
-            used[lo:hi] = np.asarray(out_used)[:n]
+        with obs.span("probe.dispatch", rows=rows, engine="device"):
+            for lo in range(0, rows, PROBE_CHUNK_ROWS):
+                hi = min(lo + PROBE_CHUNK_ROWS, rows)
+                n = hi - lo
+                Np = _pow2(n, lo=4)
+                e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
+                for i in range(n):
+                    cols = e_zero_cols[lo + i]
+                    if cols is not None and len(cols):
+                        e_chunk[i, cols, :] = 0.0
+                varying = dict(
+                    g_count=pad(g_count_k[lo:hi], (Np, Gp)),
+                    e_avail=pad(e_chunk, (Np, Ep, R)),
+                )
+                # dispatch + host pull in one device-kind leaf: the probe
+                # kernel is synchronous-by-consumption (np.asarray blocks)
+                with obs.span("probe.kernel", kind="device", rows=n):
+                    out_placed, out_used = _batched_kernel(1, self.max_minv)(
+                        varying, shared)
+                    placed_g[lo:hi] = np.asarray(out_placed)[:n]
+                    used[lo:hi] = np.asarray(out_used)[:n]
         return placed_g, used
 
     def _native_routable(self) -> bool:
@@ -601,22 +619,26 @@ class DisruptionSnapshot:
         rows = g_count_k.shape[0]
         placed_g = np.empty((rows, Gp), dtype=np.int64)
         used = np.empty(rows, dtype=np.int64)
-        for lo in range(0, rows, PROBE_CHUNK_ROWS):
-            hi = min(lo + PROBE_CHUNK_ROWS, rows)
-            n = hi - lo
-            e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
-            for i in range(n):
-                cols = e_zero_cols[lo + i]
-                if cols is not None and len(cols):
-                    e_chunk[i, cols, :] = 0.0
-            pg, u = native.solve_probe_batch(
-                shared,
-                pad(np.asarray(g_count_k[lo:hi], dtype=np.int32), (n, Gp)),
-                pad(e_chunk.astype(np.float32, copy=False), (n, Ep, R)),
-                1,
-            )
-            placed_g[lo:hi] = pg
-            used[lo:hi] = u
+        with obs.span("probe.dispatch", rows=rows, engine="native"):
+            for lo in range(0, rows, PROBE_CHUNK_ROWS):
+                hi = min(lo + PROBE_CHUNK_ROWS, rows)
+                n = hi - lo
+                e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
+                for i in range(n):
+                    cols = e_zero_cols[lo + i]
+                    if cols is not None and len(cols):
+                        e_chunk[i, cols, :] = 0.0
+                with obs.span("probe.native", kind="device", rows=n):
+                    pg, u = native.solve_probe_batch(
+                        shared,
+                        pad(np.asarray(g_count_k[lo:hi], dtype=np.int32),
+                            (n, Gp)),
+                        pad(e_chunk.astype(np.float32, copy=False),
+                            (n, Ep, R)),
+                        1,
+                    )
+                placed_g[lo:hi] = pg
+                used[lo:hi] = u
         return placed_g, used
 
 
@@ -624,6 +646,13 @@ def build_disruption_snapshot(provisioner, cluster, store, candidates):
     """Assemble the shared tensor bundle for one disruption round. Returns
     None when the device path cannot express the scenario at all (the
     probes then fall back to the sequential search)."""
+    with obs.span("snapshot.build", kind="cache",
+                  candidates=len(candidates)):
+        return _build_disruption_snapshot(
+            provisioner, cluster, store, candidates)
+
+
+def _build_disruption_snapshot(provisioner, cluster, store, candidates):
     try:
         import jax  # noqa: F401
     except Exception:
@@ -779,6 +808,14 @@ class SnapshotCache:
                 "disruption snapshot rebuilds (generation bump or wider "
                 "candidate set)",
             ).inc()
+        if self._bundle is not None:
+            # anomaly trigger: a held bundle is being displaced by a full
+            # tensorization — the delta layer declined (opaque journal
+            # entry, inexpressible churn) or the candidate key widened.
+            # The round's trace shows which; the first-ever build of a
+            # process is NOT an anomaly (there was nothing to advance).
+            obs.anomaly("snapshot-rebuild", registry=registry,
+                        generation=generation)
         b = build_disruption_snapshot(provisioner, cluster, store, candidates)
         if b is not None:
             self._bundle = b

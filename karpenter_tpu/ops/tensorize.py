@@ -50,7 +50,12 @@ emits alongside every ``consolidation_state`` generation bump:
   ``karpenter_tensorize_negative_avail_total`` counter records every
   negative availability the build clamps to zero (a node whose bound pods
   exceed its allocatable is a capacity-accounting bug that must surface,
-  not vanish into ``max(v, 0.0)``).
+  not vanish into ``max(v, 0.0)``). Every build/delta additionally opens
+  a ``cache``-kind span on the reconcile flight recorder
+  (:mod:`karpenter_tpu.obs`), and a negative-avail clamp marks the
+  current round anomalous — its full span tree dumps as Chrome trace
+  JSON, so the round that tensorized the bad state is on disk, not just
+  counted.
 
 Group-row cache contract
 ------------------------
@@ -98,6 +103,7 @@ from karpenter_tpu.scheduling import (
     Taints,
     pod_requirements,
 )
+from karpenter_tpu import obs
 from karpenter_tpu.utils import resources as resutil
 
 WORD = 32
@@ -331,7 +337,15 @@ class ExistingSnapshot:
         (ops/consolidate.py advance) must route such nodes through
         ``added`` or rebuild."""
         dirty = list(dirty)
+        removed = list(removed)
         added = list(added)
+        with obs.span("tensorize.delta", kind="cache", dirty=len(dirty),
+                      removed=len(removed), added=len(added)):
+            return self._apply_delta(snap, dirty, removed, added,
+                                     device_plan, registry)
+
+    def _apply_delta(self, snap, dirty, removed, added, device_plan,
+                     registry):
         if dirty or added:
             mini = tensorize_existing(snap, dirty + added, device_plan,
                                       registry=registry)
@@ -385,6 +399,13 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None,
     per-node counts come from each TopologyGroup's hostname domain map.
     `registry` (optional, defaults to the process registry) receives the
     negative-availability counter."""
+    with obs.span("tensorize.existing", kind="cache",
+                  nodes=len(existing_nodes)):
+        return _tensorize_existing(snap, existing_nodes, device_plan,
+                                   registry)
+
+
+def _tensorize_existing(snap, existing_nodes, device_plan, registry):
     import time
 
     from karpenter_tpu.api import labels as wk
@@ -492,6 +513,11 @@ def tensorize_existing(snap: DeviceSnapshot, existing_nodes, device_plan=None,
             "tensorization (capacity-accounting bug upstream)",
         ).inc(negative)
         name, res, v = neg_example
+        # anomaly trigger: a clamp means capacity accounting went wrong
+        # UPSTREAM of this build — the flight recorder keeps the round
+        # that tensorized the bad state (obs module contract)
+        obs.anomaly("negative-avail", registry=registry, count=negative,
+                    node=name, resource=res)
         logging.getLogger(__name__).warning(
             "tensorize_existing clamped %d negative availabilities this "
             "round (first: node %s %s=%s)", negative, name, res, v)
@@ -921,6 +947,14 @@ def tensorize(
         with extra requirements / bin caps / conflict classes), groups
         already in the order the scan should process them
     """
+    with obs.span("tensorize.build", kind="cache",
+                  plan=device_plan is not None):
+        return _tensorize(pods, templates, instance_types_by_pool,
+                          daemon_overhead, limits, device_plan)
+
+
+def _tensorize(pods, templates, instance_types_by_pool, daemon_overhead,
+               limits, device_plan):
     daemon_overhead = daemon_overhead or {}
     limits = limits or {}
 

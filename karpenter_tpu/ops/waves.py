@@ -810,4 +810,11 @@ def compile_topology(groups: list, topology, vectorized: bool | None = None) -> 
             "KARPENTER_WAVES_SEQUENTIAL", ""
         ).strip().lower() not in ("1", "true", "yes", "on")
     cls = _VecCompiler if vectorized else _Compiler
-    return cls(groups, topology).run()
+    # the sequential-oracle path is one of the slow edges the flight
+    # recorder exists to attribute: the span's `vectorized` attr says
+    # which compiler carried this round (karpenter_tpu/obs)
+    from karpenter_tpu import obs
+
+    with obs.span("waves.compile", groups=len(groups),
+                  vectorized=bool(vectorized)):
+        return cls(groups, topology).run()

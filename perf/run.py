@@ -8,6 +8,10 @@ Usage:
     python -m perf --json 4        # + per-layer consolidation breakdown
                                    # (tensorize_existing_ms, confirm_ladder_ms,
                                    # host_confirm_count, snapshot_delta)
+                                   # --json additionally embeds each row's
+                                   # trace summary (top-5 self-time spans +
+                                   # Chrome trace dump path, obs flight
+                                   # recorder) on every config/grid point
     python -m perf grid            # the reference {1..5000}x400 grid
                                    # (scheduling_benchmark_test.go:77-97)
 
@@ -63,12 +67,27 @@ import os
 ORACLE_POD_CAP = int(os.environ.get("PERF_ORACLE_CAP", "20000"))
 
 
-def run_solve_config(name, pods, pools, catalog, **solver_kw):
+def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
     from karpenter_tpu.models import HostSolver, TPUSolver
 
     solver = TPUSolver()
     _solve_timed(solver, pods, pools, catalog, **solver_kw)  # warm compile + caches
-    res, elapsed = _solve_timed(solver, pods, pools, catalog, **solver_kw)
+    trace_out = None
+    if trace:
+        # the timed solve runs as one traced round: the row embeds the
+        # top-5 self-time spans + the on-demand Chrome trace dump path
+        from karpenter_tpu import obs
+
+        with obs.round_trace(f"perf-{name}") as tr:
+            res, elapsed = _solve_timed(solver, pods, pools, catalog,
+                                        **solver_kw)
+        if tr is not None:
+            trace_out = {
+                "top_spans": tr.summary(top=5),
+                "file": obs.RECORDER.dump(tr),
+            }
+    else:
+        res, elapsed = _solve_timed(solver, pods, pools, catalog, **solver_kw)
     nodes = res.node_count()
     pps = len(pods) / elapsed
     # per-stage attribution of the timed solve (mirrors the PR-3
@@ -96,6 +115,8 @@ def run_solve_config(name, pods, pools, catalog, **solver_kw):
         "host_routed": stats.get("host_routed") or {},
         "breakdown": breakdown,
     }
+    if trace_out is not None:
+        out["trace"] = trace_out
     if len(pods) <= ORACLE_POD_CAP or os.environ.get("PERF_FULL_ORACLE"):
         ffd, ffd_elapsed = _solve_timed(HostSolver(), pods, pools, catalog)
         ffd_nodes = ffd.node_count()
@@ -143,6 +164,18 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
         confirm_hist = env.registry.histogram(m.DISRUPTION_CONFIRM_DURATION)
         confirms = env.registry.counter(m.DISRUPTION_HOST_CONFIRMS)
         hits = env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_HITS)
+        # the last disruption round's span story (obs flight recorder):
+        # which stages carried the wall clock, plus an on-demand Chrome
+        # trace dump of that round
+        from karpenter_tpu import obs
+
+        tr = obs.RECORDER.last("disrupt")
+        if tr is not None:
+            out_extra["trace"] = {
+                "top_spans": tr.summary(top=5),
+                "leaf_coverage": round(tr.leaf_coverage(), 4),
+                "file": obs.RECORDER.dump(tr),
+            }
         out_extra["breakdown"] = {
             "tensorize_existing_ms": round(
                 _tz.STATS["existing_ms"] - stats0["existing_ms"], 2),
@@ -199,7 +232,7 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
     }))
 
 
-def run_grid(min_values: int | None = None):
+def run_grid(min_values: int | None = None, trace: bool = False):
     """The reference benchmark grid: pods x 400 types, diverse 1/6 mix
     (scheduling_benchmark_test.go:77-97, :234-248); its enforced floor is
     100 pods/sec on batches over 100 pods. `min_values` re-runs the grid
@@ -222,7 +255,8 @@ def run_grid(min_values: int | None = None):
         # the solver estimates the bin axis per shape (anti-class lower
         # bound included); buckets keep the compile count small and the
         # warm-up solve pays it
-        run_solve_config(f"{prefix}-{n}", C.diverse_pods(n), pools, catalog)
+        run_solve_config(f"{prefix}-{n}", C.diverse_pods(n), pools, catalog,
+                         trace=trace)
 
 
 def main():
@@ -233,22 +267,26 @@ def main():
     breakdown = "--json" in args
     args = [a for a in args if a != "--json"]
     if args == ["grid"]:
-        run_grid()
+        run_grid(trace=breakdown)
         return
     if args == ["grid-mv"]:
-        run_grid(min_values=50)
+        run_grid(min_values=50, trace=breakdown)
         return
     picks = {int(a) for a in args} if args else {1, 2, 3, 4, 5}
     if 1 in picks:
-        run_solve_config("1-homogeneous-1k", *C.config1_homogeneous())
+        run_solve_config("1-homogeneous-1k", *C.config1_homogeneous(),
+                         trace=breakdown)
     if 2 in picks:
-        run_solve_config("2-selectors-taints-10k", *C.config2_selectors_taints())
+        run_solve_config("2-selectors-taints-10k",
+                         *C.config2_selectors_taints(), trace=breakdown)
     if 3 in picks:
-        run_solve_config("3-antiaffinity-spread-5k", *C.config3_antiaffinity_spread())
+        run_solve_config("3-antiaffinity-spread-5k",
+                         *C.config3_antiaffinity_spread(), trace=breakdown)
     if 4 in picks:
         run_consolidation_config(breakdown=breakdown)
     if 5 in picks:
-        run_solve_config("5-burst-gpu-50k", *C.config5_burst_gpu())
+        run_solve_config("5-burst-gpu-50k", *C.config5_burst_gpu(),
+                         trace=breakdown)
 
 
 if __name__ == "__main__":

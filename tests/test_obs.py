@@ -1,0 +1,594 @@
+"""The reconcile flight recorder (karpenter_tpu/obs): span-tree structure,
+Chrome trace-event dump validity, ring-buffer eviction order, the full
+anomaly-trigger matrix (each trigger → exactly one dump per round), the
+metrics/logging integration, and the two slow acceptance checks — ≥95%
+leaf-span attribution on a 300-node consolidation round and ≤2% tracer
+overhead on grid-1000.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.operator import metrics as m
+from karpenter_tpu.operator.metrics import Registry
+
+
+@pytest.fixture
+def rec(tmp_path):
+    """Isolated tracer/recorder state pointed at a fresh dump dir."""
+    obs.configure(enabled=True, dump_dir=str(tmp_path), capacity=8,
+                  dump_all=False)
+    obs.RECORDER.clear()
+    yield tmp_path
+    obs.reset()
+
+
+def dumps_in(tmp_path) -> list:
+    return sorted(p for p in os.listdir(tmp_path) if p.endswith(".trace.json"))
+
+
+# ---------------------------------------------------------------------------
+# span-tree structure
+# ---------------------------------------------------------------------------
+
+class TestTraceStructure:
+    def test_nesting_parent_links_and_self_time(self, rec):
+        with obs.round_trace("r") as tr:
+            with obs.span("a"):
+                with obs.span("a.1", kind="device"):
+                    pass
+                with obs.span("a.2", kind="cache"):
+                    pass
+            with obs.span("b"):
+                pass
+        root = tr.root
+        assert [c.name for c in root.children] == ["a", "b"]
+        a = root.children[0]
+        assert [c.name for c in a.children] == ["a.1", "a.2"]
+        assert a.children[0].kind == "device"
+        # every span closed with a duration; parents cover their children
+        for sp in tr.spans():
+            assert sp.dur is not None and sp.dur >= 0.0
+        assert a.dur >= sum(c.dur for c in a.children)
+        assert a.self_seconds() <= a.dur
+        # aggregate self time over the tree equals the root duration
+        total_self = sum(v[0] for v in tr.self_times().values())
+        assert total_self == pytest.approx(root.dur, rel=1e-6)
+
+    def test_span_without_round_is_noop(self, rec):
+        with obs.span("orphan") as sp:
+            assert sp is None
+        assert obs.RECORDER.traces() == []
+
+    def test_nested_round_degrades_to_span(self, rec):
+        with obs.round_trace("outer") as tr:
+            with obs.round_trace("inner"):
+                pass
+        assert [c.name for c in tr.root.children] == ["inner"]
+        assert [t.name for t in obs.RECORDER.traces()] == ["outer"]
+
+    def test_disabled_tracer_is_inert(self, rec):
+        obs.configure(enabled=False)
+        with obs.round_trace("r") as tr:
+            assert tr is None
+            with obs.span("x") as sp:
+                assert sp is None
+        assert obs.RECORDER.traces() == []
+
+    def test_worker_thread_attaches(self, rec):
+        with obs.round_trace("r") as tr:
+            def work():
+                with obs.attach(tr):
+                    with obs.span("worker.step"):
+                        pass
+
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+        assert "worker.step" in {c.name for c in tr.root.children}
+
+    def test_exception_closes_span_and_round(self, rec):
+        with pytest.raises(ValueError):
+            with obs.round_trace("r"):
+                with obs.span("boom"):
+                    raise ValueError("x")
+        tr = obs.RECORDER.last("r")
+        assert tr is not None
+        assert tr.root.children[0].dur is not None
+        assert tr.root.children[0].attrs["error"] == "ValueError"
+
+    def test_span_cap_degrades_not_grows(self, rec, monkeypatch):
+        monkeypatch.setattr(obs.trace if hasattr(obs, "trace") else obs,
+                            "MAX_SPANS_PER_TRACE", 8, raising=False)
+        from karpenter_tpu.obs import trace as trace_mod
+
+        monkeypatch.setattr(trace_mod, "MAX_SPANS_PER_TRACE", 8)
+        with obs.round_trace("r") as tr:
+            for _ in range(20):
+                with obs.span("s"):
+                    pass
+        assert len(tr.spans()) <= 8
+        assert tr.dropped > 0
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event dump validity
+# ---------------------------------------------------------------------------
+
+class TestChromeDump:
+    def _trace(self):
+        with obs.round_trace("r", registry=Registry()) as tr:
+            with obs.span("stage", kind="cache", rows=3):
+                with obs.span("kernel", kind="device"):
+                    pass
+            obs.anomaly("probe-fallback", method="multi")
+        return tr
+
+    def test_dump_is_valid_trace_event_json(self, rec):
+        tr = self._trace()
+        assert tr.dump_path is not None  # anomaly → dumped at round close
+        with open(tr.dump_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events
+        names = [e["name"] for e in events]
+        assert names[0] == "r"  # root first (pre-order)
+        assert "anomaly:probe-fallback" in names
+        for e in events:
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+            assert e["ph"] in ("X", "i")
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+            if e["ph"] == "X":
+                assert e["dur"] >= 0
+            else:
+                assert e["s"] == "g"
+        by_name = {e["name"]: e for e in events}
+        assert by_name["kernel"]["cat"] == "device"
+        assert by_name["stage"]["args"]["rows"] == 3
+        assert doc["otherData"]["anomalies"] == ["probe-fallback"]
+        assert doc["otherData"]["round"] == "r"
+
+    def test_dump_is_idempotent_per_trace(self, rec):
+        tr = self._trace()
+        p1 = tr.dump_path
+        p2 = obs.RECORDER.dump(tr)
+        assert p1 == p2
+        assert len(dumps_in(rec)) == 1
+
+    def test_non_jsonable_attrs_are_stringified(self, rec):
+        with obs.round_trace("r") as tr:
+            with obs.span("s", obj=object()):
+                pass
+            obs.anomaly("negative-avail")
+        doc = json.load(open(tr.dump_path, encoding="utf-8"))
+        arg = [e for e in doc["traceEvents"] if e["name"] == "s"][0]["args"]["obj"]
+        assert isinstance(arg, str)
+
+
+# ---------------------------------------------------------------------------
+# ring buffer
+# ---------------------------------------------------------------------------
+
+class TestRingBuffer:
+    def _round(self, name):
+        with obs.round_trace(name):
+            with obs.span("x"):
+                pass
+
+    def test_eviction_is_oldest_first(self, rec):
+        obs.configure(capacity=3)
+        for i in range(5):
+            self._round(f"r{i}")
+        assert [t.name for t in obs.RECORDER.traces()] == ["r2", "r3", "r4"]
+        assert obs.RECORDER.last().name == "r4"
+        assert obs.RECORDER.last("r3").name == "r3"
+
+    def test_idle_rounds_do_not_churn_the_ring(self, rec):
+        """A round with no child spans and no anomaly carries no story —
+        it must not evict real rounds."""
+        obs.configure(capacity=2)
+        self._round("real")
+        for _ in range(10):
+            with obs.round_trace("idle"):
+                pass
+        assert "real" in [t.name for t in obs.RECORDER.traces()]
+
+    def test_reconfigure_capacity_keeps_most_recent(self, rec):
+        for i in range(5):
+            self._round(f"r{i}")
+        obs.configure(capacity=2)
+        assert [t.name for t in obs.RECORDER.traces()] == ["r3", "r4"]
+
+    def test_discarded_round_skips_ring_and_histograms(self, rec):
+        registry = Registry()
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("disrupt.candidates"):
+                pass
+            obs.discard_round()
+        assert obs.RECORDER.traces() == []
+        assert registry.histogram(m.TRACE_ROUND_SECONDS).count(
+            round="disrupt") == 0
+
+    def test_anomaly_overrides_discard(self, rec):
+        with obs.round_trace("disrupt"):
+            with obs.span("x"):
+                pass
+            obs.discard_round()
+            obs.anomaly("negative-avail")
+        assert [t.name for t in obs.RECORDER.traces()] == ["disrupt"]
+        assert len(dumps_in(rec)) == 1
+
+    def test_candidate_free_disruption_ticks_are_discarded(self, rec):
+        """A quiet cluster's poll loop must not churn the ring: ticks that
+        find no disruptable candidate opt out (controller._compute_round)."""
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+
+        env = Environment(
+            instance_types=[make_instance_type("small", 2, 8)],
+            enable_disruption=True,
+        )
+        env.run_until_idle()
+        obs.RECORDER.clear()
+        for _ in range(5):
+            env.clock.step(20.0)
+            env.disruption.poll()
+        assert [t for t in obs.RECORDER.traces() if t.name == "disrupt"] == []
+
+
+# ---------------------------------------------------------------------------
+# anomaly-trigger matrix: each trigger produces exactly ONE dump per round
+# ---------------------------------------------------------------------------
+
+class TestAnomalyMatrix:
+    def test_one_dump_per_anomalous_round(self, rec):
+        with obs.round_trace("r"):
+            with obs.span("x"):
+                pass
+            obs.anomaly("host-routed", pods=2)
+        assert len(dumps_in(rec)) == 1
+
+    def test_multiple_anomalies_still_one_dump(self, rec):
+        with obs.round_trace("r"):
+            with obs.span("x"):
+                pass
+            obs.anomaly("host-routed")
+            obs.anomaly("negative-avail")
+            obs.anomaly("snapshot-rebuild")
+        assert len(dumps_in(rec)) == 1
+        tr = obs.RECORDER.last()
+        assert [k for k, _, _ in tr.anomalies] == [
+            "host-routed", "negative-avail", "snapshot-rebuild"]
+
+    def test_clean_round_produces_no_dump(self, rec):
+        with obs.round_trace("r"):
+            with obs.span("x"):
+                pass
+        assert dumps_in(rec) == []
+
+    # -- the five wired triggers, each driven through its real code path --
+
+    def test_probe_fallback_trigger(self, rec):
+        """A raising device probe marks the round and dumps once
+        (methods._device_probe's except path)."""
+        from karpenter_tpu.controllers.disruption.methods import _device_probe
+        from karpenter_tpu.models.solver import TPUSolver
+
+        class Ctx:
+            provisioner = type("P", (), {"solver": TPUSolver()})()
+            cluster = store = None
+            registry = Registry()
+            snapshot_cache = None
+
+        def bad_probe(*a, **kw):
+            raise RuntimeError("seeded disagreement")
+
+        with obs.round_trace("disrupt", registry=Ctx.registry):
+            out = _device_probe(Ctx, bad_probe, "multi", [], None)
+        assert out is None
+        assert len(dumps_in(rec)) == 1
+        assert Ctx.registry.counter(m.TRACE_ANOMALIES).value(
+            kind="probe-fallback") == 1
+
+    def test_multi_host_confirms_trigger(self, rec):
+        """>1 confirming simulation in one MultiNode round marks it."""
+        from karpenter_tpu.controllers.disruption.methods import (
+            MultiNodeConsolidation,
+        )
+
+        registry = Registry()
+        ctx = type("Ctx", (), {"registry": registry})()
+        meth = MultiNodeConsolidation(ctx)
+
+        def fake_compute(candidates, budgets):
+            meth.last_host_confirms = 3
+            meth.last_probe = "device"
+            return None
+
+        meth._compute = fake_compute
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("ladder"):
+                meth.compute_command([], {})
+        assert len(dumps_in(rec)) == 1
+        assert registry.counter(m.TRACE_ANOMALIES).value(
+            kind="multi-host-confirms") == 1
+
+    def test_single_confirm_is_not_anomalous(self, rec):
+        from karpenter_tpu.controllers.disruption.methods import (
+            MultiNodeConsolidation,
+        )
+
+        registry = Registry()
+        meth = MultiNodeConsolidation(type("Ctx", (), {"registry": registry})())
+
+        def fake_compute(candidates, budgets):
+            meth.last_host_confirms = 1
+            return None
+
+        meth._compute = fake_compute
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("ladder"):
+                meth.compute_command([], {})
+        assert dumps_in(rec) == []
+
+    def test_stale_confirm_count_does_not_refire(self, rec):
+        """A quiet round following a busy one must not inherit the busy
+        round's confirm count (compute_command resets before searching —
+        an early-return inside the search cannot skip the reset)."""
+        from karpenter_tpu.controllers.disruption.methods import (
+            MultiNodeConsolidation,
+        )
+
+        registry = Registry()
+        meth = MultiNodeConsolidation(type("Ctx", (), {"registry": registry})())
+        # busy round: 3 confirms → one anomaly dump
+        meth.last_host_confirms = 3  # as if left over from a prior search
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("ladder"):
+                # the REAL _compute early-returns on <2 candidates without
+                # ever touching the counter — the reset must already have
+                # happened
+                meth.compute_command([], {})
+        assert dumps_in(rec) == []
+        assert meth.last_host_confirms == 0
+
+    def test_snapshot_rebuild_trigger(self, rec, monkeypatch):
+        """A held bundle displaced by a full rebuild marks the round; the
+        first-ever build does not."""
+        from karpenter_tpu.ops import consolidate as cons
+
+        registry = Registry()
+        built = []
+
+        def fake_build(provisioner, cluster, store, candidates):
+            built.append(1)
+            return type("B", (), {
+                "generation": cluster.consolidation_state(),
+                "build_key": frozenset(c.provider_id for c in candidates),
+            })()
+
+        monkeypatch.setattr(cons, "build_disruption_snapshot", fake_build)
+
+        class FakeCluster:
+            def __init__(self):
+                self.gen = 1
+
+            def consolidation_state(self):
+                return self.gen
+
+            def deltas_since(self, g):
+                return None  # journal gap: delta-advance must decline
+
+        cluster = FakeCluster()
+        cand = type("C", (), {"provider_id": "p-1"})()
+        cache = cons.SnapshotCache()
+        # first build: NOT an anomaly (nothing to advance from)
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("probe"):
+                cache.get(None, cluster, None, [cand], registry=registry)
+        assert dumps_in(rec) == []
+        # generation bump + inexpressible journal → full rebuild → anomaly
+        cluster.gen = 2
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("probe"):
+                cache.get(None, cluster, None, [cand], registry=registry)
+        assert len(built) == 2
+        assert len(dumps_in(rec)) == 1
+        assert registry.counter(m.TRACE_ANOMALIES).value(
+            kind="snapshot-rebuild") == 1
+
+    def test_negative_avail_trigger(self, rec):
+        """tensorize_existing clamping a negative availability marks the
+        enclosing round (the PR-3 counter's causal complement)."""
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+        from karpenter_tpu.models import ClaimTemplate
+        from karpenter_tpu.ops.tensorize import tensorize, tensorize_existing
+
+        GIB = 2 ** 30
+        registry = Registry()
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        tpl = ClaimTemplate(pool)
+        its = {"default": [make_instance_type("small", 2, 8)]}
+        pods = [Pod(metadata=ObjectMeta(name="p0"),
+                    requests={"cpu": 1.0, "memory": GIB})]
+        snap = tensorize(pods, [tpl], its)
+
+        class FakeState:
+            provider_id = "pid-0"
+            name = hostname = "n0"
+            pods = {}
+
+            def taints(self):
+                return []
+
+        class FakeNode:
+            state_node = FakeState()
+            # bound-pod total exceeds allocatable: cpu goes negative
+            cached_available = {"cpu": 1.0, "memory": GIB}
+            requests = {"cpu": 2.0}
+
+            from karpenter_tpu.scheduling import Requirements
+            requirements = Requirements()
+
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("snapshot"):
+                tensorize_existing(snap, [FakeNode()], registry=registry)
+        assert len(dumps_in(rec)) == 1
+        assert registry.counter(m.TRACE_ANOMALIES).value(
+            kind="negative-avail") == 1
+
+    def test_host_routed_trigger_end_to_end(self, rec):
+        """A live provisioning batch whose pods route to the host engine
+        dumps its round: real Environment, real TPUSolver, a pod whose
+        spec (host ports) the device path cannot express."""
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+        from karpenter_tpu.operator import Environment
+
+        GIB = 2 ** 30
+        env = Environment(instance_types=[make_instance_type("small", 2, 8)])
+        env.store.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        env.store.create("pods", Pod(
+            metadata=ObjectMeta(name="webserver"),
+            requests={"cpu": 0.5, "memory": GIB},
+            host_ports=[("0.0.0.0", 80, "TCP")],
+        ))
+        env.run_until_idle()
+        files = [f for f in dumps_in(rec) if f.startswith("provision-")]
+        assert len(files) == 1
+        assert env.registry.counter(m.TRACE_ANOMALIES).value(
+            kind="host-routed") == 1
+        assert env.registry.counter(m.PROVISIONING_HOST_ROUTED).value(
+            reason="ineligible-spec") == 1
+
+
+# ---------------------------------------------------------------------------
+# metrics + logging integration
+# ---------------------------------------------------------------------------
+
+class TestIntegration:
+    def test_span_histograms_feed_registry(self, rec):
+        registry = Registry()
+        with obs.round_trace("provision", registry=registry):
+            with obs.span("solve.kernel", kind="device"):
+                pass
+            with obs.span("solve.decode"):
+                pass
+        h = registry.histogram(m.TRACE_SPAN_SECONDS)
+        assert h.count(span="solve.kernel", kind="device") == 1
+        assert h.count(span="solve.decode", kind="host") == 1
+        assert registry.histogram(m.TRACE_ROUND_SECONDS).count(
+            round="provision") == 1
+
+    def test_dump_counter(self, rec):
+        registry = Registry()
+        with obs.round_trace("disrupt", registry=registry):
+            with obs.span("x"):
+                pass
+            obs.anomaly("probe-fallback")
+        assert registry.counter(m.TRACE_DUMPS).value(round="disrupt") == 1
+
+    def test_trace_id_threads_into_logging(self, rec):
+        from karpenter_tpu.operator.logging import Logger
+
+        lines = []
+        log = Logger(sink=lines.append)
+        with obs.round_trace("disrupt") as tr:
+            log.info("inside")
+        log.info("outside")
+        assert f"trace={tr.trace_id}" in lines[0]
+        assert "trace=" not in lines[1]
+
+    def test_disrupt_round_traced_through_controller(self, rec):
+        """A real disruption poll opens one 'disrupt' round whose children
+        cover the ladder stages."""
+        from perf import configs as C
+
+        env = C.config4_consolidation_env(n_nodes=4)
+        env.disruption.poll_period = 0.0
+        env.clock.step(20.0)
+        env.disruption.poll()
+        tr = obs.RECORDER.last("disrupt")
+        assert tr is not None
+        names = {sp.name for sp in tr.spans()}
+        assert "disrupt.candidates" in names
+        assert "disrupt.budgets" in names
+        # the consolidation ladder ran at least one method span
+        assert any(n.startswith("method.") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# acceptance (slow): attribution coverage + tracer overhead
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestAcceptanceSlow:
+    def test_300_node_round_leaf_attribution(self, rec):
+        """≥95% of a 300-node consolidation round's wall clock lands in
+        spans below the root (the ISSUE-5 acceptance criterion)."""
+        from perf import configs as C
+
+        env = C.config4_consolidation_env(n_nodes=300)
+        env.disruption.poll_period = 0.0
+        for _ in range(3):
+            env.clock.step(20.0)
+            env.run_until_idle(max_rounds=50)
+        rounds = [t for t in obs.RECORDER.traces() if t.name == "disrupt"]
+        assert rounds, "no disruption round was traced"
+        longest = max(rounds, key=lambda t: t.root.dur or 0.0)
+        # ignore sub-millisecond rounds: attribution of a no-op poll is
+        # all fixed overhead and proves nothing
+        assert longest.root.dur > 0.05
+        assert longest.leaf_coverage() >= 0.95, (
+            f"coverage {longest.leaf_coverage():.3f}; "
+            f"top self-time: {longest.summary(top=8)}"
+        )
+
+    def test_tracer_overhead_grid_1000(self, rec):
+        """Tracer-enabled grid-1000 stays within 2% of tracer-off wall
+        clock (plus a 20ms absolute allowance for this noisy 2-vCPU box —
+        the tracer's real per-solve cost is tens of microseconds).
+        Off/on samples INTERLEAVE and each side takes its minimum, so a
+        load spike hitting one contiguous sampling window (the flake mode
+        of sequential medians under suite load) cannot bias the ratio."""
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import TPUSolver
+        from perf import configs as C
+        from perf.run import _solve_timed
+
+        catalog = benchmark_catalog(400)
+        pools = [NodePool(metadata=ObjectMeta(name="default"))]
+        pods = C.diverse_pods(1000)
+        solver = TPUSolver()
+        _solve_timed(solver, pods, pools, catalog)  # warm compiles + caches
+
+        def one(traced: bool) -> float:
+            obs.configure(enabled=traced)
+            if traced:
+                with obs.round_trace("bench"):
+                    _, el = _solve_timed(solver, pods, pools, catalog)
+            else:
+                _, el = _solve_timed(solver, pods, pools, catalog)
+            return el * 1000.0
+
+        off_samples, on_samples = [], []
+        for _ in range(7):
+            off_samples.append(one(False))
+            on_samples.append(one(True))
+        off, on = min(off_samples), min(on_samples)
+        assert on <= off * 1.02 + 20.0, (
+            f"tracer overhead too high: on={on:.1f}ms off={off:.1f}ms "
+            f"(on samples {on_samples}, off samples {off_samples})"
+        )

@@ -534,6 +534,138 @@ class TestDriftRules:
 
 
 # ---------------------------------------------------------------------------
+# GL4xx observability safety (the obs flight recorder off the traced path)
+# ---------------------------------------------------------------------------
+
+class TestObsRules:
+    def test_positive_span_in_jitted_function(self):
+        """A span context manager inside a jitted body is flagged — any
+        spelling: module helper, tracer attribute, bare import."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu import obs\n"
+            "\n"
+            "def kernel(x):\n"
+            "    with obs.span('solve.step', kind='device'):\n"
+            "        y = x * 2\n"
+            "    return y\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL401"]
+        assert "obs.span" in findings[0].message
+
+    def test_positive_round_and_tracer_attribute_spellings(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import TRACER, round_trace\n"
+            "\n"
+            "def kernel(x):\n"
+            "    with round_trace('bad'):\n"
+            "        x = x + 1\n"
+            "    with TRACER.span('worse'):\n"
+            "        x = x + 2\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL401", "GL401"]
+
+    def test_positive_span_reached_through_call_edge(self):
+        """The GL1xx taint machinery carries GL4xx too: the span lives in
+        a helper the jitted entry calls, one module over."""
+        findings, _ = analyze_sources({
+            "pkg.a": (
+                "import jax\n"
+                "from pkg.b import helper\n"
+                "\n"
+                "def entry(x):\n"
+                "    return helper(x)\n"
+                "\n"
+                "fn = jax.jit(entry)\n"
+            ),
+            "pkg.b": (
+                "from karpenter_tpu import obs\n"
+                "\n"
+                "def helper(t):\n"
+                "    with obs.span('inner'):\n"
+                "        return t * 2\n"
+            ),
+        })
+        assert rules_of(findings) == ["GL401"]
+        assert findings[0].path.endswith("pkg/b.py")
+
+    def test_positive_anomaly_and_recorder_mutation(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu import obs\n"
+            "from karpenter_tpu.obs import RECORDER\n"
+            "\n"
+            "def kernel(x):\n"
+            "    obs.anomaly('negative-avail', count=1)\n"
+            "    RECORDER.record(None)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL402", "GL402"]
+
+    def test_negative_host_side_span_not_flagged(self):
+        """Spans in plain host code — the entire product instrumentation —
+        never flag: GL4xx fires on jit-REACHABLE code only."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from karpenter_tpu import obs\n"
+            "\n"
+            "def kernel(x):\n"
+            "    return x * 2\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+            "\n"
+            "def dispatch(args):\n"
+            "    with obs.span('solve.dispatch', kind='device'):\n"
+            "        fut = fn(args)\n"
+            "    with obs.span('solve.block', kind='device'):\n"
+            "        return jnp.asarray(fut)\n"
+        )})
+        assert findings == []
+
+    def test_negative_generic_record_dump_verbs_not_flagged(self):
+        """`record`/`dump` on non-obs receivers (a topology engine, a
+        store) stay quiet even inside jitted code — only the obs-plane
+        receivers make those verbs GL402."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "\n"
+            "def kernel(x, registry):\n"
+            "    registry.record(x.shape)\n"
+            "    registry.dump()\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel, static_argnames=('registry',))\n"
+        )})
+        assert findings == []
+
+    def test_gl4_suppression_with_justification(self):
+        findings, suppressed = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu import obs\n"
+            "\n"
+            "def kernel(x):\n"
+            "    with obs.span('s'):  # graftlint: disable=GL401 -- fixture\n"
+            "        return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert findings == []
+        assert rules_of(suppressed) == ["GL401"]
+
+    def test_rules_registered(self):
+        assert "GL401" in RULES and "GL402" in RULES
+
+
+# ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
 
@@ -640,10 +772,12 @@ class TestPackageGate:
         out = capsys.readouterr().out
         for rule in ("GL101", "GL102", "GL103", "GL104",
                      "GL201", "GL202", "GL203",
-                     "GL301", "GL302", "GL303"):
+                     "GL301", "GL302", "GL303",
+                     "GL401", "GL402"):
             assert rule in out
         assert set(RULES) == {
             "GL101", "GL102", "GL103", "GL104",
             "GL201", "GL202", "GL203",
             "GL301", "GL302", "GL303",
+            "GL401", "GL402",
         }
