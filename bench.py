@@ -20,6 +20,16 @@ ONE deliberate exception precedes the ladder: the graftlint preflight
 bug, not an environment hazard, so it exits 2 with the findings on stderr
 in milliseconds — failing fast is the point, and no engine record exists
 to report.
+
+A second exception follows the record: the REGRESSION SENTINEL. After the
+benchmark line prints, the fresh headline is compared against the newest
+committed BENCH_r*.json (same-engine records only — a CPU-ladder rescue
+is an environment event, not a regression) and, under `--consolidation`,
+a fresh `python -m perf --json 4` run is compared against the newest
+PERF_r*.json consolidation row. A >15% wall-clock regression on either
+prints a delta table on stderr and exits 3 — the record is still on
+stdout, so drivers always get their line. KARPENTER_BENCH_SENTINEL=0
+disables the gate (noisy shared boxes).
 """
 
 from __future__ import annotations
@@ -175,6 +185,137 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
     }
 
 
+# --------------------------------------------------------------------------
+# regression sentinel: the fresh record vs the newest committed baseline
+# --------------------------------------------------------------------------
+
+SENTINEL_THRESHOLD = 0.15  # >15% slower than the baseline record fails
+
+
+def regression_table(pairs, threshold: float = SENTINEL_THRESHOLD):
+    """pairs: [(label, baseline_ms, fresh_ms)] -> (regressed, table lines).
+    Pure so the sentinel logic is unit-testable without a benchmark run."""
+    lines = [f"{'metric':44s} {'baseline':>10} {'fresh':>10} {'delta':>8}"]
+    regressed = False
+    for label, base, fresh in pairs:
+        if base is None or fresh is None or base <= 0:
+            continue
+        delta = (fresh - base) / base
+        bad = delta > threshold
+        regressed = regressed or bad
+        lines.append(
+            f"{label:44s} {base:>10.2f} {fresh:>10.2f} {100 * delta:>+7.1f}%"
+            f"{'  <-- REGRESSION' if bad else ''}"
+        )
+    return regressed, lines
+
+
+def _newest(pattern: str):
+    import glob
+
+    files = sorted(glob.glob(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), pattern)))
+    return files[-1] if files else None
+
+
+def _baseline_headline():
+    """(value_ms, engine, metric) of the newest BENCH_r*.json, or None."""
+    path = _newest("BENCH_r*.json")
+    if path is None:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    rec = doc.get("parsed") or {}
+    value = rec.get("value")
+    if not isinstance(value, (int, float)):
+        return None
+    return (float(value), (rec.get("detail") or {}).get("engine"),
+            rec.get("metric"))
+
+
+def _baseline_consolidation() -> dict:
+    """{config: total_ms} consolidation rows of the newest PERF_r*.json."""
+    path = _newest("PERF_r*.json")
+    if path is None:
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    return {
+        r["config"]: float(r["total_ms"])
+        for r in doc.get("results", ())
+        if isinstance(r, dict) and "total_ms" in r and "config" in r
+    }
+
+
+def _fresh_consolidation() -> dict:
+    """{config: total_ms} from one fresh `python -m perf --json 4` run."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "perf", "--json", "4"],
+            capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {}
+    out = {}
+    for line in proc.stdout.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "total_ms" in rec and "config" in rec:
+            out[rec["config"]] = float(rec["total_ms"])
+    return out
+
+
+def sentinel(record: dict, consolidation: bool = False) -> int:
+    """Exit code for the regression gate: 0 clean/ungated, 3 on a >15%
+    headline-solve or consolidation regression vs the newest committed
+    records. Headline comparison is ENGINE-GATED (an axon baseline never
+    gates a cpu/native rescue run). KARPENTER_BENCH_SENTINEL=0 disables."""
+    if os.environ.get("KARPENTER_BENCH_SENTINEL", "1").strip().lower() in (
+        "0", "false", "off", "no",
+    ):
+        return 0
+    pairs = []
+    base = _baseline_headline()
+    fresh_value = record.get("value")
+    fresh_engine = (record.get("detail") or {}).get("engine")
+    # gate on BOTH engine and metric: an axon baseline never judges a
+    # cpu-ladder rescue, and the 50k headline never judges an ad-hoc
+    # `bench.py 2000 100` run
+    if (base is not None and fresh_value is not None
+            and base[1] == fresh_engine
+            and base[2] == record.get("metric")):
+        pairs.append((record.get("metric", "headline"), base[0],
+                      float(fresh_value)))
+    if consolidation:
+        base_c = _baseline_consolidation()
+        # only pay the fresh multi-minute perf run when a baseline exists
+        # to judge it against
+        if base_c:
+            for cfg, ms in _fresh_consolidation().items():
+                if cfg in base_c:
+                    pairs.append((cfg, base_c[cfg], ms))
+    if not pairs:
+        return 0
+    regressed, lines = regression_table(pairs)
+    if not regressed:
+        return 0
+    print(f"bench: regression sentinel: >={SENTINEL_THRESHOLD:.0%} slower "
+          "than the newest committed baseline record "
+          "(KARPENTER_BENCH_SENTINEL=0 to disable)", file=sys.stderr)
+    for line in lines:
+        print(f"bench:   {line}", file=sys.stderr)
+    return 3
+
+
 # (engine, attempts, per-attempt timeout seconds, backoff between attempts).
 # native (C++ host kernel) outranks jax-on-CPU as the fallback: same
 # tensorize→kernel→decode pipeline and identical results, ~5x faster than
@@ -269,7 +410,9 @@ def main():
             if rec is not None:
                 rec.setdefault("detail", {})["attempts"] = attempts
                 print(json.dumps(rec))
-                return
+                # the record is out; now gate on the committed baselines
+                sys.exit(sentinel(
+                    rec, consolidation="--consolidation" in sys.argv))
     # every engine failed: still emit a parseable record (value null) with
     # the full diagnostic trail — never exit silent/nonzero without one
     print(

@@ -79,7 +79,10 @@ def _apply(env, plural: str, doc: dict) -> int:
 
 
 def serve_metrics(registry, port: int, host: str = ""):
-    """Prometheus text endpoint (the operator.go:160 metrics mux analog).
+    """Prometheus text endpoint (the operator.go:160 metrics mux analog)
+    plus the health/SLO surfaces: `/healthz` liveness and `/slo`, a JSON
+    snapshot of the device-plane SLO trackers (rolling request quantiles,
+    error-budget burn) and the compile ledger (obs/devplane.py).
     `host` defaults to all interfaces for containerized scrapes; deploys
     without a NetworkPolicy narrow it via KARPENTER_METRICS_BIND
     (deploy/README.md, network exposure)."""
@@ -87,13 +90,22 @@ def serve_metrics(registry, port: int, host: str = ""):
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path not in ("/metrics", "/healthz"):
+            if self.path not in ("/metrics", "/healthz", "/slo"):
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = (registry.expose() if self.path == "/metrics" else "ok").encode()
+            if self.path == "/slo":
+                from karpenter_tpu.obs import devplane
+
+                body = json.dumps(devplane.slo_snapshot()).encode()
+                ctype = "application/json"
+            else:
+                body = (
+                    registry.expose() if self.path == "/metrics" else "ok"
+                ).encode()
+                ctype = "text/plain; version=0.0.4"
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.end_headers()
             self.wfile.write(body)
 

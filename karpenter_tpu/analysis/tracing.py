@@ -32,6 +32,13 @@ runtime:
   anywhere jit-reachable, plus ``record``/``dump`` invoked on an
   obs-plane object (``obs.*``, ``RECORDER``/``recorder``/``TRACER``/
   ``tracer``/``FLIGHT_RECORDER``).
+- GL403 devplane-in-trace: a device-plane telemetry hook
+  (``record_dispatch``/``record_padding``/``record_compile``, or
+  ``observe`` on a devplane receiver — ``devplane.*``/``LEDGER``/
+  ``ledger``) inside jit-reachable code. The hooks read perf_counter
+  deltas, mutate shared ledgers/windows, and feed metric registries:
+  all host-side machinery that would freeze at trace time and race
+  XLA's runtime (the same failure mode as GL401/402, one module over).
 
 Reachability is an inter-procedural taint pass: entry functions are those
 handed to jit/pallas_call (as decorator, call argument, or via
@@ -56,6 +63,7 @@ RULES = {
     "GL104": "jax.jit/pl.pallas_call constructed inside a loop recompiles every iteration",
     "GL401": "obs tracer span enter/exit (span/round_trace) in jit-reachable code executes at trace time",
     "GL402": "obs flight-recorder mutation (anomaly/record/dump) in jit-reachable code executes at trace time",
+    "GL403": "devplane telemetry hook (compile ledger / pad-waste / SLO observe) in jit-reachable code executes at trace time",
 }
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
@@ -74,6 +82,13 @@ _ANOMALY_FUNCS = {"anomaly", "record_anomaly"}
 _RECORDER_VERBS = {"record", "dump"}
 _OBS_BASES = {"obs", "TRACER", "tracer", "RECORDER", "recorder",
               "FLIGHT_RECORDER"}
+# GL403 — the device-plane telemetry surface (karpenter_tpu/obs/devplane):
+# the hook names are matched by final attribute (devplane.record_dispatch,
+# LEDGER.record_dispatch, a bare import); the generic `observe` verb only
+# counts on an unmistakably devplane receiver.
+_DEVPLANE_FUNCS = {"record_dispatch", "record_padding", "record_compile"}
+_DEVPLANE_VERBS = {"observe"}
+_DEVPLANE_BASES = {"devplane", "LEDGER", "ledger"}
 
 
 def _const_names(node) -> set:
@@ -517,6 +532,16 @@ class _TaintVisitor:
                 f"flight-recorder call `{fname}(...)` inside jit-reachable "
                 f"`{self.fn.name}` executes at trace time (mark anomalies "
                 "from the host-side caller)",
+            )
+        elif last in _DEVPLANE_FUNCS or (
+            last in _DEVPLANE_VERBS and base in _DEVPLANE_BASES
+        ):
+            self._flag(
+                "GL403",
+                node.lineno,
+                f"devplane telemetry hook `{fname}(...)` inside "
+                f"jit-reachable `{self.fn.name}` executes at trace time "
+                "(record from the host-side dispatch site)",
             )
 
         # GL103 side effects

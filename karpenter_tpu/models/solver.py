@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 from karpenter_tpu import obs
+from karpenter_tpu.obs import devplane
 from karpenter_tpu.api import labels as wk
 from karpenter_tpu.models.inflight import InFlightNodeClaim
 from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
@@ -248,6 +249,7 @@ class TPUSolver(Solver):
                 groups=0, types=0, device_pods=0, retry_pods=0,
                 host_pods=len(pods), existing_pods=0, engine="host",
                 host_routed={reason: len(pods)} if pods else {},
+                cold_compiles=0, pad_waste_ratio=0.0,
             )
             return res
         existing_nodes = list(existing_nodes)
@@ -259,6 +261,12 @@ class TPUSolver(Solver):
         stages: dict = {}
         _rows0 = (_tz_stats.get("group_row_hits", 0),
                   _tz_stats.get("group_row_misses", 0))
+        # device-plane telemetry deltas for THIS solve: cold compiles paid
+        # and pow-2 padding waste across its dispatches (perf surfaces
+        # both per row; warm repeat rows must read 0 cold compiles)
+        _dp0 = (devplane.STATS["cold_compiles"],
+                devplane.STATS["pad_cells_actual"],
+                devplane.STATS["pad_cells_padded"])
 
         # weight order decides which template a new bin opens from
         # (scheduler.go:267 tries templates in weight order)
@@ -293,7 +301,8 @@ class TPUSolver(Solver):
                 self.last_device_stats = dict(
                     groups=0, types=0, device_pods=0, retry_pods=0,
                     host_pods=len(pods), existing_pods=0, engine="host",
-                    host_routed=host_routed, **stages,
+                    host_routed=host_routed, cold_compiles=0,
+                    pad_waste_ratio=0.0, **stages,
                 )
                 return self.host.solve(
                     pods,
@@ -330,7 +339,8 @@ class TPUSolver(Solver):
                 self.last_device_stats = dict(
                     groups=0, types=0, device_pods=0, retry_pods=0,
                     host_pods=len(pods), existing_pods=0, engine="host",
-                    host_routed=host_routed,
+                    host_routed=host_routed, cold_compiles=0,
+                    pad_waste_ratio=0.0,
                 )
                 return self.host.solve(
                     pods,
@@ -365,7 +375,14 @@ class TPUSolver(Solver):
                     time.perf_counter() - t0) * 1000.0
         claims, retry, ecommits = self._run_and_decode(
             snap, esnap, max_bins, stages)
+        _pad_padded = devplane.STATS["pad_cells_padded"] - _dp0[2]
+        _pad_actual = devplane.STATS["pad_cells_actual"] - _dp0[1]
         self.last_device_stats = dict(
+            cold_compiles=devplane.STATS["cold_compiles"] - _dp0[0],
+            pad_waste_ratio=(
+                round(1.0 - _pad_actual / _pad_padded, 4)
+                if _pad_padded > 0 else 0.0
+            ),
             groups=snap.G,
             types=snap.T,
             device_pods=len(eligible) - len(retry),
@@ -524,6 +541,10 @@ class TPUSolver(Solver):
         pull = None
         while True:
             t0 = time.perf_counter()
+            # pow-2 ladder waste of THIS dispatch (real G×T×B cells vs the
+            # padded shape-bucket volume the scan actually walks); the
+            # doubled re-run records its own extents next iteration
+            devplane.record_padding("solve.bins", G * T * B, Gp * Tp * Bp)
             # "solve.kernel" brackets the whole dispatch+materialize pair;
             # _invoke's children ("solve.dispatch"/"solve.block"/
             # "solve.native") separate host dispatch cost from the device
@@ -629,21 +650,24 @@ class TPUSolver(Solver):
         G, K, W = args["g_mask"].shape
         T = args["t_mask"].shape[0]
         if mesh is not None and G * T * K * W >= SHARD_MIN_WORK:
-            from karpenter_tpu.parallel import sharded_solve
+            from karpenter_tpu.parallel import sharded_solve_host
 
-            with obs.span("solve.dispatch", kind="device", engine="mesh"):
-                out = sharded_solve(mesh, args, max_bins, level_bits=key[-2])
-            with obs.span("solve.block", kind="device", engine="mesh"):
-                return jax.device_get(
-                    {k: out[k]
-                     for k in ("assign", "assign_e", "used", "tmpl", "F")}
-                )
+            # the shard-stage decomposition (shard.pad/tensorize/dispatch/
+            # block/merge device leaves + the mesh.shard compile-ledger
+            # family) lives inside the parallel module
+            return sharded_solve_host(mesh, args, max_bins,
+                                      level_bits=key[-2])
         # dispatch vs block bracketed separately: JAX dispatch is async, so
         # the first span is host-side launch cost (plus any compile) and
         # the second is the actual device wait — the trace's host/device
         # attribution hinges on this split
+        t0 = time.perf_counter()
         with obs.span("solve.dispatch", kind="device"):
             fut = self._kernel(key)(args)
+        # a first-sight key pays its XLA compile synchronously inside the
+        # dispatch above: that wall time is the ledger's compile record
+        devplane.record_dispatch("solve.kernel", key,
+                                 time.perf_counter() - t0)
         with obs.span("solve.block", kind="device"):
             flat = np.asarray(fut)  # one device->host pull
         return self._unpack(flat, args, max_bins)
@@ -693,8 +717,11 @@ class TPUSolver(Solver):
                 # async dispatch, no block: only the host-side launch cost
                 # lands in this span — the wait surfaces later under the
                 # next iteration's "solve.kernel"
+                t0 = time.perf_counter()
                 with obs.span("solve.dispatch_spec", kind="device"):
                     fut = self._kernel(key)(args)
+                devplane.record_dispatch("solve.kernel", key,
+                                         time.perf_counter() - t0)
             except Exception:
                 return lambda: self._invoke(args, key, max_bins)
             return lambda: self._unpack(np.asarray(fut), args, max_bins)

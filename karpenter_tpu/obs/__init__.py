@@ -11,8 +11,14 @@ Contract (cross-referenced from ops/consolidate.py and ops/tensorize.py):
 - Span enter/exit is host-only by construction: graftlint's GL4xx rules
   (``karpenter_tpu/analysis/tracing.py``) fail the tier-1 gate if a span
   or anomaly call becomes reachable from jit/pallas-traced code.
+- :mod:`karpenter_tpu.obs.devplane` adds the device-plane telemetry:
+  the compile ledger (cold-compile events + the
+  ``cold-compile-in-steady-state`` anomaly), pow-2 padding-waste
+  accounting, and the SLO trackers behind the metrics server's ``/slo``
+  endpoint. Its hooks are host-only under the same gate (GL403).
 """
 
+from karpenter_tpu.obs import devplane
 from karpenter_tpu.obs.recorder import FlightRecorder, chrome_events
 from karpenter_tpu.obs.trace import (
     RECORDER,
@@ -33,6 +39,7 @@ from karpenter_tpu.obs.trace import (
 __all__ = [
     "FlightRecorder",
     "chrome_events",
+    "devplane",
     "RECORDER",
     "TRACER",
     "Span",

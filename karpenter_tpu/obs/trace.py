@@ -33,9 +33,12 @@ Model
   round — the batched ladder's ≤1 target missed), ``snapshot-rebuild``
   (the disruption snapshot cache paid a full tensorization while holding
   a prior bundle — the delta path declined), ``host-routed`` (a live
-  provisioning batch sent pods to the host engine), and
-  ``negative-avail`` (tensorize_existing clamped a negative
-  availability). Each also counts in
+  provisioning batch sent pods to the host engine), ``negative-avail``
+  (tensorize_existing clamped a negative availability), and
+  ``cold-compile-in-steady-state`` (the device-plane compile ledger,
+  :mod:`karpenter_tpu.obs.devplane`, saw a cold XLA compile after a long
+  warm streak — shape-key churn or cache eviction in what should be a
+  compiled-once steady state). Each also counts in
   ``karpenter_trace_anomalies_total{kind}``.
 
 Threading: spans are attached via a thread-local stack, so concurrent

@@ -55,6 +55,25 @@ def _resolve_level(level) -> int:
     return n
 
 
+def root_cause(exc: BaseException) -> str:
+    """Innermost exception class name along the __cause__/__context__
+    chain — the label fallback paths attribute rescues to (a bare
+    ``RpcError`` says the wire broke; ``KeyError`` inside it says the
+    payload did)."""
+    seen = {id(exc)}
+    while True:
+        if exc.__cause__ is not None:
+            nxt = exc.__cause__
+        elif exc.__suppress_context__:
+            nxt = None  # `raise X from None`: the context was disowned
+        else:
+            nxt = exc.__context__
+        if nxt is None or id(nxt) in seen:
+            return type(exc).__name__
+        seen.add(id(nxt))
+        exc = nxt
+
+
 def _escape(v) -> str:
     s = str(v).replace("\\", "\\\\").replace('"', '\\"')
     return s.replace("\n", "\\n").replace("\r", "\\r")

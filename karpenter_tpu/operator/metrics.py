@@ -249,6 +249,21 @@ NODE_TERMINATION_DURATION = f"{NAMESPACE}_nodes_termination_duration_seconds"
 NODECLAIM_TERMINATION_DURATION = (
     f"{NAMESPACE}_nodeclaims_termination_duration_seconds"
 )
+# device-plane telemetry (karpenter_tpu/obs/devplane.py): the compile
+# ledger (cold-compile events/wall time + resident executable families),
+# pow-2 padding-waste fractions per dispatch site, and the solver-service
+# SLO surfaces (request histogram, rolling quantile gauges, error-budget
+# burn) — see deploy/README.md "Device-plane & SLO telemetry"
+COMPILE_EVENTS = f"{NAMESPACE}_compile_events_total"
+COMPILE_SECONDS = f"{NAMESPACE}_compile_seconds"
+COMPILE_FAMILIES = f"{NAMESPACE}_compile_families_resident"
+PAD_WASTE_RATIO = f"{NAMESPACE}_pad_waste_ratio"
+# waste is a fraction in [0,1]; duration buckets make no sense for it
+PAD_WASTE_BUCKETS = (0.0, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.625, 0.75,
+                     0.875, 1.0)
+SOLVER_REQUEST_SECONDS = f"{NAMESPACE}_solver_request_seconds"
+SOLVER_REQUEST_QUANTILE = f"{NAMESPACE}_solver_request_quantile_seconds"
+SLO_BUDGET_BURN = f"{NAMESPACE}_slo_error_budget_burn_total"
 # span-derived families fed by the reconcile flight recorder
 # (karpenter_tpu/obs): per-span self time, round durations, anomaly
 # trigger counts, and trace files written

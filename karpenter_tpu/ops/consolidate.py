@@ -97,16 +97,25 @@ reconcile flight recorder's span protocol (:mod:`karpenter_tpu.obs`):
 snapshot builds/advances open ``cache``-kind spans, probe dispatches open
 ``device``-kind spans, and a full rebuild that displaces a held bundle
 marks the round anomalous (``snapshot-rebuild``) so its Chrome trace
-dumps — the causal complement to the counters above.
+dumps — the causal complement to the counters above. Probe dispatches
+also feed the device-plane telemetry (:mod:`karpenter_tpu.obs.devplane`):
+each chunk records its pow-2 row-ladder waste
+(``karpenter_pad_waste_ratio{site="probe.rows"}``) and its executable
+family in the compile ledger (``probe.kernel`` — a cold compile during a
+long warm streak trips the ``cold-compile-in-steady-state`` trace dump).
+Metric semantics live in deploy/README.md ("Device-plane & SLO
+telemetry").
 """
 
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
 from karpenter_tpu import obs
+from karpenter_tpu.obs import devplane
 from karpenter_tpu.ops.tensorize import (
     ExistingSnapshot,
     bucket as _bucket,
@@ -574,11 +583,25 @@ class DisruptionSnapshot:
                     g_count=pad(g_count_k[lo:hi], (Np, Gp)),
                     e_avail=pad(e_chunk, (Np, Ep, R)),
                 )
+                # pow-2 row-ladder waste of this chunk (real counterfactual
+                # rows vs the padded batch axis the kernel vmapped over)
+                devplane.record_padding("probe.rows", n, Np)
                 # dispatch + host pull in one device-kind leaf: the probe
                 # kernel is synchronous-by-consumption (np.asarray blocks)
                 with obs.span("probe.kernel", kind="device", rows=n):
-                    out_placed, out_used = _batched_kernel(1, self.max_minv)(
-                        varying, shared)
+                    kfn = _batched_kernel(1, self.max_minv)
+                    t0 = time.perf_counter()
+                    out_placed, out_used = kfn(varying, shared)
+                    # first sight of this (row axis, snapshot shapes)
+                    # family paid its XLA compile inside the call above;
+                    # the key mirrors the solver's base_key dims — R and
+                    # the mask widths change the compiled program even
+                    # when the padded axes do not
+                    devplane.record_dispatch(
+                        "probe.kernel",
+                        (Np, shared["g_mask"].shape, shared["t_mask"].shape,
+                         Ep, R, self.max_minv),
+                        time.perf_counter() - t0)
                     placed_g[lo:hi] = np.asarray(out_placed)[:n]
                     used[lo:hi] = np.asarray(out_used)[:n]
         return placed_g, used

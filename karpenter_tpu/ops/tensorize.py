@@ -55,7 +55,11 @@ emits alongside every ``consolidation_state`` generation bump:
   (:mod:`karpenter_tpu.obs`), and a negative-avail clamp marks the
   current round anomalous — its full span tree dumps as Chrome trace
   JSON, so the round that tensorized the bad state is on disk, not just
-  counted.
+  counted. The pow-2 shape ladder these tensors feed is itself accounted
+  downstream: every dispatch records its padding waste and compile-ledger
+  family on the device-plane telemetry
+  (:mod:`karpenter_tpu.obs.devplane`; metric semantics in
+  deploy/README.md, "Device-plane & SLO telemetry").
 
 Group-row cache contract
 ------------------------

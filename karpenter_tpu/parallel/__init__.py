@@ -2,6 +2,8 @@ from karpenter_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     make_multihost_mesh,
     sharded_solve,
+    sharded_solve_host,
 )
 
-__all__ = ["make_mesh", "make_multihost_mesh", "sharded_solve"]
+__all__ = ["make_mesh", "make_multihost_mesh", "sharded_solve",
+           "sharded_solve_host"]

@@ -13,12 +13,15 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from karpenter_tpu import obs
+from karpenter_tpu.obs import devplane
 from karpenter_tpu.ops import kernels
 
 DATA_AXIS = "data"
@@ -92,12 +95,22 @@ def _pad_to(a: np.ndarray, axis: int, mult: int) -> np.ndarray:
 
 def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
     """Full solve step (feasibility + pack) with the feasibility inputs
-    sharded over the mesh. Returns the same outputs as the unsharded path.
+    sharded over the mesh. Returns the same outputs as the unsharded path
+    (lazily — consume via :func:`sharded_solve_host` for the host dict).
 
     Sharding layout: group-axis tensors are split over `data`, type-axis
     tensors over `model`; the pack scan consumes the all-gathered F (XLA
     inserts the collectives) and runs replicated — it is O(G*B*T) and tiny
     next to feasibility at scale.
+
+    Stage attribution (obs flight recorder, same ``kind=device``
+    convention as ``solve.kernel``): ``shard.pad`` is the host pow-2/mesh
+    padding, ``shard.tensorize`` the host→device placement of the shard
+    tensors, ``shard.dispatch`` the sharded program launch (plus XLA
+    compile on a cold ``mesh.shard`` ledger family). The consume side
+    (``shard.block``/``shard.merge``) lives in ``sharded_solve_host`` —
+    together these leaves decompose the MULTICHIP wall clock that used to
+    be one opaque number.
     """
     n_data, n_model = mesh.devices.shape
 
@@ -138,19 +151,61 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
     REPL_NAMES += [k for k in ("e_avail", "e_npods", "e_scnt", "e_decl", "e_match",
                                "e_aff")
                    if k in args]
-    for name in G_NAMES:
-        args[name] = _pad_to(np.asarray(args[name]), 0, n_data)
-    for name in T_NAMES:
-        args[name] = _pad_to(np.asarray(args[name]), 0, n_model)
+    T0 = np.asarray(args["t_mask"]).shape[0]
+    with obs.span("shard.pad", n_data=n_data, n_model=n_model):
+        for name in G_NAMES:
+            args[name] = _pad_to(np.asarray(args[name]), 0, n_data)
+        for name in T_NAMES:
+            args[name] = _pad_to(np.asarray(args[name]), 0, n_model)
+    Gp = args["g_count"].shape[0]
+    Tp = args["t_mask"].shape[0]
+    devplane.record_padding("mesh.shards", G * T0, Gp * Tp)
 
-    placed = dict(args)
-    for name in G_NAMES:
-        placed[name] = shard(args[name], P(DATA_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
-    for name in T_NAMES:
-        placed[name] = shard(args[name], P(MODEL_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
-    for name in REPL_NAMES:
-        placed[name] = shard(np.asarray(args[name]), P())
+    # host→device placement of the shard tensors: the stage the MULTICHIP
+    # overlap work (tensorize shard k+1 while shard k solves) will hide
+    with obs.span("shard.tensorize", kind="device", groups=Gp, types=Tp):
+        placed = dict(args)
+        for name in G_NAMES:
+            placed[name] = shard(args[name], P(DATA_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
+        for name in T_NAMES:
+            placed[name] = shard(args[name], P(MODEL_AXIS, *([None] * (np.asarray(args[name]).ndim - 1))))
+        for name in REPL_NAMES:
+            placed[name] = shard(np.asarray(args[name]), P())
 
     max_minv = int(np.asarray(args["m_minv"]).max()) if "m_minv" in args else 0
+    # the key mirrors the compiled program's real shape dims: the resource
+    # axis (R) and mask widths recompile even when the padded G/T do not
+    key = (max_bins, max_minv, level_bits, n_data, n_model, Gp, Tp,
+           args["g_mask"].shape[1:], np.asarray(args["g_demand"]).shape[1],
+           int("e_avail" in args))
+    t0 = time.perf_counter()
     with mesh:
-        return _jitted_solve_step(max_bins, max_minv, level_bits)(placed)
+        with obs.span("shard.dispatch", kind="device", n_data=n_data,
+                      n_model=n_model, bins=max_bins):
+            out = _jitted_solve_step(max_bins, max_minv, level_bits)(placed)
+    devplane.record_dispatch("mesh.shard", key, time.perf_counter() - t0)
+    return out
+
+
+def sharded_solve_host(mesh: Mesh, args: dict, max_bins: int,
+                       level_bits: int = 20) -> dict:
+    """Sharded solve consumed to host numpy: ``shard.block`` waits for the
+    in-flight sharded program, ``shard.merge`` gathers the replicated
+    outputs across the mesh into one host dict — the consumption half of
+    the shard-stage decomposition (models/solver.py rides this on the
+    mesh path; the perf harness's multichip row reads the same leaves)."""
+    # late-bound through the package attribute so a test double installed
+    # on karpenter_tpu.parallel.sharded_solve intercepts this path too
+    from karpenter_tpu import parallel as _parallel
+
+    out = _parallel.sharded_solve(mesh, args, max_bins,
+                                  level_bits=level_bits)
+    with obs.span("shard.block", kind="device", engine="mesh"):
+        try:
+            out["used"].block_until_ready()
+        except AttributeError:
+            pass  # already host-side (mocked path)
+    with obs.span("shard.merge", kind="device", engine="mesh"):
+        return jax.device_get(
+            {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}
+        )

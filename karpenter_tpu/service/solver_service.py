@@ -15,6 +15,18 @@ or the C++ engine elsewhere — while the client process needs no jax at
 dispatch time. The latency budget for the hop rides inside the solve
 target the same way the tunnel round trip does (BASELINE.md <200 ms
 includes it).
+
+Cross-boundary SLO tracing (deploy/README.md "Device-plane & SLO
+telemetry"): the client threads its open round's trace id through the
+`__meta__` payload (`trace_id`), and the server opens one linked
+round trace per request (`solver-service`, `client_trace=<id>`) so a
+grep for the client's trace id finds both halves of the hop. Request
+durations feed `karpenter_solver_request_seconds{outcome}` plus the
+rolling-quantile/error-budget SLO tracker (obs/devplane.py) that the
+metrics server's `/slo` endpoint snapshots; a server-side solve failure
+aborts the RPC with the root-cause exception class in the status
+details, which the client surfaces as the `reason` label on
+`karpenter_solver_remote_fallbacks_total` and in its structured warning.
 """
 
 from __future__ import annotations
@@ -49,41 +61,87 @@ def _unpack(blob: bytes) -> tuple:
     return arrays, meta
 
 
+def _env_latency_slo() -> float | None:
+    """KARPENTER_SOLVER_SLO_MS: per-request latency objective in ms
+    (unset = error-only SLO)."""
+    import os
+
+    v = os.environ.get("KARPENTER_SOLVER_SLO_MS", "").strip()
+    if not v:
+        return None
+    try:
+        return float(v) / 1000.0
+    except ValueError:
+        return None
+
+
 class _SolverHandler:
     """Server-side execution through the solver's own `_invoke` stack: the
     shared jitted packed kernel (one compile per shape bucket, one
     device→host pull) and the calibrated small-batch native routing both
-    apply on the serving side exactly as in-process."""
+    apply on the serving side exactly as in-process. Every request runs as
+    one linked round trace and lands in the service SLO tracker."""
 
-    def __init__(self, use_native: bool = False):
+    def __init__(self, use_native: bool = False, registry=None):
         from karpenter_tpu.models.solver import NativeSolver, TPUSolver
+        from karpenter_tpu.obs import devplane
+        from karpenter_tpu.operator import metrics as _metrics
 
         self._solver = NativeSolver() if use_native else TPUSolver()
+        self._registry = registry if registry is not None else _metrics.REGISTRY
+        self._slo = devplane.slo_tracker(
+            "solver_service", latency_slo=_env_latency_slo()
+        )
 
     def solve(self, request: bytes, context) -> bytes:
-        args, meta = _unpack(request)
-        max_bins = int(meta["max_bins"])
-        # _invoke reads only the key's tail: (..., max_bins, level_bits,
-        # max_minv) — the same layout models/solver.py builds
-        key = (max_bins, int(meta.get("level_bits", 20)),
-               int(meta.get("max_minv", 0)))
-        out = self._solver._invoke(args, key, max_bins)
-        return _pack(
-            {k: np.asarray(out[k]) for k in ("assign", "assign_e", "used", "tmpl", "F")},
-            {},
-        )
+        import time
+
+        import grpc
+
+        from karpenter_tpu import obs
+        from karpenter_tpu.operator.logging import root_cause
+
+        t0 = time.perf_counter()
+        outcome = "ok"
+        try:
+            args, meta = _unpack(request)
+            max_bins = int(meta["max_bins"])
+            # _invoke reads only the key's tail: (..., max_bins, level_bits,
+            # max_minv) — the same layout models/solver.py builds
+            key = (max_bins, int(meta.get("level_bits", 20)),
+                   int(meta.get("max_minv", 0)))
+            # the server half of the cross-boundary trace: a round of its
+            # own, linked to the client's reconcile round by trace id
+            with obs.round_trace("solver-service", registry=self._registry,
+                                 client_trace=meta.get("trace_id") or None):
+                out = self._solver._invoke(args, key, max_bins)
+            return _pack(
+                {k: np.asarray(out[k]) for k in ("assign", "assign_e", "used", "tmpl", "F")},
+                {},
+            )
+        except Exception as e:
+            outcome = "error"
+            # the client's fallback attributes its rescue to this class:
+            # ship the root cause in the status details, not just a string
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{root_cause(e)}: {e}")
+        finally:
+            self._slo.observe(time.perf_counter() - t0, outcome=outcome,
+                              registry=self._registry)
 
 
 def serve(port: int = 0, use_native: bool = False, max_workers: int = 4,
-          host: str = "127.0.0.1"):
+          host: str = "127.0.0.1", registry=None):
     """Start the device-plane server; returns (grpc.Server, bound_port).
     Default bind is loopback (tests, local splits); containerized deploys
-    pass host="0.0.0.0" so the pod IP is reachable (deploy/operator.yaml)."""
+    pass host="0.0.0.0" so the pod IP is reachable (deploy/operator.yaml).
+    `registry` homes the request/SLO families (default: the process
+    registry the standalone entrypoint's metrics server exposes)."""
     from concurrent import futures
 
     import grpc
 
-    handler = _SolverHandler(use_native=use_native)
+    handler = _SolverHandler(use_native=use_native, registry=registry)
 
     class _Generic(grpc.GenericRpcHandler):
         def service(self, call_details):
@@ -99,6 +157,9 @@ def serve(port: int = 0, use_native: bool = False, max_workers: int = 4,
         futures.ThreadPoolExecutor(max_workers=max_workers), options=_GRPC_OPTS
     )
     server.add_generic_rpc_handlers((_Generic(),))
+    # exposed for tests (fault injection on the serving solver) and for
+    # embedding callers that want the SLO tracker
+    server.solver_handler = handler
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise RuntimeError(f"solver service: failed to bind {host}:{port}")
@@ -150,29 +211,48 @@ class RemoteSolver(TPUSolver):
                 component="remote_solver", target=self._target
             )
 
+    @staticmethod
+    def _fallback_reason(e) -> str:
+        """Root-cause label for a rescued dispatch: a server-side abort
+        carries `ExceptionClass: detail` in the status details (the
+        handler's contract); anything else is a transport failure."""
+        try:
+            details = e.details() or ""
+        except Exception:
+            details = ""
+        head = details.split(":", 1)[0].strip()
+        return head if head.isidentifier() else "transport"
+
     def _invoke(self, args, key, max_bins):
         import grpc
 
+        from karpenter_tpu import obs
         from karpenter_tpu.operator import metrics as _metrics
 
+        # the round's trace id rides the request meta so the server can
+        # open a LINKED round trace: one grep joins both halves of the hop
+        trace_id = obs.current_trace_id()
         meta = {"max_bins": int(max_bins), "level_bits": int(key[-2]),
-                "max_minv": int(key[-1])}
+                "max_minv": int(key[-1]), "trace_id": trace_id or ""}
         try:
             blob = self._call(_pack(dict(args), meta))
         except grpc.RpcError as e:
-            # device plane unreachable: solve in-process rather than
-            # failing the provisioning round (the Solver seam's fallback
-            # stance — same philosophy as the engine ladder in bench.py)
+            # device plane unreachable or server solve failed: solve
+            # in-process rather than failing the provisioning round (the
+            # Solver seam's fallback stance — same philosophy as the
+            # engine ladder in bench.py), attributing the rescue to its
+            # root cause (server exception class, or transport)
             try:
                 code = str(e.code())
             except Exception:
                 code = "UNKNOWN"
+            reason = self._fallback_reason(e)
             self._registry.counter(
                 _metrics.SOLVER_REMOTE_FALLBACKS,
                 "RemoteSolver dispatches rescued by the in-process kernel",
-            ).inc(code=code)
+            ).inc(code=code, reason=reason)
             self._log.warn("solver service unavailable; solving in-process",
-                           code=code)
+                           code=code, reason=reason, trace=trace_id or "")
             return super()._invoke(args, key, max_bins)
         self._last_engine = "remote"
         arrays, _ = _unpack(blob)
@@ -196,6 +276,10 @@ def main(argv=None) -> int:
                          "reachable; use 127.0.0.1 for local-only)")
     ap.add_argument("--native", action="store_true",
                     help="serve the C++ engine instead of the accelerator")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve /metrics + /healthz + /slo for this device "
+                         "plane (0 = off); bind narrows via "
+                         "KARPENTER_METRICS_BIND like the operator's")
     args = ap.parse_args(argv)
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -204,9 +288,24 @@ def main(argv=None) -> int:
         except ValueError:
             pass  # non-main thread (tests)
     server, bound = serve(port=args.port, use_native=args.native, host=args.host)
+    metrics_server = None
+    if args.metrics_port:
+        import os
+
+        from karpenter_tpu.__main__ import serve_metrics
+        from karpenter_tpu.operator import metrics as _metrics
+
+        metrics_server = serve_metrics(
+            _metrics.REGISTRY, args.metrics_port,
+            host=os.environ.get("KARPENTER_METRICS_BIND", ""),
+        )
+        print(f"solver service: metrics on :{args.metrics_port} "
+              f"(/metrics /healthz /slo)", flush=True)
     print(f"solver service: listening on {args.host}:{bound} "
           f"({'native' if args.native else 'device'} engine)", flush=True)
     stop.wait()
+    if metrics_server is not None:
+        metrics_server.shutdown()
     server.stop(grace=2.0)
     return 0
 

@@ -14,11 +14,21 @@ Usage:
                                    # recorder) on every config/grid point
     python -m perf grid            # the reference {1..5000}x400 grid
                                    # (scheduling_benchmark_test.go:77-97)
+    python -m perf multichip       # the mesh-sharded solve decomposed into
+                                   # shard-stage leaves (shard.pad/
+                                   # tensorize/dispatch/block/merge),
+                                   # sharded vs unsharded wall clock, pad
+                                   # waste, cold compiles — run it in a
+                                   # FRESH interpreter (virtual devices
+                                   # must be set before jax initializes)
 
 One JSON line per result: {config, pods, types, ms, pods_per_sec, nodes,
 ffd_nodes, node_overhead_pct, floor_ok}. `ffd_nodes` is the host FFD
 oracle on identical inputs (BASELINE target: ≤2% node-count overhead);
-`floor_ok` asserts the reference's enforced 100 pods/sec floor.
+`floor_ok` asserts the reference's enforced 100 pods/sec floor. Every
+solve row additionally reports `pad_waste_ratio` (pow-2 ladder waste of
+its dispatches) and `cold_compiles` (compile-ledger delta — 0 on warm
+repeat rows), the device-plane telemetry of obs/devplane.py.
 """
 
 from __future__ import annotations
@@ -113,6 +123,11 @@ def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
         "floor_ok": bool(pps >= 100.0) if len(pods) > 100 else True,
         "engine": stats.get("engine"),
         "host_routed": stats.get("host_routed") or {},
+        # device-plane telemetry of the timed solve: pow-2 padding waste
+        # across its dispatches and the cold compiles it paid (0 on warm
+        # rows — the warmup solve above owns the compile cost)
+        "pad_waste_ratio": stats.get("pad_waste_ratio", 0.0),
+        "cold_compiles": stats.get("cold_compiles", 0),
         "breakdown": breakdown,
     }
     if trace_out is not None:
@@ -176,7 +191,27 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
                 "leaf_coverage": round(tr.leaf_coverage(), 4),
                 "file": obs.RECORDER.dump(tr),
             }
+        # device-plane telemetry of the consolidation run: padding waste
+        # per dispatch site and cold compiles per jit family (the probe's
+        # pow-2 row ladder shows up here)
+        pad_hist = env.registry.histogram(m.PAD_WASTE_RATIO)
+        compile_events = env.registry.counter(m.COMPILE_EVENTS)
+        pad_waste = {}
+        for site in ("probe.rows", "solve.bins", "mesh.shards"):
+            n = pad_hist.count(site=site)
+            if n:
+                pad_waste[site] = {
+                    "dispatches": n,
+                    "mean_ratio": round(pad_hist.sum(site=site) / n, 4),
+                }
+        cold = {}
+        for fam in ("probe.kernel", "solve.kernel", "mesh.shard"):
+            v = compile_events.value(family=fam)
+            if v:
+                cold[fam] = int(v)
         out_extra["breakdown"] = {
+            "pad_waste": pad_waste,
+            "cold_compiles": cold,
             "tensorize_existing_ms": round(
                 _tz.STATS["existing_ms"] - stats0["existing_ms"], 2),
             "tensorize_existing_calls": (
@@ -232,6 +267,89 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
     }))
 
 
+def run_multichip(trace: bool = False, n_devices: int = 8,
+                  n_groups: int = 512, n_types: int = 512):
+    """The MULTICHIP row: one mesh-sharded solve over virtual CPU devices
+    (the dryrun topology, __graft_entry__.dryrun_multichip), decomposed
+    into the shard-stage leaves the obs flight recorder now opens —
+    shard.pad / shard.tensorize (host-tensorize+placement) /
+    shard.dispatch / shard.block / shard.merge — plus sharded-vs-unsharded
+    wall clock, mesh pad waste, and the compile-ledger delta. This is the
+    attribution surface the MULTICHIP regression work (ROADMAP: 8 devices
+    slower than 1) reads. Needs a fresh interpreter: XLA parses the
+    virtual-device count once per process."""
+    import __graft_entry__ as graft
+
+    # one shared forcing path with the dry run: replaces any stale
+    # --xla_force_host_platform_device_count and pins the platform to cpu
+    jax = graft.force_virtual_cpu_devices(n_devices)
+    if len(jax.devices()) < 2:
+        print(json.dumps({
+            "config": f"multichip-{n_groups}x{n_types}",
+            "skipped": "needs >=2 jax devices; run in a fresh interpreter "
+                       "(XLA parses --xla_force_host_platform_device_count "
+                       "once per process)",
+        }))
+        return
+
+    import numpy as np
+
+    from karpenter_tpu import obs
+    from karpenter_tpu.obs import devplane
+    from karpenter_tpu.ops import kernels
+    from karpenter_tpu.parallel import make_mesh, sharded_solve_host
+
+    B = 256
+    snap = graft._wide_snapshot(n_groups=n_groups, n_types=n_types)
+    args = graft._snapshot_args(snap)
+    mesh = make_mesh()
+    sharded_solve_host(mesh, args, B)  # warm: the mesh.shard compile family
+    dp0 = (devplane.STATS["cold_compiles"],
+           devplane.STATS["pad_cells_actual"],
+           devplane.STATS["pad_cells_padded"])
+    t0 = time.perf_counter()
+    with obs.round_trace(f"multichip-{n_groups}x{n_types}") as tr:
+        host = sharded_solve_host(mesh, args, B)
+    sharded_ms = (time.perf_counter() - t0) * 1000.0
+
+    kernels.solve_step(args, max_bins=B)["used"].block_until_ready()  # warm
+    t0 = time.perf_counter()
+    kernels.solve_step(args, max_bins=B)["used"].block_until_ready()
+    unsharded_ms = (time.perf_counter() - t0) * 1000.0
+
+    decomposition, leaf_ms = {}, 0.0
+    if tr is not None:
+        for name, (tot, _n) in tr.self_times().items():
+            if name.startswith("shard."):
+                decomposition[name] = round(tot * 1000.0, 2)
+                leaf_ms += tot * 1000.0
+    pa = devplane.STATS["pad_cells_actual"] - dp0[1]
+    pp = devplane.STATS["pad_cells_padded"] - dp0[2]
+    out = {
+        "config": f"multichip-{n_groups}x{n_types}",
+        "devices": len(jax.devices()),
+        "mesh": dict(zip(mesh.axis_names, list(mesh.devices.shape))),
+        "work": int(snap.G * snap.T * len(snap.keys) * snap.W),
+        "sharded_ms": round(sharded_ms, 1),
+        "unsharded_ms": round(unsharded_ms, 1),
+        "nodes": int(np.asarray(host["used"]).sum()),
+        # the shard-stage attribution: ≥90% of the sharded wall clock must
+        # land in these leaves or the decomposition is lying
+        "decomposition_ms": decomposition,
+        "leaf_coverage": (
+            round(leaf_ms / sharded_ms, 4) if sharded_ms > 0 else 0.0
+        ),
+        "pad_waste_ratio": round(1.0 - pa / pp, 4) if pp > 0 else 0.0,
+        "cold_compiles": devplane.STATS["cold_compiles"] - dp0[0],
+    }
+    if trace and tr is not None:
+        out["trace"] = {
+            "top_spans": tr.summary(top=8),
+            "file": obs.RECORDER.dump(tr),
+        }
+    print(json.dumps(out))
+
+
 def run_grid(min_values: int | None = None, trace: bool = False):
     """The reference benchmark grid: pods x 400 types, diverse 1/6 mix
     (scheduling_benchmark_test.go:77-97, :234-248); its enforced floor is
@@ -271,6 +389,9 @@ def main():
         return
     if args == ["grid-mv"]:
         run_grid(min_values=50, trace=breakdown)
+        return
+    if args == ["multichip"]:
+        run_multichip(trace=breakdown)
         return
     picks = {int(a) for a in args} if args else {1, 2, 3, 4, 5}
     if 1 in picks:

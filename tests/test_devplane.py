@@ -1,0 +1,270 @@
+"""Device-plane telemetry (karpenter_tpu/obs/devplane): the compile
+ledger (warm re-dispatch = zero cold compiles, a new shape family =
+exactly one, steady-state cold compile = exactly one trace dump), the
+pow-2 padding-waste accounting across its three sites, the SLO trackers
+behind /slo, and their integration with the real solver, probe, and mesh
+dispatch paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs import devplane
+from karpenter_tpu.operator import metrics as m
+from karpenter_tpu.operator.metrics import Registry
+
+GIB = 2 ** 30
+
+
+@pytest.fixture
+def rec(tmp_path):
+    """Isolated tracer/recorder/devplane state, dump dir at tmp_path."""
+    obs.configure(enabled=True, dump_dir=str(tmp_path), capacity=8,
+                  dump_all=False)
+    obs.RECORDER.clear()
+    devplane.reset()
+    yield tmp_path
+    devplane.reset()
+    obs.reset()
+
+
+def dumps_in(tmp_path) -> list:
+    return sorted(p for p in os.listdir(tmp_path) if p.endswith(".trace.json"))
+
+
+# ---------------------------------------------------------------------------
+# compile ledger
+# ---------------------------------------------------------------------------
+
+class TestCompileLedger:
+    def test_warm_redispatch_records_zero_cold_compiles(self, rec):
+        reg = Registry()
+        assert devplane.record_dispatch("solve.kernel", ("k", 64), 0.1,
+                                        registry=reg) is True
+        before = devplane.STATS["cold_compiles"]
+        for _ in range(3):
+            assert devplane.record_dispatch("solve.kernel", ("k", 64), 0.01,
+                                            registry=reg) is False
+        assert devplane.STATS["cold_compiles"] == before
+        assert reg.counter(m.COMPILE_EVENTS).value(family="solve.kernel") == 1
+        assert reg.histogram(m.COMPILE_SECONDS).count(family="solve.kernel") == 1
+
+    def test_new_shape_family_records_exactly_one(self, rec):
+        reg = Registry()
+        for i in range(3):
+            devplane.record_dispatch("probe.kernel", ("p", i), 0.1,
+                                     registry=reg)
+            devplane.record_dispatch("probe.kernel", ("p", i), 0.01,
+                                     registry=reg)
+        assert reg.counter(m.COMPILE_EVENTS).value(family="probe.kernel") == 3
+        # resident-family gauge tracks live executable cardinality
+        assert reg.gauge(m.COMPILE_FAMILIES).value(family="probe.kernel") == 3
+        assert devplane.LEDGER.families()["probe.kernel"] == 3
+
+    def test_steady_state_cold_compile_dumps_exactly_one_trace(self, rec):
+        """A cold compile after a long warm streak (the key universe had
+        stopped growing) marks the round; the recorder dumps it once."""
+        devplane.LEDGER.steady_after = 4
+        reg = Registry()
+        devplane.record_dispatch("solve.kernel", ("fam", 1), 0.2,
+                                 registry=reg)  # expected cold (streak 0)
+        for _ in range(6):
+            devplane.record_dispatch("solve.kernel", ("fam", 1), 0.001,
+                                     registry=reg)
+        assert dumps_in(rec) == []  # warm-ups never dump
+        with obs.round_trace("provision", registry=reg):
+            with obs.span("solve.kernel", kind="device"):
+                devplane.record_dispatch("solve.kernel", ("fam", 2), 0.3,
+                                         registry=reg)
+        assert len(dumps_in(rec)) == 1
+        assert reg.counter(m.TRACE_ANOMALIES).value(
+            kind="cold-compile-in-steady-state") == 1
+        # the now-warm key in a later round: no further dump
+        with obs.round_trace("provision", registry=reg):
+            with obs.span("solve.kernel", kind="device"):
+                devplane.record_dispatch("solve.kernel", ("fam", 2), 0.001,
+                                         registry=reg)
+        assert len(dumps_in(rec)) == 1
+
+    def test_first_key_of_new_family_is_exempt_in_steady_state(self, rec):
+        """A subsystem coming online late (the first probe round after a
+        long provisioning streak) grows the key universe as expected —
+        its FIRST family key never fires the anomaly; the second does."""
+        devplane.LEDGER.steady_after = 4
+        reg = Registry()
+        devplane.record_dispatch("solve.kernel", ("s", 1), 0.1, registry=reg)
+        for _ in range(6):
+            devplane.record_dispatch("solve.kernel", ("s", 1), 0.001,
+                                     registry=reg)
+        with obs.round_trace("disrupt", registry=reg):
+            with obs.span("probe.kernel", kind="device"):
+                devplane.record_dispatch("probe.kernel", ("p", 1), 0.2,
+                                         registry=reg)  # family's first key
+        assert dumps_in(rec) == []
+        # re-arm the streak, then a SECOND key of the now-known family is
+        # genuine churn and dumps
+        for _ in range(6):
+            devplane.record_dispatch("probe.kernel", ("p", 1), 0.001,
+                                     registry=reg)
+        with obs.round_trace("disrupt", registry=reg):
+            with obs.span("probe.kernel", kind="device"):
+                devplane.record_dispatch("probe.kernel", ("p", 2), 0.2,
+                                         registry=reg)
+        assert len(dumps_in(rec)) == 1
+
+    def test_early_cold_compiles_are_not_anomalous(self, rec):
+        """Cold compiles while the universe is still growing (streak below
+        the threshold) are expected — counted, never dumped."""
+        devplane.LEDGER.steady_after = 50
+        reg = Registry()
+        with obs.round_trace("provision", registry=reg):
+            with obs.span("x"):
+                for i in range(5):
+                    devplane.record_dispatch("solve.kernel", ("g", i), 0.1,
+                                             registry=reg)
+        assert dumps_in(rec) == []
+        assert reg.counter(m.COMPILE_EVENTS).value(family="solve.kernel") == 5
+
+
+# ---------------------------------------------------------------------------
+# padding-waste accounting
+# ---------------------------------------------------------------------------
+
+class TestPaddingWaste:
+    def test_ratio_math_and_histogram_site_label(self, rec):
+        reg = Registry()
+        assert devplane.record_padding("solve.bins", 30, 64,
+                                       registry=reg) == pytest.approx(
+            1.0 - 30 / 64)
+        assert devplane.record_padding("probe.rows", 4, 4,
+                                       registry=reg) == 0.0
+        h = reg.histogram(m.PAD_WASTE_RATIO)
+        assert h.count(site="solve.bins") == 1
+        assert h.count(site="probe.rows") == 1
+        assert devplane.STATS["pad_dispatches"] == 2
+
+    def test_degenerate_extents_clamp(self, rec):
+        reg = Registry()
+        assert devplane.record_padding("solve.bins", 0, 0, registry=reg) == 0.0
+        assert devplane.record_padding("solve.bins", 100, 50,
+                                       registry=reg) == 0.0  # never negative
+
+
+# ---------------------------------------------------------------------------
+# solver / probe / mesh integration
+# ---------------------------------------------------------------------------
+
+class TestSolverIntegration:
+    def _inputs(self, n_pods=24, n_types=16):
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import ClaimTemplate
+
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pods = [Pod(metadata=ObjectMeta(name=f"p{i}"),
+                    requests={"cpu": 0.5 + (i % 3) * 0.25, "memory": GIB})
+                for i in range(n_pods)]
+        return pods, [ClaimTemplate(pool)], {
+            "default": benchmark_catalog(n_types)}
+
+    def test_warm_repeat_solve_reports_zero_cold_compiles(self, rec):
+        from karpenter_tpu.models import TPUSolver
+
+        pods, tpls, its = self._inputs()
+        s = TPUSolver()
+        s.solve([p.clone() for p in pods], tpls, its)
+        first = dict(s.last_device_stats)
+        s.solve([p.clone() for p in pods], tpls, its)
+        second = dict(s.last_device_stats)
+        # the ledger was reset by the fixture, so the first solve pays the
+        # (ledger-visible) compile; the repeat is warm end to end
+        assert first["cold_compiles"] >= 1
+        assert second["cold_compiles"] == 0
+        assert 0.0 <= second["pad_waste_ratio"] <= 1.0
+
+    def test_probe_dispatch_records_row_padding_and_family(self, rec):
+        from perf import configs as C
+
+        env = C.config4_consolidation_env(n_nodes=4)
+        env.disruption.poll_period = 0.0
+        env.clock.step(20.0)
+        env.disruption.poll()
+        h = env.registry.histogram(m.PAD_WASTE_RATIO)
+        assert h.count(site="probe.rows") >= 1
+        assert env.registry.counter(m.COMPILE_EVENTS).value(
+            family="probe.kernel") >= 1
+
+    def test_sharded_solve_host_stage_spans_and_pad_site(self, rec):
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a multi-device (virtual) mesh")
+        import numpy as np
+
+        import __graft_entry__ as graft
+        from karpenter_tpu.ops import kernels
+        from karpenter_tpu.parallel import make_mesh, sharded_solve_host
+
+        snap = graft._example_snapshot(n_pods=48, n_types=16)
+        args = graft._snapshot_args(snap)
+        mesh = make_mesh(len(jax.devices()))
+        reg = Registry()
+        with obs.round_trace("multichip", registry=reg) as tr:
+            host = sharded_solve_host(mesh, args, 64)
+        names = {sp.name for sp in tr.spans()}
+        assert {"shard.pad", "shard.tensorize", "shard.dispatch",
+                "shard.block", "shard.merge"} <= names
+        assert reg.histogram(m.PAD_WASTE_RATIO).count(site="mesh.shards") == 1
+        assert reg.counter(m.COMPILE_EVENTS).value(family="mesh.shard") >= 1
+        ref = kernels.solve_step(args, max_bins=64)
+        assert np.array_equal(np.asarray(host["assign"])[: snap.G],
+                              np.asarray(ref["assign"]))
+
+
+# ---------------------------------------------------------------------------
+# SLO trackers + the /slo endpoint
+# ---------------------------------------------------------------------------
+
+class TestSloTracker:
+    def test_quantiles_budget_and_snapshot(self, rec):
+        reg = Registry()
+        t = devplane.slo_tracker("svc", latency_slo=0.2, objective=0.9)
+        for ms in (10, 20, 30, 40, 50):
+            t.observe(ms / 1000.0, registry=reg)
+        t.observe(0.5, registry=reg)              # latency violation
+        t.observe(0.01, outcome="error", registry=reg)  # error violation
+        snap = devplane.slo_snapshot()["slo"]["svc"]
+        assert snap["count"] == 7 and snap["errors"] == 1
+        assert snap["budget_burned"] == 2
+        assert snap["p50_ms"] <= snap["p95_ms"] <= snap["p99_ms"]
+        assert reg.histogram(m.SOLVER_REQUEST_SECONDS).count(outcome="ok") == 6
+        assert reg.histogram(m.SOLVER_REQUEST_SECONDS).count(
+            outcome="error") == 1
+        assert reg.counter(m.SLO_BUDGET_BURN).value(slo="svc") == 2
+        assert reg.gauge(m.SOLVER_REQUEST_QUANTILE).value(
+            slo="svc", q="p99") > 0
+
+    def test_slo_endpoint_serves_snapshot_json(self, rec):
+        from karpenter_tpu.__main__ import serve_metrics
+
+        devplane.slo_tracker("svc").observe(0.01)
+        server = serve_metrics(Registry(), 0, host="127.0.0.1")
+        try:
+            port = server.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo", timeout=5).read().decode()
+            doc = json.loads(body)
+            assert "svc" in doc["slo"]
+            assert "compile_ledger" in doc
+            assert urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ).read().decode() == "ok"
+        finally:
+            server.shutdown()

@@ -11,9 +11,55 @@ from karpenter_tpu.api.nodepool import NodePool
 from karpenter_tpu.api.objects import Deployment, ObjectMeta, Pod
 from karpenter_tpu.cloudprovider.catalog import make_instance_type
 from karpenter_tpu.operator import Environment
-from karpenter_tpu.operator.logging import NOP, Logger, NopLogger, make_logger
+from karpenter_tpu.operator.logging import (
+    NOP,
+    Logger,
+    NopLogger,
+    make_logger,
+    root_cause,
+)
 
 GIB = 2**30
+
+
+class TestRootCause:
+    """root_cause walks __cause__/__context__ to the innermost class name
+    (the `reason` label RemoteSolver fallbacks attribute rescues to)."""
+
+    def _raise_chained(self):
+        try:
+            raise KeyError("inner")
+        except KeyError as e:
+            raise ValueError("outer") from e
+
+    def test_walks_explicit_cause_chain(self):
+        try:
+            self._raise_chained()
+        except ValueError as e:
+            assert root_cause(e) == "KeyError"
+
+    def test_walks_implicit_context(self):
+        try:
+            try:
+                raise KeyError("inner")
+            except KeyError:
+                raise ValueError("outer")
+        except ValueError as e:
+            assert root_cause(e) == "KeyError"
+
+    def test_from_none_disowns_the_context(self):
+        """`raise X from None` deliberately suppresses the context — the
+        root cause is X itself, not the disowned inner exception."""
+        try:
+            try:
+                raise KeyError("inner")
+            except KeyError:
+                raise ValueError("outer") from None
+        except ValueError as e:
+            assert root_cause(e) == "ValueError"
+
+    def test_bare_exception(self):
+        assert root_cause(RuntimeError("x")) == "RuntimeError"
 
 
 class TestLogger:

@@ -95,3 +95,98 @@ class TestRemoteFallback:
         res = s.solve([p.clone() for p in pods(10)], [ClaimTemplate(pool)], its)
         assert res.scheduled_pod_count() == 10
         assert s.last_device_stats["engine"] != "remote"
+
+    def test_unreachable_service_counts_transport_reason(self):
+        from karpenter_tpu.operator import metrics as m
+        from karpenter_tpu.operator.metrics import Registry
+
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        its = {pool.name: benchmark_catalog(20)}
+        reg = Registry()
+        s = RemoteSolver("127.0.0.1:1", registry=reg)
+        s.solve([p.clone() for p in pods(10)], [ClaimTemplate(pool)], its)
+        assert reg.counter(m.SOLVER_REMOTE_FALLBACKS).value(
+            code="StatusCode.UNAVAILABLE", reason="transport") >= 1
+
+
+class TestSloTracing:
+    """The cross-boundary SLO surfaces (ISSUE 6): the client's round
+    trace id links the server-side request trace, request durations feed
+    the SLO histogram/quantiles, and a server-side failure lands in the
+    client fallback with the root-cause `reason` label."""
+
+    @pytest.fixture
+    def rec(self, tmp_path):
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs import devplane
+
+        obs.configure(enabled=True, dump_dir=str(tmp_path), capacity=8,
+                      dump_all=False)
+        obs.RECORDER.clear()
+        devplane.reset()
+        yield tmp_path
+        devplane.reset()
+        obs.reset()
+
+    def test_loopback_round_trip_links_traces_and_ticks_slo(self, rec):
+        from karpenter_tpu import obs
+        from karpenter_tpu.operator import metrics as m
+        from karpenter_tpu.operator.metrics import Registry
+
+        reg = Registry()
+        srv, port = serve(port=0, registry=reg)
+        try:
+            pool = NodePool(metadata=ObjectMeta(name="default"))
+            its = {pool.name: benchmark_catalog(20)}
+            s = RemoteSolver(f"127.0.0.1:{port}", registry=reg)
+            with obs.round_trace("provision", registry=reg) as tr:
+                res = s.solve([p.clone() for p in pods(20)],
+                              [ClaimTemplate(pool)], its)
+            assert res.scheduled_pod_count() == 20
+            assert s.last_device_stats["engine"] == "remote"
+            # the server opened its own round, linked by the client id
+            server_tr = obs.RECORDER.last("solver-service")
+            assert server_tr is not None
+            assert server_tr.root.attrs["client_trace"] == tr.trace_id
+            # SLO surfaces ticked: histogram, rolling quantiles, no burn
+            assert reg.histogram(m.SOLVER_REQUEST_SECONDS).count(
+                outcome="ok") >= 1
+            assert reg.gauge(m.SOLVER_REQUEST_QUANTILE).value(
+                slo="solver_service", q="p50") > 0
+            assert reg.counter(m.SLO_BUDGET_BURN).value(
+                slo="solver_service") == 0
+        finally:
+            srv.stop(grace=None)
+
+    def test_forced_server_error_falls_back_with_reason_label(self, rec):
+        from karpenter_tpu.operator import metrics as m
+        from karpenter_tpu.operator.logging import Logger
+        from karpenter_tpu.operator.metrics import Registry
+
+        reg = Registry()
+        srv, port = serve(port=0, registry=reg)
+        try:
+            def boom(args, key, max_bins):
+                raise RuntimeError("seeded server failure")
+
+            srv.solver_handler._solver._invoke = boom
+            pool = NodePool(metadata=ObjectMeta(name="default"))
+            its = {pool.name: benchmark_catalog(20)}
+            lines = []
+            s = RemoteSolver(f"127.0.0.1:{port}", registry=reg,
+                             log=Logger(sink=lines.append))
+            res = s.solve([p.clone() for p in pods(10)],
+                          [ClaimTemplate(pool)], its)
+            # rescued in-process, attributed to the server's root cause
+            assert res.scheduled_pod_count() == 10
+            assert s.last_device_stats["engine"] != "remote"
+            assert reg.counter(m.SOLVER_REMOTE_FALLBACKS).value(
+                code="StatusCode.INTERNAL", reason="RuntimeError") == 1
+            assert any("reason=RuntimeError" in ln for ln in lines)
+            # the server side recorded the error outcome + budget burn
+            assert reg.histogram(m.SOLVER_REQUEST_SECONDS).count(
+                outcome="error") == 1
+            assert reg.counter(m.SLO_BUDGET_BURN).value(
+                slo="solver_service") == 1
+        finally:
+            srv.stop(grace=None)

@@ -662,7 +662,106 @@ class TestObsRules:
         assert rules_of(suppressed) == ["GL401"]
 
     def test_rules_registered(self):
-        assert "GL401" in RULES and "GL402" in RULES
+        assert "GL401" in RULES and "GL402" in RULES and "GL403" in RULES
+
+
+class TestDevplaneRules:
+    """GL403: the compile-ledger / pad-waste / SLO hooks must stay
+    jit-unreachable — the device-plane telemetry is host-side machinery
+    (perf_counter deltas, shared ledgers, registry writes) exactly like
+    the spans GL401 guards."""
+
+    def test_positive_ledger_and_padding_in_jitted_function(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import devplane\n"
+            "\n"
+            "def kernel(x):\n"
+            "    devplane.record_dispatch('solve.kernel', ('k',), 0.1)\n"
+            "    devplane.record_padding('solve.bins', 10, 16)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL403", "GL403"]
+        assert "record_dispatch" in findings[0].message
+
+    def test_positive_bare_import_and_ledger_observe_spellings(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs.devplane import LEDGER, record_padding\n"
+            "\n"
+            "def kernel(x):\n"
+            "    record_padding('probe.rows', 3, 4)\n"
+            "    LEDGER.observe(x)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL403", "GL403"]
+
+    def test_positive_hook_reached_through_call_edge(self):
+        """Reachability carries GL403 across modules like GL401: the hook
+        hides in a helper the jitted entry calls."""
+        findings, _ = analyze_sources({
+            "pkg.a": (
+                "import jax\n"
+                "from pkg.b import helper\n"
+                "\n"
+                "def entry(x):\n"
+                "    return helper(x)\n"
+                "\n"
+                "fn = jax.jit(entry)\n"
+            ),
+            "pkg.b": (
+                "from karpenter_tpu.obs import devplane\n"
+                "\n"
+                "def helper(t):\n"
+                "    devplane.record_dispatch('probe.kernel', ('k',), 0.2)\n"
+                "    return t * 2\n"
+            ),
+        })
+        assert rules_of(findings) == ["GL403"]
+        assert findings[0].path.endswith("pkg/b.py")
+
+    def test_negative_host_side_dispatch_hook_not_flagged(self):
+        """The production pattern — time the jitted call host-side, then
+        record — never flags (models/solver.py, ops/consolidate.py,
+        parallel/mesh.py all hook exactly this way)."""
+        findings, _ = analyze_sources({"fx": (
+            "import time\n"
+            "import jax\n"
+            "from karpenter_tpu.obs import devplane\n"
+            "\n"
+            "def kernel(x):\n"
+            "    return x * 2\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+            "\n"
+            "def dispatch(args, key):\n"
+            "    devplane.record_padding('solve.bins', 10, 16)\n"
+            "    t0 = time.perf_counter()\n"
+            "    fut = fn(args)\n"
+            "    devplane.record_dispatch('solve.kernel', key, "
+            "time.perf_counter() - t0)\n"
+            "    return fut\n"
+        )})
+        assert findings == []
+
+    def test_negative_generic_observe_verb_not_flagged(self):
+        """`observe` on non-devplane receivers (a histogram, any metric)
+        stays quiet even inside jitted code — only the devplane receivers
+        make the verb GL403."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "\n"
+            "def kernel(x, hist):\n"
+            "    hist.observe(x.shape[0])\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel, static_argnames=('hist',))\n"
+        )})
+        assert findings == []
 
 
 # ---------------------------------------------------------------------------
@@ -773,11 +872,11 @@ class TestPackageGate:
         for rule in ("GL101", "GL102", "GL103", "GL104",
                      "GL201", "GL202", "GL203",
                      "GL301", "GL302", "GL303",
-                     "GL401", "GL402"):
+                     "GL401", "GL402", "GL403"):
             assert rule in out
         assert set(RULES) == {
             "GL101", "GL102", "GL103", "GL104",
             "GL201", "GL202", "GL203",
             "GL301", "GL302", "GL303",
-            "GL401", "GL402",
+            "GL401", "GL402", "GL403",
         }
