@@ -26,10 +26,13 @@ benchmark line prints, the fresh headline is compared against the newest
 committed BENCH_r*.json (same-engine records only — a CPU-ladder rescue
 is an environment event, not a regression) and, under `--consolidation`,
 a fresh `python -m perf --json 4` run is compared against the newest
-PERF_r*.json consolidation row. A >15% wall-clock regression on either
-prints a delta table on stderr and exits 3 — the record is still on
-stdout, so drivers always get their line. KARPENTER_BENCH_SENTINEL=0
-disables the gate (noisy shared boxes).
+PERF_r*.json consolidation row. `--multitenant` adds the multi-tenant
+fleet leg the same way: a fresh `python -m perf multitenant` run vs the
+newest committed multitenant row, on BOTH total wall clock and the
+concurrent worst-tenant p99 (baseline-gated — no committed row, no fresh
+run). A >15% regression on any leg prints a delta table on stderr and
+exits 3 — the record is still on stdout, so drivers always get their
+line. KARPENTER_BENCH_SENTINEL=0 disables the gate (noisy shared boxes).
 """
 
 from __future__ import annotations
@@ -236,8 +239,8 @@ def _baseline_headline():
             rec.get("metric"))
 
 
-def _baseline_consolidation() -> dict:
-    """{config: total_ms} consolidation rows of the newest PERF_r*.json."""
+def _perf_baseline_rows() -> dict:
+    """{config: row} of the newest PERF_r*.json results."""
     path = _newest("PERF_r*.json")
     if path is None:
         return {}
@@ -247,17 +250,17 @@ def _baseline_consolidation() -> dict:
     except (OSError, json.JSONDecodeError):
         return {}
     return {
-        r["config"]: float(r["total_ms"])
+        r["config"]: r
         for r in doc.get("results", ())
-        if isinstance(r, dict) and "total_ms" in r and "config" in r
+        if isinstance(r, dict) and "config" in r
     }
 
 
-def _fresh_consolidation() -> dict:
-    """{config: total_ms} from one fresh `python -m perf --json 4` run."""
+def _fresh_perf_rows(perf_args: list) -> dict:
+    """{config: row} from one fresh `python -m perf <args>` run."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "perf", "--json", "4"],
+            [sys.executable, "-m", "perf", *perf_args],
             capture_output=True, text=True, timeout=900,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
@@ -269,16 +272,78 @@ def _fresh_consolidation() -> dict:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if isinstance(rec, dict) and "total_ms" in rec and "config" in rec:
-            out[rec["config"]] = float(rec["total_ms"])
+        if isinstance(rec, dict) and "config" in rec:
+            out[rec["config"]] = rec
     return out
 
 
-def sentinel(record: dict, consolidation: bool = False) -> int:
+def _baseline_consolidation() -> dict:
+    """{config: total_ms} consolidation rows of the newest PERF_r*.json."""
+    return {
+        cfg: float(r["total_ms"])
+        for cfg, r in _perf_baseline_rows().items()
+        if "total_ms" in r and not cfg.startswith("multitenant")
+    }
+
+
+def _fresh_consolidation() -> dict:
+    """{config: total_ms} from one fresh `python -m perf --json 4` run."""
+    return {
+        cfg: float(r["total_ms"])
+        for cfg, r in _fresh_perf_rows(["--json", "4"]).items()
+        if "total_ms" in r
+    }
+
+
+def _multitenant_pairs() -> list:
+    """Sentinel pairs for the multi-tenant fleet row: wall clock AND the
+    concurrent worst-tenant p99 (a queueing/coalescing regression shows
+    up in p99 long before total wall clock moves). Baseline-gated like
+    the consolidation leg: no committed multitenant row, no fresh run."""
+    base = {
+        cfg: r for cfg, r in _perf_baseline_rows().items()
+        # a degraded committed row (client fallbacks — its latencies never
+        # crossed the wire) must not become the yardstick either
+        if cfg.startswith("multitenant") and "total_ms" in r
+        and not r.get("degraded")
+    }
+    if not base:
+        return []
+    pairs = []
+    fresh_rows = _fresh_perf_rows(["multitenant"])
+    for cfg, fresh in fresh_rows.items():
+        b = base.get(cfg)
+        if b is None or "total_ms" not in fresh:
+            continue
+        if fresh.get("degraded"):
+            # client fallbacks mean the latencies never crossed the
+            # service — not a number to gate on (or to pass on)
+            print(f"bench: multitenant sentinel: fresh {cfg} row is "
+                  "degraded (client fallbacks) — not compared",
+                  file=sys.stderr)
+            continue
+        pairs.append((cfg, float(b["total_ms"]), float(fresh["total_ms"])))
+        if "worst_p99_ms" in b and "worst_p99_ms" in fresh:
+            pairs.append((f"{cfg}:p99", float(b["worst_p99_ms"]),
+                          float(fresh["worst_p99_ms"])))
+    if not pairs:
+        # a committed baseline exists, the fresh run was paid, and NOTHING
+        # matched (config shape drift — different PERF_TENANTS etc.): a
+        # silently-green no-op gate is worse than a loud one
+        print("bench: multitenant sentinel: no fresh row matched the "
+              f"committed configs {sorted(base)} (fresh: "
+              f"{sorted(fresh_rows)}) — nothing was compared",
+              file=sys.stderr)
+    return pairs
+
+
+def sentinel(record: dict, consolidation: bool = False,
+             multitenant: bool = False) -> int:
     """Exit code for the regression gate: 0 clean/ungated, 3 on a >15%
-    headline-solve or consolidation regression vs the newest committed
-    records. Headline comparison is ENGINE-GATED (an axon baseline never
-    gates a cpu/native rescue run). KARPENTER_BENCH_SENTINEL=0 disables."""
+    headline-solve, consolidation, or multi-tenant-fleet regression vs
+    the newest committed records. Headline comparison is ENGINE-GATED (an
+    axon baseline never gates a cpu/native rescue run).
+    KARPENTER_BENCH_SENTINEL=0 disables."""
     if os.environ.get("KARPENTER_BENCH_SENTINEL", "1").strip().lower() in (
         "0", "false", "off", "no",
     ):
@@ -303,6 +368,8 @@ def sentinel(record: dict, consolidation: bool = False) -> int:
             for cfg, ms in _fresh_consolidation().items():
                 if cfg in base_c:
                     pairs.append((cfg, base_c[cfg], ms))
+    if multitenant:
+        pairs.extend(_multitenant_pairs())
     if not pairs:
         return 0
     regressed, lines = regression_table(pairs)
@@ -412,7 +479,8 @@ def main():
                 print(json.dumps(rec))
                 # the record is out; now gate on the committed baselines
                 sys.exit(sentinel(
-                    rec, consolidation="--consolidation" in sys.argv))
+                    rec, consolidation="--consolidation" in sys.argv,
+                    multitenant="--multitenant" in sys.argv))
     # every engine failed: still emit a parseable record (value null) with
     # the full diagnostic trail — never exit silent/nonzero without one
     print(

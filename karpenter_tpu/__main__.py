@@ -150,7 +150,11 @@ def main(argv=None) -> int:
     if target:
         from karpenter_tpu.service import RemoteSolver
 
-        solver = RemoteSolver(target)
+        # KARPENTER_SOLVER_TENANT opts this operator into the fleet
+        # service's streaming delta protocol (session mode): one full
+        # snapshot, then per-round deltas + per-tenant SLO on the server
+        solver = RemoteSolver(
+            target, tenant=os.environ.get("KARPENTER_SOLVER_TENANT") or None)
     env = Environment(
         clock=Clock(),  # wall-clock: budgets/TTLs run in real time
         sync=False,  # production batching window (1s idle / 10s max)

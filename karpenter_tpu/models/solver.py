@@ -78,21 +78,13 @@ class HostSolver(Solver):
 SHARD_MIN_WORK = 1 << 21
 
 
-def _packed_kernel(max_bins: int, use_pallas: bool = False, level_bits: int = 20,
-                   max_minv: int = 0):
-    """Jitted solve kernel with all outputs flattened into ONE int32
-    buffer: over a tunneled chip every separate device->host array pays a
-    full ~64ms round trip, which dominates these small tensors.
-
-    Module-level cache: solver instances come and go (every Environment
-    builds one), but the jit wrapper must be shared or each instance
-    re-traces the scan — the dominant cost of a test suite with hundreds
-    of environments."""
-    cached = _PACKED_KERNELS.get((max_bins, use_pallas, level_bits, max_minv))
-    if cached is not None:
-        return cached
-
-    import jax
+def _make_packed(max_bins: int, use_pallas: bool, level_bits: int,
+                 max_minv: int):
+    """The traceable packed-kernel body: solve_step with every output
+    flattened into ONE int32 buffer — shared by the plain jit wrapper
+    (:func:`_packed_kernel`) and the coalescer's vmapped batch wrapper
+    (:func:`_batched_solve_kernel`), so both compile the same program
+    modulo the batch axis."""
     import jax.numpy as jnp
 
     from karpenter_tpu.ops import kernels
@@ -108,12 +100,85 @@ def _packed_kernel(max_bins: int, use_pallas: bool = False, level_bits: int = 20
             out["F"].astype(jnp.int32).ravel(),
         ])
 
-    fn = jax.jit(packed)
+    return packed
+
+
+def _packed_kernel(max_bins: int, use_pallas: bool = False, level_bits: int = 20,
+                   max_minv: int = 0):
+    """Jitted solve kernel with all outputs flattened into ONE int32
+    buffer: over a tunneled chip every separate device->host array pays a
+    full ~64ms round trip, which dominates these small tensors.
+
+    Module-level cache: solver instances come and go (every Environment
+    builds one), but the jit wrapper must be shared or each instance
+    re-traces the scan — the dominant cost of a test suite with hundreds
+    of environments."""
+    cached = _PACKED_KERNELS.get((max_bins, use_pallas, level_bits, max_minv))
+    if cached is not None:
+        return cached
+
+    import jax
+
+    fn = jax.jit(_make_packed(max_bins, use_pallas, level_bits, max_minv))
     _PACKED_KERNELS[(max_bins, use_pallas, level_bits, max_minv)] = fn
     return fn
 
 
 _PACKED_KERNELS: dict = {}
+
+
+def _batched_solve_kernel(max_bins: int, level_bits: int = 20,
+                          max_minv: int = 0):
+    """jit(vmap(packed kernel)) over a stacked leading axis: the solver
+    service's coalesced dispatch — N concurrent tenants' same-shape solves
+    ride ONE device call and demux by row (the same vmap-over-snapshots
+    shape the batched consolidation probe compiles, ops/consolidate.py
+    ``_batched_kernel``). Static params thread statically for the same
+    reason the probe's do: solve_step's host-side reads cannot run on a
+    tracer."""
+    key = (max_bins, level_bits, max_minv, "vmap")
+    cached = _PACKED_KERNELS.get(key)
+    if cached is not None:
+        return cached
+
+    import jax
+
+    packed = _make_packed(max_bins, False, level_bits, max_minv)
+    fn = jax.jit(jax.vmap(packed))
+    _PACKED_KERNELS[key] = fn
+    return fn
+
+
+def batched_invoke(args_list, max_bins: int, level_bits: int = 20,
+                   max_minv: int = 0):
+    """Run N same-shape solve snapshots as one vmapped device dispatch;
+    returns one host output dict per input, each identical in layout to
+    ``TPUSolver._invoke``'s. Every dict in ``args_list`` must carry the
+    same keys with the same shapes/dtypes (the coalescer's bucket key
+    guarantees it); the padded batch rows repeat the last snapshot and are
+    dropped before demux. The pow-2 batch-axis waste and the compiled
+    family land in the device-plane telemetry (site/family
+    ``service.batch``)."""
+    n = len(args_list)
+    Np = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+    base = args_list[0]
+    stacked = {
+        k: np.stack([a[k] for a in args_list]
+                    + [args_list[-1][k]] * (Np - n))
+        for k in base
+    }
+    devplane.record_padding("service.batch", n, Np)
+    kfn = _batched_solve_kernel(max_bins, level_bits, max_minv)
+    t0 = time.perf_counter()
+    with obs.span("solve.kernel", kind="device", batch=n):
+        flat = np.asarray(kfn(stacked))
+    devplane.record_dispatch(
+        "service.batch",
+        (Np, max_bins, level_bits, max_minv,
+         tuple(sorted((k, v.shape[1:]) for k, v in stacked.items()))),
+        time.perf_counter() - t0)
+    return [TPUSolver._unpack(flat[i], args_list[i], max_bins)
+            for i in range(n)]
 
 
 # pods-per-solve below which the C++ engine beats the accelerator: the

@@ -228,9 +228,29 @@ class SloTracker:
     ``observe`` records one request: the duration lands in the
     ``karpenter_solver_request_seconds{outcome}`` histogram, the rolling
     window's p50/p95/p99 refresh their gauges, and a request that
-    violates the objective (outcome != ok, or duration above
-    ``latency_slo`` seconds when one is set) burns error budget.
-    ``snapshot()`` is the ``/slo`` endpoint's JSON body."""
+    violates the objective burns error budget. Violation = the outcome is
+    a failure (``error``/``rejected`` — ``resync`` is a protocol
+    renegotiation, not a failure), or the duration exceeds ``latency_slo``
+    seconds when one is set. ``snapshot()`` is the ``/slo`` endpoint's
+    JSON body.
+
+    Multi-tenant surfaces: passing ``tenant=`` additionally maintains a
+    per-tenant rolling window (quantile gauges and
+    ``karpenter_solver_tenant_requests_total{slo,tenant,outcome}`` carry
+    the tenant label) and the snapshot gains a ``tenants`` section — the
+    ISSUE-7 per-tenant SLO plane rides the same tracker rather than a new
+    one."""
+
+    # outcomes that do NOT burn error budget: a resync demand is the delta
+    # protocol renegotiating, not a failed request
+    _OK_OUTCOMES = ("ok", "resync")
+    # tenant sub-windows are bounded: tenant ids are client-supplied, and
+    # a fleet with ephemeral tenant names must not grow tracker memory
+    # without limit — the least-recently-observed tenant's window drops at
+    # the cap (its already-emitted metric series remain on the registry;
+    # operators with unbounded tenant churn should also bound scrape
+    # cardinality upstream)
+    _TENANT_CAP = 256
 
     def __init__(self, name: str, objective: float = 0.99,
                  latency_slo: float | None = None, window: int = 512):
@@ -242,19 +262,41 @@ class SloTracker:
         self._count = 0
         self._errors = 0
         self._burned = 0
+        # tenant -> {window, count, errors, burned} rolling sub-views
+        self._tenants: dict = {}
 
-    def observe(self, seconds: float, outcome: str = "ok", registry=None):
-        violated = outcome != "ok" or (
+    def observe(self, seconds: float, outcome: str = "ok", registry=None,
+                tenant: str | None = None):
+        violated = outcome not in self._OK_OUTCOMES or (
             self.latency_slo is not None and seconds > self.latency_slo
         )
+        t_samples = None
         with self._lock:
             self._window.append(float(seconds))
             self._count += 1
-            if outcome != "ok":
+            if outcome not in self._OK_OUTCOMES:
                 self._errors += 1
             if violated:
                 self._burned += 1
             samples = sorted(self._window)
+            if tenant is not None:
+                tv = self._tenants.pop(tenant, None)
+                if tv is None:
+                    if len(self._tenants) >= self._TENANT_CAP:
+                        # dict order is recency order (pop+reinsert below)
+                        self._tenants.pop(next(iter(self._tenants)))
+                    tv = {
+                        "window": deque(maxlen=256), "count": 0,
+                        "errors": 0, "burned": 0,
+                    }
+                self._tenants[tenant] = tv
+                tv["window"].append(float(seconds))
+                tv["count"] += 1
+                if outcome not in self._OK_OUTCOMES:
+                    tv["errors"] += 1
+                if violated:
+                    tv["burned"] += 1
+                t_samples = sorted(tv["window"])
         from karpenter_tpu.operator import metrics as _m
 
         reg = _resolve_registry(registry)
@@ -274,6 +316,21 @@ class SloTracker:
         )
         for label, v in self._quantiles(samples).items():
             q.set(v, slo=self.name, q=label)
+        if tenant is not None:
+            reg.counter(
+                _m.SOLVER_TENANT_REQUESTS,
+                "solver-service requests by tenant and outcome",
+            ).inc(slo=self.name, tenant=tenant, outcome=outcome)
+            for label, v in self._quantiles(t_samples).items():
+                q.set(v, slo=self.name, tenant=tenant, q=label)
+
+    def tenant_quantiles(self, tenant: str) -> dict:
+        """Rolling {p50,p95,p99} (seconds) of one tenant's sub-window —
+        the perf harness's per-tenant latency read."""
+        with self._lock:
+            tv = self._tenants.get(tenant)
+            samples = sorted(tv["window"]) if tv is not None else []
+        return self._quantiles(samples)
 
     @staticmethod
     def _quantiles(samples: list) -> dict:
@@ -289,7 +346,23 @@ class SloTracker:
         with self._lock:
             samples = sorted(self._window)
             count, errors, burned = self._count, self._errors, self._burned
+            tenants = {
+                t: (sorted(tv["window"]), tv["count"], tv["errors"],
+                    tv["burned"])
+                for t, tv in self._tenants.items()
+            }
         qs = self._quantiles(samples)
+        tenant_view = {}
+        for t, (t_samples, t_count, t_errors, t_burned) in tenants.items():
+            tq = self._quantiles(t_samples)
+            tenant_view[t] = {
+                "count": t_count,
+                "errors": t_errors,
+                "budget_burned": t_burned,
+                "p50_ms": round(tq["p50"] * 1000.0, 3),
+                "p95_ms": round(tq["p95"] * 1000.0, 3),
+                "p99_ms": round(tq["p99"] * 1000.0, 3),
+            }
         error_rate = errors / count if count else 0.0
         # budget burn: fraction of the window's allowed violations spent —
         # >1.0 means the objective is being missed
@@ -309,6 +382,7 @@ class SloTracker:
             "p50_ms": round(qs["p50"] * 1000.0, 3),
             "p95_ms": round(qs["p95"] * 1000.0, 3),
             "p99_ms": round(qs["p99"] * 1000.0, 3),
+            **({"tenants": tenant_view} if tenant_view else {}),
         }
 
 

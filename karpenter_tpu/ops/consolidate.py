@@ -121,9 +121,9 @@ from karpenter_tpu.ops.tensorize import (
     bucket as _bucket,
     device_basic_eligible,
     group_by_signature,
+    interned_signature,
     kernel_args,
     pad_to as pad,
-    pod_signature,
     tensorize,
     tensorize_existing,
 )
@@ -208,7 +208,7 @@ class DisruptionSnapshot:
             p0 = pods_g[0]
             sig = p0.__dict__.get("_sig_cache")
             if sig is None and plan is None:
-                sig = p0.__dict__["_sig_cache"] = pod_signature(p0)
+                sig = interned_signature(p0)
             if sig is not None:
                 self.sig_to_group.setdefault(sig, g)
         self.base = self._with_deleting(self.base)
@@ -302,10 +302,7 @@ class DisruptionSnapshot:
             return base
         base = base.copy()
         for p in self.deleting_pods:
-            sig = p.__dict__.get("_sig_cache")
-            if sig is None:
-                sig = p.__dict__["_sig_cache"] = pod_signature(p)
-            g = self.sig_to_group.get(sig)
+            g = self.sig_to_group.get(interned_signature(p))
             if g is not None:
                 base[g] += 1
         return base
@@ -384,10 +381,7 @@ class DisruptionSnapshot:
                     self.unprobeable.add(sn.provider_id)
                     self.col_by_pid.pop(sn.provider_id, None)
                 continue
-            sig = pod.__dict__.get("_sig_cache")
-            if sig is None:
-                sig = pod.__dict__["_sig_cache"] = pod_signature(pod)
-            g = self.sig_to_group.get(sig)
+            g = self.sig_to_group.get(interned_signature(pod))
             if g is None:
                 return False  # unseen scheduling shape: new group/vocab
             self.gidx_of[pod.uid] = g
@@ -496,11 +490,7 @@ class DisruptionSnapshot:
             rows.append(r)
         gsel = []
         for pods_g in sim_snap.groups:
-            p0 = pods_g[0]
-            sig = p0.__dict__.get("_sig_cache")
-            if sig is None:
-                sig = p0.__dict__["_sig_cache"] = pod_signature(p0)
-            g = self.sig_to_group.get(sig)
+            g = self.sig_to_group.get(interned_signature(pods_g[0]))
             if g is None:
                 return None
             gsel.append(g)

@@ -171,6 +171,31 @@ def pad_to(a: np.ndarray, shape: tuple, fill=0) -> np.ndarray:
     return out
 
 
+def splice_rows(dst: np.ndarray, rows, vals) -> np.ndarray:
+    """Row-splice ``vals`` into ``dst`` at ``rows`` along the leading axis —
+    the delta-maintenance primitive :meth:`ExistingSnapshot.apply_delta`
+    uses for dirty existing-node rows, exported so the solver service's
+    per-tenant bundle patching (service/session.py) applies the SAME
+    in-place row semantics to a cached tensor snapshot. Trailing shapes
+    must match; a mismatch raises rather than broadcasting silently."""
+    rows = np.atleast_1d(np.asarray(rows, dtype=np.intp))
+    vals = np.asarray(vals, dtype=dst.dtype)
+    if vals.shape[1:] != dst.shape[1:]:
+        raise ValueError(
+            f"splice_rows: trailing shape {vals.shape[1:]} != {dst.shape[1:]}"
+        )
+    if vals.ndim == 0 or vals.shape[0] != rows.shape[0]:
+        # a (1,...) vals against k rows would broadcast-replicate one row
+        # into every slot with no error — the silent-corruption class this
+        # primitive's checks exist to reject
+        raise ValueError(
+            f"splice_rows: {rows.shape[0]} rows != "
+            f"{vals.shape[0] if vals.ndim else 'scalar'} replacement rows"
+        )
+    dst[rows] = vals
+    return dst
+
+
 @dataclass
 class DeviceSnapshot:
     # vocabularies
@@ -353,17 +378,21 @@ class ExistingSnapshot:
         if dirty or added:
             mini = tensorize_existing(snap, dirty + added, device_plan,
                                       registry=registry)
-        for j, node in enumerate(dirty):
-            r = self.row_of[node.state_node.provider_id]
-            self.nodes[r] = node
-            self.e_avail[r] = mini.e_avail[j]
-            self.ge_ok[:, r] = mini.ge_ok[:, j]
-            self.e_npods[r] = mini.e_npods[j]
-            self.e_scnt[r] = mini.e_scnt[j]
-            self.e_decl[r] = mini.e_decl[j]
-            self.e_match[r] = mini.e_match[j]
-            self.e_aff[r] = mini.e_aff[j]
-            self.live[r] = True
+        if dirty:
+            rows = np.empty(len(dirty), dtype=np.intp)
+            for j, node in enumerate(dirty):
+                r = self.row_of[node.state_node.provider_id]
+                rows[j] = r
+                self.nodes[r] = node
+            nd = len(dirty)
+            splice_rows(self.e_avail, rows, mini.e_avail[:nd])
+            splice_rows(self.e_npods, rows, mini.e_npods[:nd])
+            splice_rows(self.e_scnt, rows, mini.e_scnt[:nd])
+            splice_rows(self.e_decl, rows, mini.e_decl[:nd])
+            splice_rows(self.e_match, rows, mini.e_match[:nd])
+            splice_rows(self.e_aff, rows, mini.e_aff[:nd])
+            self.ge_ok[:, rows] = mini.ge_ok[:, :nd]
+            self.live[rows] = True
         for pid in removed:
             r = self.row_of.get(pid)
             if r is None or not self.live[r]:
@@ -626,15 +655,6 @@ def pod_signature(pod) -> tuple:
     split into separate groups, which costs a few rows, not correctness).
     """
     ns = tuple(sorted(pod.node_selector.items()))
-    aff = ()
-    if pod.affinity is not None and pod.affinity.node_affinity is not None:
-        aff = tuple(
-            tuple(
-                (e.key, e.operator, tuple(e.values), e.min_values)
-                for e in term.match_expressions
-            )
-            for term in pod.affinity.node_affinity.required
-        )
     res = tuple(sorted(pod.requests.items()))
     cont = tuple(
         tuple(sorted((c.get("requests") or {}).items())) for c in pod.containers or ()
@@ -644,6 +664,24 @@ def pod_signature(pod) -> tuple:
         for c in pod.init_containers or ()
     )
     ovh = tuple(sorted(pod.overhead.items()))
+    aff, tol_sig, lbl, spread, pa = _signature_tail(pod)
+    return (ns, aff, res, cont, init, ovh, tol_sig, lbl, spread, pa)
+
+
+def _signature_tail(pod) -> tuple:
+    """The signature components ``Pod.clone`` deep-copies (so identity
+    memos can never share them): (aff, tol_sig, lbl, spread, pa). Shared
+    by :func:`pod_signature` and the batch path so both assemble the exact
+    same tuple shape."""
+    aff = ()
+    if pod.affinity is not None and pod.affinity.node_affinity is not None:
+        aff = tuple(
+            tuple(
+                (e.key, e.operator, tuple(e.values), e.min_values)
+                for e in term.match_expressions
+            )
+            for term in pod.affinity.node_affinity.required
+        )
     tol_sig = tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations))
     # labels: topology selectors match on them, so the waves compiler needs
     # label-homogeneous groups to reason per-representative
@@ -680,7 +718,7 @@ def pod_signature(pod) -> tuple:
                  tuple(sorted(w.pod_affinity_term.namespaces)), "pref")
                 for w in block.preferred
             )
-    return (ns, aff, res, cont, init, ovh, tol_sig, lbl, spread, pa)
+    return (aff, tol_sig, lbl, spread, pa)
 
 
 def _selector_sig(sel):
@@ -692,15 +730,119 @@ def _selector_sig(sel):
     )
 
 
+# process-wide signature intern pool: equal signatures collapse to ONE
+# canonical tuple, so every downstream dict keyed on signatures
+# (sig_to_group, the group-row cache, group_by_signature itself) compares
+# by identity first instead of walking two deep nested tuples. Bounded:
+# a signature-vocabulary blowup (adversarial label churn) clears the pool
+# rather than growing without limit — interning is an optimization, never
+# a correctness dependency.
+_SIG_INTERN: dict = {}
+_SIG_INTERN_MAX = 8192
+
+
+def intern_signature(sig: tuple) -> tuple:
+    """The canonical instance of an equal signature tuple."""
+    canon = _SIG_INTERN.get(sig)
+    if canon is None:
+        if len(_SIG_INTERN) >= _SIG_INTERN_MAX:
+            _SIG_INTERN.clear()
+        _SIG_INTERN[sig] = canon = sig
+    return canon
+
+
+def interned_signature(pod) -> tuple:
+    """``pod_signature`` with the ``_sig_cache`` memo and the intern pool
+    applied — the per-pod entry point every consumer outside the batch path
+    should use (ops/consolidate.py's sig_to_group registrations do)."""
+    d = pod.__dict__
+    sig = d.get("_sig_cache")
+    if sig is None:
+        sig = d["_sig_cache"] = intern_signature(pod_signature(pod))
+    return sig
+
+
+def batch_signatures(pods) -> list:
+    """Signatures for one tensorize batch at once (ROADMAP's ~35 µs/pod
+    first-sight interning burn-down): replica stamps share their spec
+    sub-objects by reference (a Deployment stamps every replica from one
+    template; ``Pod.clone`` keeps ``requests``/``node_selector``/
+    ``containers`` shared), so per-CALL identity memos skip re-tupling
+    those components per pod, and the finished tuple lands in the
+    process-wide intern pool so later rounds hash one canonical object per
+    distinct shape. Components clones deep-copy (affinity, tolerations,
+    labels, spread) are recomputed per pod — they are empty on the burst
+    shapes that dominate, and correctness never depends on sharing."""
+    out = [None] * len(pods)
+    ns_m: dict = {}
+    res_m: dict = {}
+    cont_m: dict = {}
+    init_m: dict = {}
+    ovh_m: dict = {}
+    for i, pod in enumerate(pods):
+        d = pod.__dict__
+        sig = d.get("_sig_cache")
+        if sig is not None:
+            out[i] = sig
+            continue
+        # empty components skip the memo outright: per-pod default
+        # containers (a fresh empty list each) would miss on every id and
+        # pay the bookkeeping for nothing
+        sel = pod.node_selector
+        if not sel:
+            ns = ()
+        else:
+            ns = ns_m.get(id(sel))
+            if ns is None:
+                ns = ns_m[id(sel)] = tuple(sorted(sel.items()))
+        req = pod.requests
+        if not req:
+            res = ()
+        else:
+            res = res_m.get(id(req))
+            if res is None:
+                res = res_m[id(req)] = tuple(sorted(req.items()))
+        if not pod.containers:
+            cont = ()
+        else:
+            cont = cont_m.get(id(pod.containers))
+            if cont is None:
+                cont = cont_m[id(pod.containers)] = tuple(
+                    tuple(sorted((c.get("requests") or {}).items()))
+                    for c in pod.containers
+                )
+        if not pod.init_containers:
+            init = ()
+        else:
+            init = init_m.get(id(pod.init_containers))
+            if init is None:
+                init = init_m[id(pod.init_containers)] = tuple(
+                    tuple(sorted((c.get("requests") or {}).items()))
+                    for c in pod.init_containers
+                )
+        if not pod.overhead:
+            ovh = ()
+        else:
+            ovh = ovh_m.get(id(pod.overhead))
+            if ovh is None:
+                ovh = ovh_m[id(pod.overhead)] = tuple(
+                    sorted(pod.overhead.items()))
+        # the remaining components are pod-owned copies (clone deep-copies
+        # them): one shared tail builder keeps both paths assembling the
+        # exact same tuple shape
+        aff, tol_sig, lbl, spread, pa = _signature_tail(pod)
+        sig = intern_signature(
+            (ns, aff, res, cont, init, ovh, tol_sig, lbl, spread, pa))
+        out[i] = d["_sig_cache"] = sig
+    return out
+
+
 def group_by_signature(pods) -> list:
     """list[list[Pod]] grouped by scheduling signature (unsorted)."""
     by_sig: dict = {}
     get_group = by_sig.get
-    for pod in pods:
-        d = pod.__dict__
-        sig = d.get("_sig_cache")
-        if sig is None:
-            sig = d["_sig_cache"] = pod_signature(pod)
+    sigs = batch_signatures(pods)
+    for pod, sig in zip(pods, sigs):
         grp = get_group(sig)
         if grp is None:
             by_sig[sig] = [pod]
@@ -974,9 +1116,7 @@ def _tensorize(pods, templates, instance_types_by_pool, daemon_overhead,
                 reqs = reqs.copy()
                 reqs.add(*dg.extra_reqs)
             group_reqs.append(reqs)
-            sig = rep.__dict__.get("_sig_cache")
-            if sig is None:
-                sig = rep.__dict__["_sig_cache"] = pod_signature(rep)
+            sig = interned_signature(rep)
             # waves extra reqs (zone pins/IN-sets) key the row alongside
             # the spec signature: the same deployment template lands in
             # different zone subgroups with different packed rows

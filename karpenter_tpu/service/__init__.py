@@ -9,8 +9,23 @@ wire. Everything else — tensorize, decode, validation, the host fallback —
 stays host-side, so the payload is exactly the kernel's tensor snapshot
 and the reply is its packed outputs (the same seam `TPUSolver._invoke`
 already is in-process).
+
+Since ISSUE 7 the service is multi-tenant: `RemoteSolver(..., tenant=)`
+speaks the streaming delta protocol against per-tenant server-side
+snapshot caches (session.py), concurrent same-shape solves coalesce into
+one device dispatch (coalesce.py), and per-tenant budgets/SLO surfaces
+ride the PR-6 telemetry plane — deploy/README.md "Multi-tenant solver
+service" documents the wire format and knobs.
 """
 
+from karpenter_tpu.service.coalesce import Coalescer
+from karpenter_tpu.service.session import SessionRegistry, TenantSession
 from karpenter_tpu.service.solver_service import RemoteSolver, serve
 
-__all__ = ["RemoteSolver", "serve"]
+__all__ = [
+    "Coalescer",
+    "RemoteSolver",
+    "SessionRegistry",
+    "TenantSession",
+    "serve",
+]

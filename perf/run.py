@@ -21,6 +21,13 @@ Usage:
                                    # waste, cold compiles — run it in a
                                    # FRESH interpreter (virtual devices
                                    # must be set before jax initializes)
+    python -m perf multitenant     # N concurrent synthetic clusters
+                                   # (PERF_TENANTS=8) round-robin through
+                                   # one solver service: per-tenant
+                                   # p50/p99, p99 ratio vs single-tenant,
+                                   # coalesce rate, session-cache hit
+                                   # rate, delta accounting, seeded
+                                   # isolation verdict
 
 One JSON line per result: {config, pods, types, ms, pods_per_sec, nodes,
 ffd_nodes, node_overhead_pct, floor_ok}. `ffd_nodes` is the host FFD
@@ -350,6 +357,343 @@ def run_multichip(trace: bool = False, n_devices: int = 8,
     print(json.dumps(out))
 
 
+def run_multitenant(n_tenants: int | None = None, rounds: int | None = None,
+                    pods_per_round: int | None = None, emit: bool = True):
+    """The ISSUE-7 multi-tenant fleet row: N concurrent synthetic clusters
+    (PERF_TENANTS, default 8) sustain round-robin reconcile loops through
+    ONE solver service — session mode, streaming deltas, coalesced
+    dispatch — and the row reports per-tenant p50/p99 (server-side SLO
+    windows), the p99 ratio vs a single-tenant run on the same warm
+    server, the coalesce rate, the session-cache hit rate, the delta
+    accounting (steady state must ship deltas only: full uploads ==
+    tenants, zero forced resyncs), and a seeded isolation verdict — every
+    tenant's per-round claim compositions diffed against its solo
+    in-process oracle. Wired into bench.py's regression sentinel via
+    ``--multitenant``."""
+    import random
+    import threading
+
+    n_tenants = n_tenants or int(os.environ.get("PERF_TENANTS", "8"))
+    rounds = rounds or int(os.environ.get("PERF_TENANT_ROUNDS", "3"))
+    pods_per_round = pods_per_round or int(
+        os.environ.get("PERF_TENANT_PODS", "40"))
+    config = f"multitenant-{n_tenants}x{rounds}x{pods_per_round}"
+    try:
+        import grpc  # noqa: F401
+        import jax  # noqa: F401
+    except Exception as e:
+        row = {"config": config, "skipped": f"needs grpc+jax: {e}"}
+        if emit:
+            print(json.dumps(row))
+        return row
+
+    import socket
+    import subprocess
+    import urllib.request
+
+    from karpenter_tpu.api.nodepool import NodePool
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+    from karpenter_tpu.models import ClaimTemplate, TPUSolver
+    from karpenter_tpu.operator.metrics import Registry
+    from karpenter_tpu.service import RemoteSolver
+    from karpenter_tpu.service.solver_service import (
+        _METHOD_REGISTER,
+        _GRPC_OPTS,
+        _pack,
+    )
+
+    # the device plane runs as its OWN process — the two-plane deployment
+    # this row models. Co-locating it with N client threads would measure
+    # one interpreter's GIL contention, not the service: server-side
+    # latency comes back through the /slo endpoint, counters through
+    # /metrics (exactly the surfaces an operator scrapes).
+    def _free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    grpc_port, metrics_port = _free_port(), _free_port()
+    child_env = dict(os.environ)
+    child_env["XLA_FLAGS"] = ""  # no virtual-mesh thread pools in the plane
+    child_env.setdefault("KARPENTER_COALESCE_WINDOW_MS", "4")
+    server_proc = subprocess.Popen(
+        [sys.executable, "-m", "karpenter_tpu.service.solver_service",
+         "--host", "127.0.0.1", "--port", str(grpc_port),
+         "--metrics-port", str(metrics_port)],
+        env=child_env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    target = f"127.0.0.1:{grpc_port}"
+    # readiness: a Register round trip proves the serving stack is up
+    import grpc as _grpc
+
+    chan = _grpc.insecure_channel(target, options=_GRPC_OPTS)
+    ping = chan.unary_unary(_METHOD_REGISTER, request_serializer=None,
+                            response_deserializer=None)
+    deadline = time.monotonic() + 90.0
+    while True:
+        try:
+            # wait_for_ready: block on connectivity instead of fail-fast
+            # polling (a refused pre-start dial would park the channel in
+            # gRPC's exponential connection backoff)
+            ping(_pack({}, {"tenant": "readiness-probe"}),
+                 timeout=10.0, wait_for_ready=True)
+            break
+        except _grpc.RpcError:
+            if time.monotonic() > deadline:
+                server_proc.kill()
+                row = {"config": config,
+                       "skipped": "solver service failed to start"}
+                if emit:
+                    print(json.dumps(row))
+                return row
+            time.sleep(0.5)
+
+    def _scrape(path: str) -> str:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}{path}", timeout=10
+        ) as r:
+            return r.read().decode()
+
+    def _prom(text: str, name: str) -> list:
+        """[(labels dict, value)] for one exposition family."""
+        out = []
+        for line in text.splitlines():
+            if not line.startswith(name):
+                continue
+            rest = line[len(name):]
+            labels = {}
+            if rest.startswith("{"):
+                inner, rest = rest[1:].split("}", 1)
+                for kv in inner.split(","):
+                    if kv:
+                        k, v = kv.split("=", 1)
+                        labels[k] = v.strip('"')
+            elif not rest.startswith(" "):
+                continue  # a longer family name sharing the prefix
+            out.append((labels, float(rest.strip())))
+        return out
+
+    GIB = 2**30
+    reg = Registry()  # client-side families (fallbacks, retries, bytes)
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    catalog = benchmark_catalog(40)
+    its = {pool.name: catalog}
+    templates = [ClaimTemplate(pool)]
+
+    def workload(seed: int, r: int) -> list:
+        rng = random.Random(seed * 1009 + r)
+        out = []
+        for i in range(pods_per_round):
+            out.append(Pod(
+                metadata=ObjectMeta(name=f"t{seed}-r{r}-p{i}"),
+                requests={"cpu": float(rng.choice([0.25, 0.5, 1.0, 2.0])),
+                          "memory": float(rng.choice([1, 2, 4])) * GIB},
+            ))
+        return out
+
+    # reconcile cadence: real clusters think between rounds (watch events,
+    # budgets, TTLs); back-to-back solves would measure pure CPU contention
+    # instead of the service's queueing/coalescing behavior
+    think_s = float(os.environ.get("PERF_TENANT_THINK_MS", "200")) / 1000.0
+
+    def reconcile_loop(tenant: str, seed: int, sizes: dict,
+                       stagger: float = 0.0):
+        solver = RemoteSolver(target, registry=reg, tenant=tenant)
+        per_round = []
+        # real fleets are not phase-locked: each cluster's reconcile
+        # cadence has its own phase (stagger) and jitter, so collisions
+        # are the coalescer's occasional opportunity, not a lockstep storm
+        rng = random.Random(seed ^ 0x5EED)
+        if stagger:
+            time.sleep(stagger * rng.random())
+        for r in range(rounds):
+            res = solver.solve([p.clone() for p in workload(seed, r)],
+                               templates, its)
+            per_round.append(sorted(len(c.pods) for c in res.new_claims))
+            if think_s and r + 1 < rounds:
+                time.sleep(think_s * (0.75 + 0.5 * rng.random()))
+        sizes[tenant] = (per_round, solver.session_stats)
+
+    def run_fleet(prefix: str, sizes: dict, errors: dict | None = None):
+        # a dead tenant thread must surface as a LOUD degraded row, not as
+        # a KeyError traceback with no JSON at all — capture per-thread
+        # failures instead of leaking them to the default excepthook
+        def guarded(tenant, seed):
+            try:
+                reconcile_loop(tenant, seed, sizes, think_s)
+            except Exception as e:  # noqa: BLE001 — reported in the row
+                if errors is None:  # warm phase: keep the loud traceback
+                    raise
+                errors[tenant] = f"{type(e).__name__}: {e}"
+
+        threads = [
+            threading.Thread(target=guarded, args=(f"{prefix}-{i}", i))
+            for i in range(n_tenants)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return (time.perf_counter() - t0) * 1000.0
+
+    try:
+        from karpenter_tpu.operator import metrics as m
+
+        # snapshot BEFORE the warm/baseline phases: a fallback during the
+        # single-tenant baseline also poisons the row (single_p99 would
+        # describe requests that never crossed the wire), so the degraded
+        # flag must cover every phase the row's numbers come from
+        fallbacks0 = reg.counter(m.SOLVER_REMOTE_FALLBACKS).total()
+        # warm the compile families — solo AND concurrent (the coalesced
+        # batch buckets are their own executables) — so the measured phase
+        # is the steady state every tenant rides
+        reconcile_loop("warm", 999, {})
+        run_fleet("warm", {})
+        # single-tenant baseline on the same warm server — repeated once
+        # per tenant so its p99 pools the SAME sample count the
+        # worst-tenant max is drawn from (n_tenants x rounds): a
+        # 3-sample baseline max against a 24-sample concurrent max would
+        # read >1 on pure iid noise, flaking the ratio bar on loaded
+        # boxes without any real contention
+        single: dict = {}
+        for _ in range(n_tenants):
+            reconcile_loop("single", 998, single)
+
+        # measured-phase deltas: warm-up traffic must not pollute the
+        # global coalesce counters (per-tenant families key on the
+        # measured tenants' names, so they need no baseline)
+        pre = _scrape("/metrics")
+        reqs0 = sum(v for _, v in _prom(
+            pre, "karpenter_solver_coalesce_batch_size_sum"))
+        coalesced0 = sum(v for _, v in _prom(
+            pre, "karpenter_solver_coalesced_requests_total"))
+        sizes: dict = {}
+        fleet_errors: dict = {}
+        total_ms = run_fleet("tenant", sizes, errors=fleet_errors)
+        missing = [f"tenant-{i}" for i in range(n_tenants)
+                   if f"tenant-{i}" not in sizes]
+        if missing:
+            row = {"config": config, "degraded": True,
+                   "error": {t: fleet_errors.get(t, "thread died without "
+                             "reporting") for t in missing}}
+            if emit:
+                print(json.dumps(row))
+            return row
+        # a degraded service silently rescues solves in-process on the
+        # CLIENT — the isolation diff would still pass (in-process output
+        # trivially matches the in-process oracle) and the /slo latencies
+        # would describe requests that never happened, so the row must
+        # say whether its numbers actually crossed the wire
+        fallbacks = int(
+            reg.counter(m.SOLVER_REMOTE_FALLBACKS).total() - fallbacks0)
+
+        # seeded isolation: every tenant's per-round claim compositions
+        # must equal its solo in-process oracle's (zero cross-tenant bleed)
+        isolation_ok = True
+        for i in range(n_tenants):
+            oracle = TPUSolver()
+            for r in range(rounds):
+                ref = oracle.solve([p.clone() for p in workload(i, r)],
+                                   templates, its)
+                got = sizes[f"tenant-{i}"][0][r]
+                if got != sorted(len(c.pods) for c in ref.new_claims):
+                    isolation_ok = False
+
+        # the service's own SLO plane answers the latency questions — the
+        # same /slo JSON an operator's dashboard reads
+        slo = json.loads(_scrape("/slo"))
+        tenants_view = slo["slo"]["solver_service"].get("tenants", {})
+        per_tenant = {
+            t: {
+                "p50": tenants_view.get(t, {}).get("p50_ms", 0.0),
+                "p95": tenants_view.get(t, {}).get("p95_ms", 0.0),
+                "p99": tenants_view.get(t, {}).get("p99_ms", 0.0),
+            }
+            for t in (f"tenant-{i}" for i in range(n_tenants))
+        }
+        worst_p99 = max(q["p99"] for q in per_tenant.values())
+        single_p99 = tenants_view.get("single", {}).get("p99_ms", 0.0)
+        deltas = {"full_uploads": 0, "delta_rounds": 0, "resyncs": 0,
+                  "retries": 0, "bytes_full": 0, "bytes_delta": 0}
+        for _, stats in sizes.values():
+            for k in deltas:
+                deltas[k] += stats.get(k, 0)
+        post = _scrape("/metrics")
+        total_reqs = sum(v for _, v in _prom(
+            post, "karpenter_solver_coalesce_batch_size_sum")) - reqs0
+        coalesced = sum(v for _, v in _prom(
+            post, "karpenter_solver_coalesced_requests_total")) - coalesced0
+        measured = {f"tenant-{i}" for i in range(n_tenants)}
+        hits = sum(
+            v for lb, v in _prom(
+                post, "karpenter_solver_session_cache_hits_total")
+            if lb.get("tenant") in measured and lb.get("kind") == "delta")
+        stores = sum(
+            v for lb, v in _prom(
+                post, "karpenter_solver_session_cache_stores_total")
+            if lb.get("tenant") in measured)
+        evictions = sum(
+            v for lb, v in _prom(
+                post, "karpenter_solver_session_cache_evictions_total")
+            if lb.get("tenant") in measured)
+        bleed = sum(
+            v for lb, v in _prom(post, "karpenter_solver_bleed_checks_total")
+            if lb.get("outcome") == "bleed")
+        if bleed:
+            isolation_ok = False
+        row = {
+            "config": config,
+            "tenants": n_tenants,
+            "rounds": rounds,
+            "total_ms": round(total_ms, 2),
+            "single_p99_ms": round(single_p99, 3),
+            "worst_p99_ms": round(worst_p99, 3),
+            # the acceptance bar: concurrent p99 within 2x single-tenant
+            "p99_ratio": round(worst_p99 / max(single_p99, 1e-9), 3),
+            "per_tenant": per_tenant,
+            "coalesce": {
+                "requests": int(total_reqs),
+                "coalesced": int(coalesced),
+                "rate": round(coalesced / total_reqs, 4) if total_reqs else 0.0,
+            },
+            "session_cache": {
+                "hits": int(hits),
+                "stores": int(stores),
+                "hit_rate": round(hits / (hits + stores), 4)
+                if hits + stores else 0.0,
+                "evictions": int(evictions),
+            },
+            # steady state must ship deltas only: full resync count ==
+            # initial uploads (one per tenant) + forced-gap events (none)
+            "deltas": deltas,
+            "deltas_only_steady_state": (
+                deltas["full_uploads"] == n_tenants
+                and deltas["resyncs"] == 0
+            ),
+            "isolation_ok": isolation_ok,
+            # >0 means some solves never crossed the service: the latency
+            # fields describe a degraded run (the sentinel skips it); a
+            # zero single-tenant p99 means the baseline itself never hit
+            # the server, which makes p99_ratio meaningless
+            "client_fallbacks": fallbacks,
+            "degraded": fallbacks > 0 or single_p99 <= 0,
+        }
+        if emit:
+            print(json.dumps(row))
+        return row
+    finally:
+        server_proc.terminate()
+        try:
+            server_proc.wait(timeout=10)
+        except Exception:
+            server_proc.kill()
+
+
 def run_grid(min_values: int | None = None, trace: bool = False):
     """The reference benchmark grid: pods x 400 types, diverse 1/6 mix
     (scheduling_benchmark_test.go:77-97, :234-248); its enforced floor is
@@ -392,6 +736,12 @@ def main():
         return
     if args == ["multichip"]:
         run_multichip(trace=breakdown)
+        return
+    if args == ["multitenant"]:
+        # (no --json trace embedding here: the service runs as its own
+        # process, so its round traces live in the server's trace dir and
+        # its latency story comes back through /slo, not the local tracer)
+        run_multitenant()
         return
     picks = {int(a) for a in args} if args else {1, 2, 3, 4, 5}
     if 1 in picks:

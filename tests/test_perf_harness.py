@@ -185,3 +185,66 @@ class TestGridProvisioningBench:
         # BASELINE config)
         oracle, _ = _solve_timed(HostSolver(), pods, [pool], catalog)
         assert oracle.scheduled_pod_count() == res2.scheduled_pod_count()
+
+
+class TestMultiTenantSentinelLeg:
+    """bench.py's --multitenant regression leg: baseline-gated (no
+    committed multitenant row, no fresh multi-minute run) and pairing
+    BOTH total wall clock and the concurrent worst-tenant p99."""
+
+    def test_no_baseline_row_skips_the_fresh_run(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
+            "4-consolidation-300-underutilized": {"total_ms": 2300.0},
+        })
+        ran = []
+        monkeypatch.setattr(bench, "_fresh_perf_rows",
+                            lambda args: ran.append(args) or {})
+        assert bench._multitenant_pairs() == []
+        assert ran == []  # the fresh run was never paid
+
+    def test_pairs_total_and_p99(self, monkeypatch):
+        import bench
+
+        cfg = "multitenant-8x3x24"
+        monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
+            cfg: {"config": cfg, "total_ms": 1000.0, "worst_p99_ms": 20.0},
+        })
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args: {
+            cfg: {"config": cfg, "total_ms": 1100.0, "worst_p99_ms": 50.0},
+        })
+        pairs = bench._multitenant_pairs()
+        assert (cfg, 1000.0, 1100.0) in pairs
+        assert (f"{cfg}:p99", 20.0, 50.0) in pairs
+        # a >15% p99 regression trips the shared table
+        regressed, _ = bench.regression_table(pairs)
+        assert regressed
+
+    def test_degraded_fresh_row_not_compared(self, monkeypatch, capsys):
+        import bench
+
+        cfg = "multitenant-8x3x24"
+        monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
+            cfg: {"config": cfg, "total_ms": 1000.0, "worst_p99_ms": 20.0},
+        })
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args: {
+            cfg: {"config": cfg, "total_ms": 9000.0, "worst_p99_ms": 900.0,
+                  "degraded": True},
+        })
+        assert bench._multitenant_pairs() == []
+        err = capsys.readouterr().err
+        assert "degraded" in err  # loud skip, never a silently-green gate
+
+    def test_config_shape_drift_warns(self, monkeypatch, capsys):
+        import bench
+
+        monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
+            "multitenant-8x3x24": {"total_ms": 1000.0},
+        })
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args: {
+            "multitenant-4x2x24": {"config": "multitenant-4x2x24",
+                                   "total_ms": 500.0},
+        })
+        assert bench._multitenant_pairs() == []
+        assert "nothing was compared" in capsys.readouterr().err

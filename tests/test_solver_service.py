@@ -96,17 +96,41 @@ class TestRemoteFallback:
         assert res.scheduled_pod_count() == 10
         assert s.last_device_stats["engine"] != "remote"
 
-    def test_unreachable_service_counts_transport_reason(self):
+    def test_unreachable_service_counts_retryable_transport_reason(
+            self, monkeypatch):
+        """An UNAVAILABLE dispatch gets exactly one jittered retry, then
+        falls back labeled `transport-retryable` (distinguishing a
+        flapping service from a hard transport fault or a server error)."""
         from karpenter_tpu.operator import metrics as m
         from karpenter_tpu.operator.metrics import Registry
 
+        monkeypatch.setenv("KARPENTER_SOLVER_RETRY_MS", "1")
         pool = NodePool(metadata=ObjectMeta(name="default"))
         its = {pool.name: benchmark_catalog(20)}
         reg = Registry()
         s = RemoteSolver("127.0.0.1:1", registry=reg)
         s.solve([p.clone() for p in pods(10)], [ClaimTemplate(pool)], its)
         assert reg.counter(m.SOLVER_REMOTE_FALLBACKS).value(
-            code="StatusCode.UNAVAILABLE", reason="transport") >= 1
+            code="StatusCode.UNAVAILABLE", reason="transport-retryable") >= 1
+        # the bounded retry is visible on the scrape and in session_stats
+        assert reg.counter(m.SOLVER_REMOTE_RETRIES).value(
+            code="StatusCode.UNAVAILABLE") >= 1
+        assert s.session_stats["retries"] >= 1
+
+    def test_retry_disabled_keeps_hard_transport_reason(self, monkeypatch):
+        from karpenter_tpu.operator import metrics as m
+        from karpenter_tpu.operator.metrics import Registry
+
+        monkeypatch.setenv("KARPENTER_SOLVER_RETRY", "0")
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        its = {pool.name: benchmark_catalog(20)}
+        reg = Registry()
+        s = RemoteSolver("127.0.0.1:1", registry=reg)
+        s.solve([p.clone() for p in pods(10)], [ClaimTemplate(pool)], its)
+        # still retryable-coded, so the reason names it; no retry happened
+        assert reg.counter(m.SOLVER_REMOTE_FALLBACKS).value(
+            code="StatusCode.UNAVAILABLE", reason="transport-retryable") >= 1
+        assert s.session_stats["retries"] == 0
 
 
 class TestSloTracing:

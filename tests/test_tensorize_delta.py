@@ -26,6 +26,7 @@ from karpenter_tpu.operator.metrics import (
 )
 from karpenter_tpu.ops.tensorize import (
     STATS,
+    splice_rows,
     tensorize,
     tensorize_existing,
 )
@@ -152,6 +153,30 @@ def run_sequence(seed, steps=8):
             esnap.apply_delta(snap, dirty=[en])
         assert_parity(snap, esnap, enode_by_pid, seed, step)
     return esnap
+
+
+class TestSpliceRows:
+    def test_row_count_mismatch_raises_not_broadcasts(self):
+        """A (1, W) vals against k rows would broadcast-replicate one row
+        into every slot with no numpy error — the silent-corruption class
+        this primitive exists to reject."""
+        dst = np.arange(12, dtype=np.float32).reshape(6, 2)
+        before = dst.copy()
+        with pytest.raises(ValueError, match="replacement rows"):
+            splice_rows(dst, [0, 2, 4], np.full((1, 2), 9.0))
+        with pytest.raises(ValueError, match="replacement rows"):
+            splice_rows(np.zeros(4), [1, 3], np.float64(7.0))  # scalar vals
+        assert np.array_equal(dst, before)
+
+    def test_trailing_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="trailing shape"):
+            splice_rows(np.zeros((4, 3)), [0], np.zeros((1, 2)))
+
+    def test_scalar_row_with_matching_val_splices(self):
+        dst = np.zeros((4, 2), dtype=np.float32)
+        splice_rows(dst, 2, np.full((1, 2), 5.0))
+        assert dst[2].tolist() == [5.0, 5.0]
+        assert not dst[[0, 1, 3]].any()
 
 
 class TestDeltaFullParity:
