@@ -30,7 +30,15 @@ PERF_r*.json consolidation row. `--multitenant` adds the multi-tenant
 fleet leg the same way: a fresh `python -m perf multitenant` run vs the
 newest committed multitenant row, on BOTH total wall clock and the
 concurrent worst-tenant p99 (baseline-gated — no committed row, no fresh
-run). A >15% regression on any leg prints a delta table on stderr and
+run). `--multichip` adds the partitioned mesh leg: a fresh `python -m
+perf multichip` run must show parity=exact on the gate row (the merged
+partitioned end state bit-identical to its unsharded oracle), sharded
+<= 0.8x unsharded on real accelerator meshes (the virtual-CPU mesh is
+exempted to parity-only), zero host-routed pods on the 500k burst row,
+and its sharded_ms is regression-compared against the newest committed
+MULTICHIP_r*.json (both the legacy dryrun-tail schema and the new
+perf-row schema parse). A >15% regression on any leg prints a delta
+table on stderr and
 exits 3 — the record is still on stdout, so drivers always get their
 line. KARPENTER_BENCH_SENTINEL=0 disables the gate (noisy shared boxes).
 """
@@ -77,8 +85,12 @@ def build_workload(n_pods=50_000, n_types=500):
     pods = []
     for i in range(n_pods):
         req, sel = shapes[i % len(shapes)]
+        # shared-by-reference spec sub-objects, exactly like clone-stamped
+        # replicas (Pod.clone shares requests/node_selector): the burst's
+        # first-sight signature pass dedups by identity instead of paying
+        # a per-pod hash
         pods.append(
-            Pod(metadata=ObjectMeta(name=f"p{i}"), requests=req, node_selector=dict(sel))
+            Pod(metadata=ObjectMeta(name=f"p{i}"), requests=req, node_selector=sel)
         )
     templates = [ClaimTemplate(p) for p in pools]
     its = {p.name: catalog for p in pools}
@@ -256,13 +268,14 @@ def _perf_baseline_rows() -> dict:
     }
 
 
-def _fresh_perf_rows(perf_args: list) -> dict:
+def _fresh_perf_rows(perf_args: list, env: dict | None = None) -> dict:
     """{config: row} from one fresh `python -m perf <args>` run."""
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "perf", *perf_args],
             capture_output=True, text=True, timeout=900,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, **env} if env else None,
         )
     except subprocess.TimeoutExpired:
         return {}
@@ -337,8 +350,123 @@ def _multitenant_pairs() -> list:
     return pairs
 
 
+def _baseline_multichip() -> list:
+    """[(label, sharded_ms)] from the newest committed MULTICHIP_r*.json.
+    Recognizes BOTH schemas: the legacy dryrun capture ({"tail":
+    "...sharded_ms=X unsharded_ms=Y"}) and the perf-row schema the
+    partitioned rows emit — {"results": [row,...]}, a bare row list, or a
+    single row dict, each row keyed by "config" with "sharded_ms"."""
+    import re
+
+    path = _newest("MULTICHIP_r*.json")
+    if path is None:
+        return []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
+    rows = []
+    if isinstance(doc, dict) and isinstance(doc.get("results"), list):
+        rows = doc["results"]
+    elif isinstance(doc, list):
+        rows = doc
+    elif isinstance(doc, dict) and "sharded_ms" in doc:
+        rows = [doc]
+    out = []
+    for r in rows:
+        if isinstance(r, dict) and isinstance(r.get("sharded_ms"), (int, float)):
+            out.append((r.get("config", "multichip"), float(r["sharded_ms"])))
+    if out:
+        return out
+    # legacy schema: the dryrun's stderr/stdout tail with the timing line
+    tail = doc.get("tail", "") if isinstance(doc, dict) else ""
+    m = re.search(r"sharded_ms=([0-9.]+)", tail)
+    if m:
+        return [("multichip:legacy-dryrun-tail", float(m.group(1)))]
+    return []
+
+
+def _multichip_pairs():
+    """(sentinel pairs, hard-gate problems) for the partitioned multichip
+    leg. The GATE row must be parity=exact always; on a real accelerator
+    mesh sharded must be <= 0.8x unsharded (the virtual-CPU mesh is
+    exempted to parity-only — its "devices" are host threads, so the
+    ratio measures scheduler noise, not the interconnect); the burst row
+    must have routed zero pods to the host. Regression pairs compare
+    sharded_ms against the newest committed MULTICHIP_r*.json rows."""
+    # skip the burst row's informational oracle replay: this leg never
+    # reads burst parity (only gate-row parity + burst host routing),
+    # and the replay costs about as much as the burst itself against the
+    # subprocess's fixed 900s budget
+    fresh = _fresh_perf_rows(["multichip"],
+                             env={"PERF_MULTICHIP_BURST_PARITY": "0"})
+    problems, pairs = [], []
+    rows = [r for r in fresh.values() if "sharded_ms" in r]
+    gate = next((r for r in rows if r.get("gate")), None)
+    if gate is None:
+        skipped = next((r.get("skipped") for r in fresh.values()
+                        if r.get("skipped")), None)
+        problems.append(
+            f"multichip: no gate row produced ({skipped or 'no output'})")
+        return pairs, problems
+    if gate.get("parity") != "exact":
+        if gate.get("parity") is None:
+            # perf only computes parity on the partitioned rung — a None
+            # here means the gate row FELL BACK (blocker, or
+            # KARPENTER_SHARD_PARTITION=0 leaked into CI), which is a
+            # routing regression, not a numerical divergence
+            problems.append(
+                f"multichip: gate row ran engine={gate.get('engine')!r} "
+                "with no parity check — expected the partitioned rung")
+        else:
+            problems.append(
+                f"multichip: gate row parity={gate.get('parity')!r} — the "
+                "partitioned merge/repair diverged from its unsharded "
+                "oracle")
+    sh, un = gate.get("sharded_ms"), gate.get("unsharded_ms")
+    if not gate.get("virtual", True):
+        if (isinstance(sh, (int, float)) and isinstance(un, (int, float))
+                and un > 0 and sh > 0.8 * un):
+            problems.append(
+                f"multichip: sharded {sh}ms > 0.8x unsharded {un}ms on a "
+                "real accelerator mesh")
+    burst_rows = [r for r in rows if not r.get("gate")]
+    for r in burst_rows:
+        if r.get("host_routed_pods"):
+            problems.append(
+                f"multichip: burst row {r.get('config')} routed "
+                f"{r['host_routed_pods']} pods to the host")
+    from karpenter_tpu.service.session import env_int
+
+    if not burst_rows and env_int("PERF_MULTICHIP_PODS", 500000) > 0:
+        # the zero-host-routing gate is a HARD gate: a burst row that was
+        # supposed to run but never printed must fail loudly, not pass by
+        # absence (mirrors the gate-row-missing problem above)
+        problems.append(
+            "multichip: no burst row produced (PERF_MULTICHIP_PODS did not "
+            "disable it) — the zero-host-routing gate was never evaluated")
+    by_config = {r.get("config"): r for r in rows}
+    for label, base_ms in _baseline_multichip():
+        # only the legacy dryrun capture (no config key) may judge the gate
+        # row; a row-schema label with no matching fresh config must not be
+        # cross-compared against a different-shaped row
+        if label.startswith("multichip:legacy"):
+            match = by_config.get(label, gate)
+        elif label in by_config:
+            match = by_config[label]
+        else:
+            print(f"bench: multichip sentinel: committed baseline "
+                  f"{label!r} matched no fresh row (fresh: "
+                  f"{sorted(by_config)}) — not compared", file=sys.stderr)
+            continue
+        if isinstance(match.get("sharded_ms"), (int, float)):
+            pairs.append((label, base_ms, float(match["sharded_ms"])))
+    return pairs, problems
+
+
 def sentinel(record: dict, consolidation: bool = False,
-             multitenant: bool = False) -> int:
+             multitenant: bool = False, multichip: bool = False) -> int:
     """Exit code for the regression gate: 0 clean/ungated, 3 on a >15%
     headline-solve, consolidation, or multi-tenant-fleet regression vs
     the newest committed records. Headline comparison is ENGINE-GATED (an
@@ -370,6 +498,15 @@ def sentinel(record: dict, consolidation: bool = False,
                     pairs.append((cfg, base_c[cfg], ms))
     if multitenant:
         pairs.extend(_multitenant_pairs())
+    if multichip:
+        m_pairs, m_problems = _multichip_pairs()
+        pairs.extend(m_pairs)
+        if m_problems:
+            print("bench: multichip gate failed "
+                  "(KARPENTER_BENCH_SENTINEL=0 to disable):", file=sys.stderr)
+            for p in m_problems:
+                print(f"bench:   {p}", file=sys.stderr)
+            return 3
     if not pairs:
         return 0
     regressed, lines = regression_table(pairs)
@@ -480,7 +617,8 @@ def main():
                 # the record is out; now gate on the committed baselines
                 sys.exit(sentinel(
                     rec, consolidation="--consolidation" in sys.argv,
-                    multitenant="--multitenant" in sys.argv))
+                    multitenant="--multitenant" in sys.argv,
+                    multichip="--multichip" in sys.argv))
     # every engine failed: still emit a parseable record (value null) with
     # the full diagnostic trail — never exit silent/nonzero without one
     print(

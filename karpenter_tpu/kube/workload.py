@@ -50,6 +50,7 @@ class WorkloadController:
                 # owned list order (store insertion) approximates it
                 self.store.delete("pods", extra)
                 progressed = True
+            tmpl_sig = None
             for _ in range(deploy.replicas - len(owned)):
                 p = deploy.template.clone()
                 from karpenter_tpu.api.objects import new_uid
@@ -63,6 +64,27 @@ class WorkloadController:
                 p.node_name = ""
                 p.phase = "Pending"
                 p.conditions = []
+                # stamp the scheduling signature at index build time: every
+                # replica of one deployment is spec-identical to its
+                # template (the fields edited above — name/uid/owner/
+                # node_name/phase/conditions — are not signature inputs,
+                # and clone() deep-copies are value-equal), so the burst's
+                # first tensorize pays ONE signature hash per deployment
+                # instead of one per pod. Computed fresh per poll (not
+                # memoized on the template object) so an edited template
+                # stamps its NEW signature; already-running pods keep the
+                # old spec and the old signature, which stays correct for
+                # them. Solver-side clones drop the cache (dataclasses.
+                # replace copies declared fields only), preserving the
+                # relaxation-mutates-clones invariant.
+                if tmpl_sig is None:
+                    from karpenter_tpu.ops.tensorize import (
+                        intern_signature,
+                        pod_signature,
+                    )
+
+                    tmpl_sig = intern_signature(pod_signature(deploy.template))
+                p.__dict__["_sig_cache"] = tmpl_sig
                 self.store.create("pods", p)
                 progressed = True
         return progressed

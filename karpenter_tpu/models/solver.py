@@ -633,7 +633,11 @@ class TPUSolver(Solver):
                 # decode finds no leftovers — async device time only)
                 pull = self._invoke_spec(
                     args, base_key + (Bp2, level_bits, max_minv), Bp2)
-            assign = host["assign"][:G, :Bp]
+            # the RETURNED bin axis, not the requested Bp: the partitioned
+            # mesh solve (parallel/mesh.py) merges per-shard budgets into
+            # a wider global axis — slicing to Bp would silently drop
+            # whole shards' bins and route their pods to the host loop
+            assign = host["assign"][:G]
             tmpl = host["tmpl"]
             # F (G×T per-group feasibility) replaces the big per-bin `types`
             # matrix on the host: exact for single-group bins, a sound
@@ -823,13 +827,15 @@ class TPUSolver(Solver):
         for g in gset[1:]:
             joint = joint & feas[g]
         tsel = np.flatnonzero(joint & (snap.t_tmpl == m))
-        # single-group bins whose template shares NO requirement key with
-        # the group (and constrains neither zone nor capacity type) need no
+        # bins whose merged requirement set provably DECOMPOSES need no
         # merged re-check: group-vs-type is exactly F (masks and offering
         # sets both group-side), template-vs-type was prefiltered into
-        # type_refs by the REAL intersection, and key-disjointness rules
-        # out every three-way meet. The standard stamped pool (nodepool
-        # label only) hits this on every grid bin.
+        # type_refs by the REAL intersection, and the structure below
+        # rules out every three-way meet. The standard stamped pool
+        # (nodepool label only) hits this on every grid bin, and the
+        # partitioned mesh solve's merged multi-group bins (each shard's
+        # groups are disjoint slices sharing selector shapes) hit the
+        # multi-group arm at 500k scale.
         tmeta = getattr(snap, "_tmpl_keymeta", None)
         if tmeta is None:
             tmeta = [
@@ -843,10 +849,17 @@ class TPUSolver(Solver):
             snap._tmpl_keymeta = tmeta
         tkeys, off_free = tmeta[m]
         exact = (
-            len(gset) == 1
-            and off_free
-            and tkeys.isdisjoint(snap.group_reqs[gset[0]].keys())
+            off_free
+            and all(tkeys.isdisjoint(snap.group_reqs[g].keys()) for g in gset)
+            and (len(gset) == 1 or self._decomposable(snap, gset))
         )
+        if exact and tsel.size:
+            # count only bins where a merged re-check was actually
+            # avoided — with zero surviving candidates the re-check is a
+            # no-op and counting it would overstate the A/B coverage
+            from karpenter_tpu.ops.tensorize import STATS as _tz
+
+            _tz["decode_exact_skips"] += 1
         if tsel.size and not exact:
             mask_bin, has_bin, tol_bin = snap.mask_set(bin_reqs)
             tm, th, tt = snap.t_mask[tsel], snap.t_has[tsel], snap.t_tol[tsel]
@@ -900,6 +913,79 @@ class TPUSolver(Solver):
                 persist.pop(next(iter(persist)))
             persist[pkey] = entry
         return entry
+
+    @staticmethod
+    def _decomposable(snap, gset) -> bool:
+        """Multi-group arm of the decoder's exact-skip: True when the
+        bin's merged requirement set decomposes per key into single-group
+        checks F already made — then the merged re-check cannot remove a
+        candidate and is skipped outright.
+
+        Exactness argument (the PR-4 single-group reasoning extended to
+        the partitioned-shard merged bins, where every bin's groups come
+        from one shard's disjoint slice and bursts share a handful of
+        selector shapes):
+
+        * **Requirements.** With the template key-disjoint from every
+          group (checked by the caller), the merged set's row for key k is
+          exactly the row of whichever groups carry k. If a key is carried
+          by 2+ groups, we require their (mask, tol) rows BIT-EQUAL — the
+          merged row is then that shared row, and the kernel checked it
+          against every type for each carrier (F is conjunctive over
+          gset). A key carried once decomposes trivially. Three-way meets
+          need a shared key with *different* masks — excluded.
+        * **Offerings.** F's offering check is per GROUP (zone/ct allowed
+          sets ∧ availability, jointly over one offering). The merged bin
+          needs ONE offering satisfying every group's zone AND ct sets at
+          once, which per-group F cannot promise when different groups
+          constrain different offering labels (g1 pins zone, g2 pins ct:
+          each F found *some* offering, possibly different ones). We
+          therefore require every offering-constraining group (zone or ct
+          key present) to agree bit-for-bit on BOTH labels — the joint
+          predicate then equals each such group's own F offering check.
+
+        Under both conditions the candidate set after the merged re-check
+        equals the F∧template prefilter, so skipping is exact. Cost is a
+        few row compares per DISTINCT (template, group-set) key, amortized
+        by the compat cache. KARPENTER_DECODE_EXACT_SKIP=0 disables this
+        arm for A/B (the seeded parity suite pins on/off equality)."""
+        import os
+
+        if os.environ.get("KARPENTER_DECODE_EXACT_SKIP", "1").strip().lower() in (
+            "0", "false", "off", "no",
+        ):
+            return False
+        has = snap.g_has
+        mask = snap.g_mask
+        tol = snap.g_tol
+        K = has.shape[1]
+        carriers: list = [None] * K
+        for g in gset:
+            for k in np.flatnonzero(has[g]):
+                first = carriers[k]
+                if first is None:
+                    carriers[k] = g
+                elif (tol[g, k] != tol[first, k]
+                      or (mask[g, k] != mask[first, k]).any()):
+                    return False
+        # offering bundle: zone/ct-constraining groups must agree on both
+        zk = snap.key_index.get(wk.TOPOLOGY_ZONE_LABEL)
+        ck = snap.key_index.get(wk.CAPACITY_TYPE_LABEL)
+        off_keys = [k for k in (zk, ck) if k is not None]
+        if off_keys:
+            offg = [g for g in gset if any(has[g, k] for k in off_keys)]
+            if len(offg) > 1:
+                g0 = offg[0]
+                for g in offg[1:]:
+                    for k in off_keys:
+                        if has[g, k] != has[g0, k]:
+                            return False
+                        if has[g0, k] and (
+                            tol[g, k] != tol[g0, k]
+                            or (mask[g, k] != mask[g0, k]).any()
+                        ):
+                            return False
+        return True
 
     def _decode(self, snap, esnap, assign, assign_e, used, feas, tmpl,
                 compat_cache=None):

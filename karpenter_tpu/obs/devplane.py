@@ -55,6 +55,9 @@ __all__ = [
     "STATS",
     "record_dispatch",
     "record_padding",
+    "record_shard_fallback",
+    "record_shard_overlap",
+    "record_shard_repair",
     "slo_tracker",
     "slo_snapshot",
     "reset",
@@ -72,6 +75,13 @@ STATS = {
     "pad_dispatches": 0,
     "pad_cells_actual": 0.0,
     "pad_cells_padded": 0.0,
+    # partitioned mesh solve (parallel/mesh.py): host tensorize wall time
+    # hidden under in-flight shard programs (the pipeline's overlap),
+    # straddling pods re-packed by the bounded repair pass, and fallbacks
+    # out of the partitioned rung
+    "shard_overlap_ms": 0.0,
+    "shard_repair_pods": 0,
+    "shard_fallbacks": 0,
 }
 _STATS_LOCK = threading.Lock()
 
@@ -219,6 +229,53 @@ def record_padding(site: str, actual, padded, registry=None) -> float:
         buckets=_m.PAD_WASTE_BUCKETS,
     ).observe(ratio, site=site)
     return ratio
+
+
+def record_shard_overlap(seconds: float, registry=None) -> None:
+    """Host tensorize wall time of one partitioned mesh solve that ran
+    while earlier shards' programs were already in flight — the pipelined
+    shard.tensorize-under-shard.block overlap, counted so the MULTICHIP
+    rows can show the pipeline engaged rather than inferring it from
+    span arithmetic."""
+    seconds = max(float(seconds), 0.0)
+    with _STATS_LOCK:
+        STATS["shard_overlap_ms"] += seconds * 1000.0
+    from karpenter_tpu.operator import metrics as _m
+
+    _resolve_registry(registry).counter(
+        _m.SHARD_OVERLAP_SECONDS,
+        "host shard-tensorize seconds hidden under in-flight shard solves "
+        "(partitioned mesh pipeline)",
+    ).inc(seconds)
+
+
+def record_shard_repair(pods: int, registry=None) -> None:
+    """Straddling pods the partitioned merge's bounded host repair pass
+    re-packed (parallel/mesh.py _repair_merged)."""
+    pods = max(int(pods), 0)
+    if not pods:
+        return
+    with _STATS_LOCK:
+        STATS["shard_repair_pods"] += pods
+    from karpenter_tpu.operator import metrics as _m
+
+    _resolve_registry(registry).counter(
+        _m.SHARD_REPAIR_PODS,
+        "straddling pods re-packed by the partitioned mesh repair pass",
+    ).inc(pods)
+
+
+def record_shard_fallback(reason: str, registry=None) -> None:
+    """One abandonment of the partitioned mesh rung (repair bound
+    exceeded, etc.) — the solve fell back to an exact slower path."""
+    with _STATS_LOCK:
+        STATS["shard_fallbacks"] += 1
+    from karpenter_tpu.operator import metrics as _m
+
+    _resolve_registry(registry).counter(
+        _m.SHARD_FALLBACKS,
+        "partitioned mesh solves that fell back to an exact slower path",
+    ).inc(reason=reason)
 
 
 class SloTracker:
@@ -421,4 +478,5 @@ def reset():
         STATS.update(
             cold_compiles=0, compile_ms=0.0, warm_dispatches=0,
             pad_dispatches=0, pad_cells_actual=0.0, pad_cells_padded=0.0,
+            shard_overlap_ms=0.0, shard_repair_pods=0, shard_fallbacks=0,
         )

@@ -267,6 +267,12 @@ PAD_WASTE_RATIO = f"{NAMESPACE}_pad_waste_ratio"
 # waste is a fraction in [0,1]; duration buckets make no sense for it
 PAD_WASTE_BUCKETS = (0.0, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.625, 0.75,
                      0.875, 1.0)
+# partitioned mesh solve (parallel/mesh.py): pipelined-tensorize overlap
+# seconds, straddling pods re-packed by the bounded repair pass, and
+# fallbacks out of the partitioned rung by reason
+SHARD_OVERLAP_SECONDS = f"{NAMESPACE}_shard_tensorize_overlap_seconds_total"
+SHARD_REPAIR_PODS = f"{NAMESPACE}_shard_repair_pods_total"
+SHARD_FALLBACKS = f"{NAMESPACE}_shard_fallbacks_total"
 SOLVER_REQUEST_SECONDS = f"{NAMESPACE}_solver_request_seconds"
 SOLVER_REQUEST_QUANTILE = f"{NAMESPACE}_solver_request_quantile_seconds"
 SLO_BUDGET_BURN = f"{NAMESPACE}_slo_error_budget_burn_total"

@@ -133,6 +133,11 @@ STATS = {
     # rows reused across provisioning rounds/batches
     "group_row_hits": 0,
     "group_row_misses": 0,
+    # decoder merged-mask re-checks skipped because the bin's requirement
+    # set was provably decomposable (models/solver.py _compat_entry —
+    # single-group disjoint-template bins AND the partitioned-shard
+    # multi-group extension)
+    "decode_exact_skips": 0,
 }
 
 # the scrape-plane family name lives in operator/metrics.py
@@ -168,6 +173,38 @@ def pad_to(a: np.ndarray, shape: tuple, fill=0) -> np.ndarray:
     """Zero- (or fill-) pad `a` up to `shape` (prefix slices preserved)."""
     out = np.full(shape, fill, dtype=a.dtype) if fill else np.zeros(shape, dtype=a.dtype)
     out[tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
+# every solve-arg tensor that rides the group (G) axis on its leading dim —
+# the slicing vocabulary for the partitioned mesh solve's per-shard bundle
+# views (parallel/mesh.py) and the one list a new group-axis tensor family
+# must join to reach the shards. ge_ok is [G,E]: group-axis leading, so it
+# slices here too (the partitioned path never sees it — existing nodes are
+# a partition blocker — but the view helper stays total).
+GROUP_AXIS_KEYS = frozenset({
+    "g_mask", "g_has", "g_tol", "g_demand", "g_count", "g_zone_allowed",
+    "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single", "g_decl",
+    "g_match", "g_sown", "g_smatch", "g_aneed", "g_amatch", "ge_ok",
+})
+
+
+def shard_view(args: dict, lo: int, hi: int, g_pad: int) -> dict:
+    """Per-shard bundle view of a solve arg dict: group-axis tensors are
+    sliced to [lo:hi) and zero-padded to ``g_pad`` rows; type/template
+    tensors pass through BY REFERENCE (they are shard-invariant, so the
+    host pays no copy per shard — placement happens at device_put time).
+    Zero padding is inert by the kernels' padded-row contract: count 0
+    rows never take, a zero g_sown row only gates itself."""
+    out = {}
+    for k, v in args.items():
+        if k in GROUP_AXIS_KEYS:
+            a = np.asarray(v)[lo:hi]
+            if a.shape[0] != g_pad:
+                a = pad_to(a, (g_pad,) + a.shape[1:])
+            out[k] = a
+        else:
+            out[k] = v
     return out
 
 
@@ -668,11 +705,22 @@ def pod_signature(pod) -> tuple:
     return (ns, aff, res, cont, init, ovh, tol_sig, lbl, spread, pa)
 
 
+# the tail of a pod with no affinity/tolerations/labels/spread — the shape
+# that dominates deployment bursts. One shared constant instead of five
+# fresh empty tuples per pod: at 500k first-sight pods the empty-component
+# tuple builds were the bulk of the remaining per-pod signature cost.
+_EMPTY_TAIL = ((), (), (), (), ())
+
+
 def _signature_tail(pod) -> tuple:
     """The signature components ``Pod.clone`` deep-copies (so identity
     memos can never share them): (aff, tol_sig, lbl, spread, pa). Shared
     by :func:`pod_signature` and the batch path so both assemble the exact
     same tuple shape."""
+    if (pod.affinity is None and not pod.tolerations
+            and not pod.metadata.labels
+            and not pod.topology_spread_constraints):
+        return _EMPTY_TAIL
     aff = ()
     if pod.affinity is not None and pod.affinity.node_affinity is not None:
         aff = tuple(
@@ -779,12 +827,34 @@ def batch_signatures(pods) -> list:
     cont_m: dict = {}
     init_m: dict = {}
     ovh_m: dict = {}
+    # whole-signature identity memo for tail-free pods: replica stamps
+    # share every signature-bearing sub-object by reference (requests /
+    # node_selector / containers ride Pod.clone untouched), so a burst of
+    # N pods over S shapes pays S tuple builds + S intern hashes, not N —
+    # the per-pod-hash burn-down the 500k first round needs. Pods with a
+    # non-empty tail (affinity/tolerations/labels/spread) never enter:
+    # clone deep-copies those, so identity can't vouch for them.
+    whole_m: dict = {}
     for i, pod in enumerate(pods):
         d = pod.__dict__
         sig = d.get("_sig_cache")
         if sig is not None:
             out[i] = sig
             continue
+        tail_free = (pod.affinity is None and not pod.tolerations
+                     and not pod.metadata.labels
+                     and not pod.topology_spread_constraints)
+        wkey = None
+        if tail_free:
+            wkey = (id(pod.node_selector) if pod.node_selector else 0,
+                    id(pod.requests) if pod.requests else 0,
+                    id(pod.containers) if pod.containers else 0,
+                    id(pod.init_containers) if pod.init_containers else 0,
+                    id(pod.overhead) if pod.overhead else 0)
+            sig = whole_m.get(wkey)
+            if sig is not None:
+                out[i] = d["_sig_cache"] = sig
+                continue
         # empty components skip the memo outright: per-pod default
         # containers (a fresh empty list each) would miss on every id and
         # pay the bookkeeping for nothing
@@ -834,6 +904,8 @@ def batch_signatures(pods) -> list:
         sig = intern_signature(
             (ns, aff, res, cont, init, ovh, tol_sig, lbl, spread, pa))
         out[i] = d["_sig_cache"] = sig
+        if wkey is not None:
+            whole_m[wkey] = sig
     return out
 
 

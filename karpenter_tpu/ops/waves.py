@@ -541,23 +541,33 @@ class _Compiler:
     # ---- landings ------------------------------------------------------
     def _emit(self, pods, extra_reqs, bin_cap, zone_split, decl, match,
               spread_caps, smatch, aff_need, amatch):
+        # batched subgroup construction: every field except the pod slice
+        # and the zone pin is IDENTICAL across a wave's subgroups, so the
+        # per-wave structure is built ONCE and shared — including
+        # `spread_caps`, whose per-subgroup dict(…) copy used to dominate
+        # this loop at fleet scale (ROADMAP named _emit as a residual host
+        # stage that would dominate at 500k pods; a 100-zone wave now pays
+        # one copy, not 100). Sharing is safe: DeviceGroup fields are
+        # read-only after compile (tensorize/spread_tensors/class_masks
+        # only read), and each call site already hands _emit a fresh dict.
+        emit = self.device_groups.append
         if zone_split:
             # zone-pinned subgroups; pods partitioned in order
             cursor = 0
+            zone = wk.TOPOLOGY_ZONE_LABEL
             for d in sorted(zone_split):
                 cnt = zone_split[d]
                 sub = pods[cursor: cursor + cnt]
                 cursor += cnt
-                self.device_groups.append(DeviceGroup(
-                    sub,
-                    extra_reqs + [Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [d])],
-                    bin_cap, False, decl, match, dict(spread_caps), smatch,
+                emit(DeviceGroup(
+                    sub, extra_reqs + [Requirement(zone, IN, [d])],
+                    bin_cap, False, decl, match, spread_caps, smatch,
                     aff_need, amatch,
                 ))
         else:
-            self.device_groups.append(DeviceGroup(
+            emit(DeviceGroup(
                 list(pods), extra_reqs, bin_cap, False, decl, match,
-                dict(spread_caps), smatch, aff_need, amatch,
+                spread_caps, smatch, aff_need, amatch,
             ))
 
     def _bump_landings(self, gid, pods, zone_split):

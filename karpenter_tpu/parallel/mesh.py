@@ -1,19 +1,83 @@
-"""Device-mesh sharding for the solve kernels.
+"""Device-mesh sharding for the solve kernels: the PARTITIONED formulation.
 
-The reference scales by bounding problem size per solve (SURVEY.md §5
-long-context note); the TPU build scales by sharding the feasibility tensor
-over a mesh instead: pod-groups ride the `data` axis and instance types the
-`model` axis, XLA inserting the all-gathers needed before the (small,
-sequential) pack scan. On real hardware those collectives ride ICI; the
-same program dry-runs on a virtual CPU mesh (tests/conftest.py,
-__graft_entry__.dryrun_multichip).
+The replicated program this module used to run (group/type tensors sharded
+over the mesh, one all-gathered pack scan) made 8 devices buy nothing:
+`shard.block` — the device wait on the replicated scan — was the entire
+MULTICHIP number (PR-6 attribution). The pod-group axis now **genuinely
+partitions** instead:
+
+* **Partition.** `plan_shards` splits the FFD-ordered group axis into
+  contiguous slices balanced by estimated bin need, one slice per mesh
+  device (the mesh flattens for the pack — the scan's inner tensors are
+  [B_s, T] and far too small for model-axis collectives to earn anything).
+  Each shard runs the SAME jitted ``solve_step`` over its slice against a
+  **per-shard bin-capacity budget** (``ShardPlan.budget``, a unified pow-2
+  bucket so one executable serves every shard), so the scan's sequential
+  length drops from G steps over a [B, T] state to G_s steps over
+  [B_s, T] — the total scan work falls by ~the shard count even before
+  any cross-device concurrency.
+* **Pipeline.** Shard dispatch is async: shard k+1's host tensorize
+  (slice + pad + ``device_put``) runs while shard k's program is already
+  in flight — the `shard.tensorize`-under-`shard.block` overlap the
+  module's TODO used to describe. The hidden host time is accounted on
+  the device plane (``devplane.record_shard_overlap``).
+* **Merge.** Per-shard outputs reconcile into one global bin axis
+  (block-placement: shard s owns bins [s*B_s, (s+1)*B_s)); per-group
+  feasibility rows concatenate exactly (F is group-local). Bin occupancy
+  needs no cross-shard psum here because eligibility (below) guarantees
+  shards share no mutable global state — existing-node capacity and
+  finite nodepool limits, the two cross-shard accumulators that WOULD
+  need reconciling, force the fallback ladder instead.
+* **Repair.** Pods a shard could not place inside its budget *straddle*
+  the partition: a bounded host pass (`_repair_merged`,
+  ``KARPENTER_SHARD_REPAIR_MAX``) re-packs them into other shards'
+  residual bin capacity (soundness-gated: only bins whose member groups'
+  requirement rows are bit-equal to the straddler's, or empty, so the
+  merged requirement set is decomposable and the kernel's own F ∧
+  surviving-types state is exact) or opens fresh bins from the
+  weight-best template with the kernel's own new-bin rule. Repair beyond
+  the bound falls back to the plain unsharded solve.
+
+**Exactness contract.** The merged end state is bit-identical to the
+**unsharded oracle of the same partition**: :func:`partitioned_reference`
+runs the identical per-shard ``solve_step`` sequentially on one device and
+the identical merge/repair host code — tests/test_partitioned_mesh.py pins
+device-vs-oracle equality across mesh shapes, and ``perf multichip``
+reports it as ``parity``. On a degenerate (single-device) mesh the plan is
+refused and the solve runs unsharded, so the partitioned path degrades to
+exact global-oracle parity. Against the *global* sequential oracle the
+partitioned pack may legitimately open more bins (a straddler the repair
+pass placed on a fresh bin where the global scan would have found residual
+capacity in another group's bin); the perf row reports that as node
+overhead, exactly like the grid rows do.
+
+**Fallback ladder.** Snapshots the partition cannot express keep the old
+exact paths: existing nodes (cross-shard capacity), finite nodepool limits
+(cross-shard budget), minValues, single-bin groups, and active topology
+conflict/spread/affinity classes (cross-GROUP bin state) route to the
+replicated sharded program (`_replicated_solve`, bit-identical to the
+unsharded kernel — the pre-partition contract); a degenerate mesh or a
+repair overflow routes to the plain unsharded solve. ``LAST_RUN`` records
+which rung ran and why.
+
+Stage attribution (obs flight recorder + devplane): ``shard.tensorize``
+(per-shard host slice/pad/placement), ``shard.dispatch`` (async launch,
+plus XLA compile on a cold ``mesh.shard`` ledger key — keys carry the
+shard shape AND the target device, so per-device executables are visible,
+not warm-looking), ``shard.block`` (the wait for all in-flight shards),
+``shard.merge`` (gather + reconcile), ``shard.repair`` (the bounded host
+pass). Pad waste lands per shard on ``karpenter_pad_waste_ratio
+{site="mesh.shards"}``.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
+import threading
 import time
+from dataclasses import dataclass
 
 import jax
 import numpy as np
@@ -23,16 +87,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from karpenter_tpu import obs
 from karpenter_tpu.obs import devplane
 from karpenter_tpu.ops import kernels
+from karpenter_tpu.ops.tensorize import (
+    SPREAD_OWNED_MIN,
+    bucket,
+    shard_view,
+)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+# straddling pods (a shard's budget ran dry) beyond this bound abandon the
+# partitioned result and fall back to the unsharded solve: repair is a
+# host-sequential pass, so an unbounded one could quietly become the old
+# host-loop regression the device path exists to avoid
+SHARD_REPAIR_MAX = 4096
+
+# diagnostics of the last sharded_solve call, read by the perf harness's
+# multichip rows (engine rung, per-shard shapes, repair/overlap totals)
+class _LastRun(threading.local):
+    """Dict-like facade over a per-THREAD run record: diagnostics of the
+    most recent sharded solve on the calling thread (engine rung, shard
+    stats, overlap, repair counts). Thread-local because the PR-7 solver
+    service drives concurrent solves on gRPC worker threads — a module
+    global dict would interleave two tenants' clear()/update() sequences
+    and hand a reader (perf rows, the dryrun parity check) another solve's
+    engine field. Single-threaded readers (perf harness, tests, dryrun)
+    read right after their own solve and are unaffected."""
+
+    def __init__(self):
+        self._d: dict = {}
+
+    def get(self, key, default=None):
+        return self._d.get(key, default)
+
+    def __getitem__(self, key):
+        return self._d[key]
+
+    def __setitem__(self, key, value):
+        self._d[key] = value
+
+    def __contains__(self, key):
+        return key in self._d
+
+    def clear(self):
+        self._d.clear()
+
+    def update(self, *args, **kw):
+        self._d.update(*args, **kw)
+
+
+LAST_RUN = _LastRun()
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_solve_step(max_bins: int, max_minv: int = 0, level_bits: int = 20):
     """One jitted executable per (max_bins, minValues width, level bits);
-    jax.jit's own cache handles the per-shape/per-sharding specializations
-    under it."""
+    jax.jit's own cache handles the per-shape/per-device/per-sharding
+    specializations under it (a partitioned shard pinned to device k
+    compiles its own executable — the mesh.shard ledger key carries the
+    device index so those compiles are attributed, not warm-looking)."""
     return jax.jit(functools.partial(kernels.solve_step, max_bins=max_bins,
                                      use_pallas=False, max_minv=max_minv,
                                      level_bits=level_bits))
@@ -52,10 +165,10 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 def make_multihost_mesh(n_hosts: int | None = None,
                         chips_per_host: int | None = None) -> Mesh:
     """DCN-tier mesh: the data (group) axis spans HOSTS and the model
-    (type) axis stays INTRA-host, so the heavy collective — the [G,T]
-    feasibility all-gather feeding the pack scan — rides ICI while only
-    the group-sharded inputs cross DCN (the scaling-book layout: put the
-    bandwidth-hungry axis on the fast interconnect).
+    (type) axis stays INTRA-host. For the partitioned pack the layout is
+    moot (shards are independent programs, no collectives); the replicated
+    fallback still wants its heavy [G,T] all-gather on ICI, so the
+    scaling-book placement is kept.
 
     On real multi-host installs, jax.devices() already interleaves
     processes and `mesh_utils` keeps each host's chips contiguous on the
@@ -93,25 +206,512 @@ def _pad_to(a: np.ndarray, axis: int, mult: int) -> np.ndarray:
     return np.pad(a, pad)
 
 
-def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
-    """Full solve step (feasibility + pack) with the feasibility inputs
-    sharded over the mesh. Returns the same outputs as the unsharded path
-    (lazily — consume via :func:`sharded_solve_host` for the host dict).
+# --------------------------------------------------------------------------
+# partition planning
+# --------------------------------------------------------------------------
 
-    Sharding layout: group-axis tensors are split over `data`, type-axis
-    tensors over `model`; the pack scan consumes the all-gathered F (XLA
-    inserts the collectives) and runs replicated — it is O(G*B*T) and tiny
-    next to feasibility at scale.
 
-    Stage attribution (obs flight recorder, same ``kind=device``
-    convention as ``solve.kernel``): ``shard.pad`` is the host pow-2/mesh
-    padding, ``shard.tensorize`` the host→device placement of the shard
-    tensors, ``shard.dispatch`` the sharded program launch (plus XLA
-    compile on a cold ``mesh.shard`` ledger family). The consume side
-    (``shard.block``/``shard.merge``) lives in ``sharded_solve_host`` —
-    together these leaves decompose the MULTICHIP wall clock that used to
-    be one opaque number.
-    """
+@dataclass
+class ShardPlan:
+    """One partitioned dispatch: contiguous group slices (FFD order
+    preserved), a unified padded group axis, and a unified per-shard bin
+    budget (one compiled executable serves every shard)."""
+
+    bounds: list  # [(lo, hi)] group slices, contiguous and ordered
+    g_pad: int  # padded per-shard group axis
+    budget: int  # per-shard bin axis (pow-2/3·2^k bucket)
+    need: list  # per-shard un-padded bin estimate (pad-waste accounting)
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.bounds)
+
+
+def _partition_blockers(args: dict) -> str | None:
+    """Why this snapshot cannot partition (None = eligible). Each blocker
+    is a cross-shard coupling the block-diagonal merge cannot reconcile:
+    existing nodes and finite limits are mutable GLOBAL accumulators,
+    minValues/single-bin change the new-bin rule the repair pass mirrors,
+    and topology classes are cross-GROUP bin state."""
+    if "e_avail" in args:
+        return "existing-nodes"
+    mm = args.get("m_minv")
+    if mm is not None and np.asarray(mm).size and int(np.asarray(mm).max()) > 0:
+        return "min-values"
+    if np.isfinite(np.asarray(args["m_limits"])).any():
+        return "nodepool-limits"
+    # per-group checks look only at ACTIVE rows: kernel_args pads the
+    # group axis to a pow-2 bucket with fill 0, and a padded g_sown row
+    # of 0 (< SPREAD_OWNED_MIN) or padded zero flags must not read as a
+    # blocker — count-0 rows place no pods and are inert by the padding
+    # contract, so any non-bucket-aligned real snapshot would otherwise
+    # silently lose the partitioned rung
+    active = np.asarray(args["g_count"]) > 0
+    gs = args.get("g_single")
+    if gs is not None and np.asarray(gs)[active].any():
+        return "single-bin-groups"
+    for k in ("g_decl", "g_match", "g_aneed", "g_amatch"):
+        v = args.get(k)
+        if v is not None and np.asarray(v)[active].any():
+            return "topology-classes"
+    sown = args.get("g_sown")
+    if sown is not None and np.asarray(sown).size and (
+        np.asarray(sown)[active] < SPREAD_OWNED_MIN
+    ).any():
+        return "topology-classes"
+    return None
+
+
+def _bin_need(args: dict):
+    """(per-group bin-need weight [G], per-resource max allocatable [R]) —
+    the same demand/allocatable lower bound the solver's bin-axis estimator
+    uses (models/solver.py _run_and_decode), per group so the planner can
+    balance slices and budget shards by it. The pods resource axis rides
+    along (every pod demands 1), so kubelet max-pods caps the bound too."""
+    g_count = np.asarray(args["g_count"]).astype(np.float64)
+    g_demand = np.asarray(args["g_demand"]).astype(np.float64)
+    t_alloc = np.asarray(args["t_alloc"]).astype(np.float64)
+    max_alloc = t_alloc.max(axis=0) if t_alloc.size else np.zeros(0)
+    demand = g_demand * g_count[:, None]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        lb = np.where(max_alloc[None, :] > 0, demand / max_alloc[None, :], 0.0)
+    return np.nan_to_num(lb).max(axis=1), max_alloc
+
+
+def estimate_bin_axis(args: dict) -> int:
+    """Unsharded bin-axis estimate for one solve (demand lower bound with
+    the solver's 1.5x FFD headroom) — the honest baseline axis for the
+    multichip comparison rows (perf/run.py), shared with shard budgeting."""
+    w, _ = _bin_need(args)
+    total_pods = int(np.asarray(args["g_count"]).sum())
+    est = int(np.ceil(w.sum())) if w.size else 1
+    return min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
+
+
+def plan_shards(args: dict, n_shards: int, max_bins: int | None = None
+                ) -> ShardPlan | None:
+    """Partition the group axis for `n_shards` devices, or None when the
+    snapshot must fall back (see `_partition_blockers` / degenerate
+    shapes). KARPENTER_SHARD_PARTITION=0 disables the partitioned path
+    outright (A/B against the replicated program). Every refusal records
+    its actual cause in ``LAST_RUN["plan_refusal"]`` — a leaked
+    kill-switch in CI must not surface as a coincidental blocker name."""
+    if os.environ.get("KARPENTER_SHARD_PARTITION", "1").strip().lower() in (
+        "0", "false", "off", "no",
+    ):
+        LAST_RUN["plan_refusal"] = "partition-disabled"
+        return None
+    if n_shards < 2:
+        LAST_RUN["plan_refusal"] = "degenerate-mesh"
+        return None
+    blocker = _partition_blockers(args)
+    if blocker is not None:
+        LAST_RUN["plan_refusal"] = blocker
+        return None
+    g_count = np.asarray(args["g_count"]).astype(np.int64)
+    G = int(g_count.shape[0])
+    real_groups = int((g_count > 0).sum())
+    total_pods = int(g_count.sum())
+    if total_pods <= 0 or real_groups < 4:
+        LAST_RUN["plan_refusal"] = "too-few-groups"
+        return None
+    S = min(n_shards, max(real_groups // 2, 1))
+    if S < 2:
+        LAST_RUN["plan_refusal"] = "too-few-groups"
+        return None
+    need_w, max_alloc = _bin_need(args)
+    total_need = float(need_w.sum())
+    if total_need <= 0 or not (max_alloc > 0).any():
+        LAST_RUN["plan_refusal"] = "no-need"
+        return None
+    # contiguous slices balanced by a hybrid weight: per-shard wall clock
+    # is (scan steps) x (per-step [budget, T] cost), so pure need-balance
+    # piles the many small-demand FFD-tail groups onto the last shard
+    # (169 of 512 in the gate shape) while pure group-balance inflates the
+    # unified budget to the heaviest slice's need. need + mean(need) per
+    # group bounds the step imbalance at ~2x while keeping need (and so
+    # the budget) near-balanced.
+    w = need_w + (g_count > 0) * (total_need / max(real_groups, 1))
+    cum = np.cumsum(w)
+    total = float(cum[-1])
+    cuts = np.searchsorted(cum, total * np.arange(1, S) / S, side="left") + 1
+    bounds = []
+    lo = 0
+    for c in [int(c) for c in cuts] + [G]:
+        hi = min(max(c, lo), G)
+        if hi > lo:
+            bounds.append((lo, hi))
+            lo = hi
+    # the trailing [G] sentinel always extends the last slice to G, so
+    # every row (incl. zero-weight padding) is covered
+    assert lo == G
+    if len(bounds) < 2:
+        LAST_RUN["plan_refusal"] = "single-slice"
+        return None
+    g_demand = np.asarray(args["g_demand"]).astype(np.float64)
+    need = []
+    for blo, bhi in bounds:
+        demand = (g_demand[blo:bhi] * g_count[blo:bhi, None]).sum(axis=0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            lb = np.where(max_alloc > 0, demand / max_alloc, 0.0)
+        est = int(np.ceil(np.nan_to_num(lb).max())) if lb.size else 1
+        pods_s = int(g_count[blo:bhi].sum())
+        need.append(min(max((3 * est) // 2, 8), max(pods_s, 1), 4096))
+    budget = bucket(max(need), lo=8)
+    if max_bins:
+        budget = min(budget, bucket(max_bins, lo=8))
+    g_pad = bucket(max(hi - lo for lo, hi in bounds), lo=8)
+    return ShardPlan(bounds=bounds, g_pad=g_pad, budget=budget, need=need)
+
+
+# --------------------------------------------------------------------------
+# partitioned execution: pipelined per-shard dispatch + merge + repair
+# --------------------------------------------------------------------------
+
+
+def _repair_bound() -> int:
+    try:
+        return max(int(os.environ.get("KARPENTER_SHARD_REPAIR_MAX",
+                                      SHARD_REPAIR_MAX)), 0)
+    except ValueError:
+        return SHARD_REPAIR_MAX
+
+
+def _in_flight(out: dict) -> bool:
+    """True while any array of an async-dispatched shard output has not
+    yet materialized on its device (jax.Array.is_ready)."""
+    for v in out.values():
+        ready = getattr(v, "is_ready", None)
+        if ready is not None and not ready():
+            return True
+    return False
+
+
+def _solve_shards(args: dict, plan: ShardPlan, level_bits: int,
+                  devices=None) -> list:
+    """Dispatch every shard's solve; returns the per-shard (lazy) output
+    dicts. With `devices`, shard s is placed on devices[s % len] and the
+    dispatch is async — shard k+1's host tensorize overlaps shard k's
+    in-flight program (the pipeline). Without devices (the reference
+    replay) everything runs sequentially on the default device — same
+    executable, same numerics, bit-identical outputs."""
+    fn = _jitted_solve_step(plan.budget, 0, level_bits)
+    T = int(np.asarray(args["t_mask"]).shape[0])
+    K, W = np.asarray(args["g_mask"]).shape[1:]
+    g_count = np.asarray(args["g_count"])
+    outs = []
+    overlap = 0.0
+    shard_stats = []
+    for s, (lo, hi) in enumerate(plan.bounds):
+        t0 = time.perf_counter()
+        with obs.span("shard.tensorize", kind="device", shard=s,
+                      groups=hi - lo):
+            local = shard_view(args, lo, hi, plan.g_pad)
+            if devices is not None:
+                dev = devices[s % len(devices)]
+                local = {k: jax.device_put(np.asarray(v), dev)
+                         for k, v in local.items()}
+        tz = time.perf_counter() - t0
+        if devices is not None and s and _in_flight(outs[-1]):
+            # the previous shard's program is STILL unready after this
+            # tensorize finished, so the whole window was hidden under
+            # in-flight device work — genuinely pipelined overlap. A
+            # program that completed before (or during) the tensorize
+            # counts nothing: the signal must be able to read zero when
+            # the pipeline is not actually hiding host time.
+            overlap += tz
+        if devices is not None:
+            # actual = REAL rows only: the trailing slice absorbs the
+            # snapshot's own bucket-padding (count-0) rows, which are as
+            # inert as the shard pad and must count as waste, not work
+            devplane.record_padding(
+                "mesh.shards",
+                int((g_count[lo:hi] > 0).sum()) * T * plan.need[s],
+                plan.g_pad * T * plan.budget,
+            )
+        t0 = time.perf_counter()
+        with obs.span("shard.dispatch", kind="device", shard=s):
+            out = fn(local)
+        dt = time.perf_counter() - t0
+        if devices is not None:
+            devplane.record_dispatch(
+                "mesh.shard",
+                ("part", plan.g_pad, plan.budget, level_bits, K, W, T,
+                 s % len(devices)),
+                dt,
+            )
+        outs.append(out)
+        shard_stats.append({
+            "shard": s, "groups": hi - lo,
+            "pods": int(g_count[lo:hi].sum()),
+            "bins": plan.budget, "bins_est": plan.need[s],
+            "tensorize_ms": round(tz * 1000.0, 2),
+            "dispatch_ms": round(dt * 1000.0, 2),
+        })
+    if devices is not None:
+        devplane.record_shard_overlap(overlap)
+        LAST_RUN["shards"] = shard_stats
+        LAST_RUN["overlap_ms"] = round(overlap * 1000.0, 2)
+    return outs
+
+
+def _merge_shards(host_outs: list, plan: ShardPlan, G: int, T: int) -> dict:
+    """Reconcile per-shard outputs into one global bin axis: shard s owns
+    bins [s*budget, (s+1)*budget), group rows splice back to their slice,
+    and F concatenates exactly (feasibility is group-local). Pure index
+    bookkeeping over int32/bool — no float is recomputed, so the merge is
+    bit-exact by construction on device and replay alike."""
+    S = len(host_outs)
+    Bu = plan.budget
+    Bm = S * Bu
+    assign = np.zeros((G, Bm), dtype=np.int32)
+    used = np.zeros(Bm, dtype=bool)
+    tmpl = np.zeros(Bm, dtype=np.int32)
+    types = np.zeros((Bm, T), dtype=bool)
+    F = np.zeros((G, T), dtype=bool)
+    for s, ((lo, hi), out) in enumerate(zip(plan.bounds, host_outs)):
+        n = hi - lo
+        assign[lo:hi, s * Bu:(s + 1) * Bu] = np.asarray(out["assign"])[:n]
+        used[s * Bu:(s + 1) * Bu] = np.asarray(out["used"])
+        tmpl[s * Bu:(s + 1) * Bu] = np.asarray(out["tmpl"])
+        types[s * Bu:(s + 1) * Bu] = np.asarray(out["types"])
+        F[lo:hi] = np.asarray(out["F"])[:n]
+    return {
+        "assign": assign,
+        "assign_e": np.zeros((G, 1), dtype=np.int32),
+        "used": used,
+        "tmpl": tmpl,
+        "types": types,
+        "F": F,
+    }
+
+
+_EPS = 1e-6
+
+
+def _tmpl_full_rows(args: dict, g: int) -> np.ndarray:
+    """[M] bool — host mirror of the kernel's tmpl_full row for group g
+    (taints/custom-label admission AND template requirement overlap with
+    the Intersects tolerance rule), for the repair pass's new-bin rule."""
+    g_mask = np.asarray(args["g_mask"])[g]
+    g_has = np.asarray(args["g_has"])[g]
+    m_mask = np.asarray(args["m_mask"])
+    m_has = np.asarray(args["m_has"])
+    both = m_has & g_has[None, :]
+    ov = ((m_mask & g_mask[None, :, :]) != 0).any(axis=2)
+    g_tol = args.get("g_tol")
+    m_tol = args.get("m_tol")
+    if g_tol is not None and m_tol is not None:
+        ov = ov | (np.asarray(m_tol) & np.asarray(g_tol)[g][None, :])
+    return np.asarray(args["g_tmpl_ok"])[g] & (~both | ov).all(axis=1)
+
+
+def _repair_merged(args: dict, merged: dict, plan: ShardPlan):
+    """Bounded host repair of straddling pods — pods whose shard ran out
+    of bin budget. Returns (merged, repaired_count) or None when the
+    straddler count exceeds KARPENTER_SHARD_REPAIR_MAX (the caller falls
+    back to the unsharded solve).
+
+    Soundness: a straddler group g only joins a bin whose member groups'
+    requirement rows are bit-equal to g's or empty — then the bin's merged
+    requirement set decomposes per key to g's own (plus the template,
+    whose compat `_tmpl_full_rows` re-checks), the kernel's surviving
+    `types` state already enforces every member's constraints, and
+    `F[g]` is exactly g-vs-type, so `types ∧ F[g]` is the exact joint
+    candidate set — no three-way requirement or offering meet can differ.
+    Capacity uses the kernel's own float32 floor(+eps) arithmetic, and
+    fresh bins open from the weight-best template under the kernel's
+    new-bin rule (minValues/limits are partition blockers, so neither
+    applies here). The pass is deterministic numpy shared verbatim with
+    :func:`partitioned_reference`, keeping device-vs-oracle bit parity
+    through repair."""
+    g_count = np.asarray(args["g_count"]).astype(np.int64)
+    assign = merged["assign"]
+    left = g_count - assign.sum(axis=1)
+    total_left = int(left.sum())
+    if total_left == 0:
+        return merged, 0
+    if total_left > _repair_bound():
+        return None
+    G = g_count.shape[0]
+    g_demand = np.asarray(args["g_demand"], dtype=np.float32)
+    g_mask = np.asarray(args["g_mask"])
+    g_has = np.asarray(args["g_has"])
+    g_tol = np.asarray(args["g_tol"]) if "g_tol" in args else np.zeros_like(g_has)
+    t_alloc = np.asarray(args["t_alloc"], dtype=np.float32)
+    t_tmpl = np.asarray(args["t_tmpl"])
+    m_overhead = np.asarray(args["m_overhead"], dtype=np.float32)
+    bin_cap = np.asarray(args["g_bin_cap"]) if "g_bin_cap" in args else None
+    used, tmpl, types, F = (merged["used"], merged["tmpl"], merged["types"],
+                            merged["F"])
+    load = assign.T.astype(np.float32) @ g_demand
+    load[used] += m_overhead[tmpl[used]]
+    member = assign > 0
+    row_empty = ~g_has.any(axis=1)
+    repaired = 0
+    for g in np.flatnonzero(left):
+        n = int(left[g])
+        d = g_demand[g]
+        pos = d > 0
+        tf = _tmpl_full_rows(args, g)
+        # residual capacity in OTHER shards' bins, requirement-sound per
+        # the decomposability gate above
+        same = ((g_has == g_has[g]).all(axis=1)
+                & (g_tol == g_tol[g]).all(axis=1)
+                & (g_mask == g_mask[g]).reshape(G, -1).all(axis=1))
+        blocked = member[~(same | row_empty)].any(axis=0)
+        cand = used & ~blocked & tf[tmpl]
+        idx = np.flatnonzero(cand)
+        if idx.size and pos.any():
+            adp = t_alloc[:, pos] / d[pos]  # [T,Rp]
+            ldp = load[idx][:, pos] / d[pos]  # [C,Rp]
+            cap_bt = np.floor(
+                (adp[None, :, :] - ldp[:, None, :]).min(axis=2) + _EPS
+            ).astype(np.int64)
+            tok = types[idx] & F[g][None, :]
+            cap_bt = np.where(tok, np.maximum(cap_bt, 0), 0)
+            q = cap_bt.max(axis=1)
+            for j, b in enumerate(idx):
+                if n <= 0:
+                    break
+                room = int(q[j])
+                if bin_cap is not None:
+                    room = min(room, int(bin_cap[g]) - int(assign[g, b]))
+                take = min(n, room)
+                if take <= 0:
+                    continue
+                assign[g, b] += take
+                load[b] += take * d
+                types[b] = tok[j] & (cap_bt[j] >= take)
+                member[g, b] = True
+                n -= take
+                repaired += take
+        if n > 0 and pos.any():
+            # fresh bins from the weight-best template (templates are
+            # pre-sorted by weight, so the first feasible index wins —
+            # the kernel's argmax-over-feasible rule)
+            free_idx = np.flatnonzero(~used)
+            if not free_idx.size:
+                # the merged axis is exactly S x budget and every bin is
+                # occupied (under-budgeted shards — e.g. one pinned type
+                # per group defeats the resource lower bound): GROW the
+                # axis host-side. One new column per remaining pod bounds
+                # the growth by the repair budget; unused rows stay
+                # used=False for the decoder, and the reference replay
+                # shares this code verbatim so bit parity holds.
+                assign = np.concatenate(
+                    [assign, np.zeros((G, n), assign.dtype)], axis=1)
+                member = np.concatenate(
+                    [member, np.zeros((G, n), bool)], axis=1)
+                used = np.concatenate([used, np.zeros(n, used.dtype)])
+                tmpl = np.concatenate([tmpl, np.zeros(n, tmpl.dtype)])
+                types = np.concatenate(
+                    [types, np.zeros((n, types.shape[1]), types.dtype)])
+                load = np.concatenate(
+                    [load, np.zeros((n, load.shape[1]), load.dtype)])
+                merged.update(assign=assign, used=used, tmpl=tmpl,
+                              types=types)
+                free_idx = np.flatnonzero(~used)
+            if free_idx.size:
+                for m in range(m_overhead.shape[0]):
+                    if not tf[m]:
+                        continue
+                    ovh_ok = (m_overhead[m][None, :] <= t_alloc + _EPS
+                              ).all(axis=1)
+                    fresh = t_alloc - m_overhead[m][None, :]
+                    fr = np.floor(
+                        (fresh[:, pos] / d[pos]).min(axis=1) + _EPS
+                    ).astype(np.int64)
+                    ok_t = F[g] & (t_tmpl == m) & ovh_ok & (fr > 0)
+                    if not ok_t.any():
+                        continue
+                    per_node = int(fr[ok_t].max())
+                    if bin_cap is not None:
+                        per_node = min(per_node, int(bin_cap[g]))
+                    if per_node <= 0:
+                        continue
+                    for b in free_idx:
+                        if n <= 0:
+                            break
+                        take = min(n, per_node)
+                        used[b] = True
+                        tmpl[b] = m
+                        assign[g, b] = take
+                        load[b] = m_overhead[m] + take * d
+                        types[b] = ok_t & (fr >= take)
+                        member[g, b] = True
+                        n -= take
+                        repaired += take
+                    break
+        # any residual stays unplaced — the decoder routes it to retry
+        # exactly as it does for the unsharded kernel's spill
+    return merged, repaired
+
+
+def _partitioned_solve(mesh: Mesh, args: dict, max_bins: int,
+                       level_bits: int, plan: ShardPlan):
+    """Run the plan over the mesh's (flattened) devices; returns the
+    merged+repaired host dict, or None when repair exceeded its bound."""
+    devices = list(mesh.devices.reshape(-1))
+    G = int(np.asarray(args["g_count"]).shape[0])
+    T = int(np.asarray(args["t_mask"]).shape[0])
+    outs = _solve_shards(args, plan, level_bits, devices=devices)
+    with obs.span("shard.block", kind="device", engine="mesh",
+                  shards=plan.n_shards):
+        for out in outs:
+            out["used"].block_until_ready()
+    with obs.span("shard.merge", kind="device", engine="mesh"):
+        keys = ("assign", "used", "tmpl", "F", "types")
+        host_outs = [jax.device_get({k: o[k] for k in keys}) for o in outs]
+        merged = _merge_shards(host_outs, plan, G, T)
+    with obs.span("shard.repair", shards=plan.n_shards):
+        repaired = _repair_merged(args, merged, plan)
+    if repaired is None:
+        return None
+    merged, n_rep = repaired
+    if n_rep:
+        devplane.record_shard_repair(n_rep)
+    LAST_RUN["repaired_pods"] = n_rep
+    return merged
+
+
+def partitioned_reference(args: dict, max_bins: int, n_shards: int,
+                          level_bits: int = 20):
+    """The unsharded oracle of the partitioned program: the SAME plan, the
+    SAME per-shard ``solve_step`` executed sequentially on the default
+    device, the SAME merge and repair host code. The mesh execution must
+    be bit-identical to this (tests/test_partitioned_mesh.py); returns
+    None when the snapshot would not partition (callers then compare
+    against the plain unsharded kernel instead)."""
+    plan = plan_shards(args, n_shards, max_bins)
+    if plan is None:
+        return None
+    G = int(np.asarray(args["g_count"]).shape[0])
+    T = int(np.asarray(args["t_mask"]).shape[0])
+    outs = _solve_shards(args, plan, level_bits, devices=None)
+    keys = ("assign", "used", "tmpl", "F", "types")
+    host_outs = [jax.device_get({k: o[k] for k in keys}) for o in outs]
+    merged = _merge_shards(host_outs, plan, G, T)
+    repaired = _repair_merged(args, merged, plan)
+    if repaired is None:
+        return None
+    return repaired[0]
+
+
+# --------------------------------------------------------------------------
+# the replicated program (exact fallback for inexpressible snapshots)
+# --------------------------------------------------------------------------
+
+
+def _replicated_solve(mesh: Mesh, args: dict, max_bins: int,
+                      level_bits: int = 20):
+    """The pre-partition sharded program: feasibility inputs sharded over
+    the mesh, the pack scan consuming the all-gathered F replicated. Kept
+    as the exact fallback for snapshots the partition cannot express
+    (existing nodes, finite limits, topology classes, minValues) — its
+    answer is bit-identical to the unsharded kernel, which is exactly the
+    contract those paths already rely on. Returns lazily; consume via
+    :func:`sharded_solve_host`."""
     n_data, n_model = mesh.devices.shape
 
     def shard(a, spec):
@@ -161,8 +761,7 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
     Tp = args["t_mask"].shape[0]
     devplane.record_padding("mesh.shards", G * T0, Gp * Tp)
 
-    # host→device placement of the shard tensors: the stage the MULTICHIP
-    # overlap work (tensorize shard k+1 while shard k solves) will hide
+    # host→device placement of the shard tensors
     with obs.span("shard.tensorize", kind="device", groups=Gp, types=Tp):
         placed = dict(args)
         for name in G_NAMES:
@@ -187,13 +786,62 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
     return out
 
 
+# --------------------------------------------------------------------------
+# public entry points
+# --------------------------------------------------------------------------
+
+
+def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
+    """Full solve step over the mesh. Routing ladder (module docstring):
+
+    1. **partitioned** — the group axis splits into per-device shards,
+       each packing against its own bin budget; merged + repaired host
+       dict (numpy, already consumed).
+    2. **replicated** — snapshots the partition cannot express (existing
+       nodes, finite limits, topology classes, minValues, single-bin
+       groups) run the old sharded program, bit-identical to the
+       unsharded kernel; returned lazily.
+    3. **unsharded** — degenerate mesh or repair-bound overflow runs the
+       plain jitted kernel.
+
+    Either return shape is consumable via :func:`sharded_solve_host`
+    (numpy dicts pass through; lazy dicts block + gather)."""
+    LAST_RUN.clear()
+    n_devices = int(mesh.devices.size)
+    if n_devices <= 1:
+        LAST_RUN.update(engine="unsharded", reason="degenerate-mesh")
+        max_minv = (int(np.asarray(args["m_minv"]).max())
+                    if "m_minv" in args else 0)
+        return _jitted_solve_step(max_bins, max_minv, level_bits)(args)
+    plan = plan_shards(args, n_devices, max_bins)
+    if plan is None:
+        # plan_shards recorded WHY (blocker name, kill-switch, degenerate
+        # shape) — no second blocker scan over the group tensors here
+        LAST_RUN.update(engine="replicated",
+                        reason=LAST_RUN.get("plan_refusal", "no-plan"))
+        return _replicated_solve(mesh, args, max_bins, level_bits)
+    LAST_RUN.update(engine="partitioned", n_shards=plan.n_shards,
+                    budget=plan.budget, g_pad=plan.g_pad)
+    merged = _partitioned_solve(mesh, args, max_bins, level_bits, plan)
+    if merged is None:
+        # straddlers beyond the repair bound: the partitioned answer is
+        # abandoned for the exact unsharded solve (bounded occurrence —
+        # budgets carry 1.5x headroom, so this is the adversarial tail)
+        LAST_RUN.update(engine="unsharded", reason="repair-bound")
+        devplane.record_shard_fallback("repair-bound")
+        return _jitted_solve_step(max_bins, 0, level_bits)(args)
+    return merged
+
+
 def sharded_solve_host(mesh: Mesh, args: dict, max_bins: int,
                        level_bits: int = 20) -> dict:
-    """Sharded solve consumed to host numpy: ``shard.block`` waits for the
-    in-flight sharded program, ``shard.merge`` gathers the replicated
-    outputs across the mesh into one host dict — the consumption half of
-    the shard-stage decomposition (models/solver.py rides this on the
-    mesh path; the perf harness's multichip row reads the same leaves)."""
+    """Sharded solve consumed to host numpy: ``shard.block`` waits for any
+    in-flight program, ``shard.merge`` gathers to one host dict — the
+    consumption half of the shard-stage decomposition (models/solver.py
+    rides this on the mesh path; the perf harness's multichip rows read
+    the same leaves). The partitioned rung returns an already-merged host
+    dict, so both spans are ~zero there and the real block/merge/repair
+    cost sits in the rung's own leaves."""
     # late-bound through the package attribute so a test double installed
     # on karpenter_tpu.parallel.sharded_solve intercepts this path too
     from karpenter_tpu import parallel as _parallel
@@ -204,7 +852,7 @@ def sharded_solve_host(mesh: Mesh, args: dict, max_bins: int,
         try:
             out["used"].block_until_ready()
         except AttributeError:
-            pass  # already host-side (mocked path)
+            pass  # already host-side (partitioned rung or mocked path)
     with obs.span("shard.merge", kind="device", engine="mesh"):
         return jax.device_get(
             {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}

@@ -201,7 +201,46 @@ class TestSolverIntegration:
         assert env.registry.counter(m.COMPILE_EVENTS).value(
             family="probe.kernel") >= 1
 
-    def test_sharded_solve_host_stage_spans_and_pad_site(self, rec):
+    def test_partitioned_stage_spans_and_per_shard_pad_site(self, rec):
+        """The partitioned rung opens tensorize/dispatch/block/merge/
+        repair leaves, records ONE mesh.shards pad sample PER SHARD, and
+        matches its unsharded oracle bit-for-bit."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a multi-device (virtual) mesh")
+        import numpy as np
+
+        import __graft_entry__ as graft
+        from karpenter_tpu.parallel import make_mesh, sharded_solve_host
+        from karpenter_tpu.parallel.mesh import (
+            LAST_RUN,
+            partitioned_reference,
+        )
+
+        snap = graft._example_snapshot(n_pods=48, n_types=16)
+        args = graft._snapshot_args(snap)
+        mesh = make_mesh(len(jax.devices()))
+        reg = Registry()
+        with obs.round_trace("multichip", registry=reg) as tr:
+            host = sharded_solve_host(mesh, args, 64)
+        assert LAST_RUN.get("engine") == "partitioned"
+        names = {sp.name for sp in tr.spans()}
+        assert {"shard.tensorize", "shard.dispatch", "shard.block",
+                "shard.merge", "shard.repair"} <= names
+        n_shards = LAST_RUN["n_shards"]
+        assert reg.histogram(m.PAD_WASTE_RATIO).count(
+            site="mesh.shards") == n_shards
+        assert reg.counter(m.COMPILE_EVENTS).value(family="mesh.shard") >= 1
+        ref = partitioned_reference(args, 64, len(jax.devices()))
+        assert np.array_equal(np.asarray(host["assign"]), ref["assign"])
+
+    def test_replicated_rung_keeps_stage_spans_and_parity(self, rec,
+                                                          monkeypatch):
+        """With the partition disabled (or any blocker active) the
+        replicated program still opens the pre-partition leaves, records
+        one aggregate pad sample, and stays bit-identical to the
+        unsharded kernel."""
         import jax
 
         if len(jax.devices()) < 2:
@@ -211,18 +250,20 @@ class TestSolverIntegration:
         import __graft_entry__ as graft
         from karpenter_tpu.ops import kernels
         from karpenter_tpu.parallel import make_mesh, sharded_solve_host
+        from karpenter_tpu.parallel.mesh import LAST_RUN
 
+        monkeypatch.setenv("KARPENTER_SHARD_PARTITION", "0")
         snap = graft._example_snapshot(n_pods=48, n_types=16)
         args = graft._snapshot_args(snap)
         mesh = make_mesh(len(jax.devices()))
         reg = Registry()
         with obs.round_trace("multichip", registry=reg) as tr:
             host = sharded_solve_host(mesh, args, 64)
+        assert LAST_RUN.get("engine") == "replicated"
         names = {sp.name for sp in tr.spans()}
         assert {"shard.pad", "shard.tensorize", "shard.dispatch",
                 "shard.block", "shard.merge"} <= names
         assert reg.histogram(m.PAD_WASTE_RATIO).count(site="mesh.shards") == 1
-        assert reg.counter(m.COMPILE_EVENTS).value(family="mesh.shard") >= 1
         ref = kernels.solve_step(args, max_bins=64)
         assert np.array_equal(np.asarray(host["assign"])[: snap.G],
                               np.asarray(ref["assign"]))

@@ -211,7 +211,7 @@ class TestMultiTenantSentinelLeg:
         monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
             cfg: {"config": cfg, "total_ms": 1000.0, "worst_p99_ms": 20.0},
         })
-        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args: {
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
             cfg: {"config": cfg, "total_ms": 1100.0, "worst_p99_ms": 50.0},
         })
         pairs = bench._multitenant_pairs()
@@ -228,7 +228,7 @@ class TestMultiTenantSentinelLeg:
         monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
             cfg: {"config": cfg, "total_ms": 1000.0, "worst_p99_ms": 20.0},
         })
-        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args: {
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
             cfg: {"config": cfg, "total_ms": 9000.0, "worst_p99_ms": 900.0,
                   "degraded": True},
         })
@@ -242,9 +242,173 @@ class TestMultiTenantSentinelLeg:
         monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
             "multitenant-8x3x24": {"total_ms": 1000.0},
         })
-        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args: {
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
             "multitenant-4x2x24": {"config": "multitenant-4x2x24",
                                    "total_ms": 500.0},
         })
         assert bench._multitenant_pairs() == []
         assert "nothing was compared" in capsys.readouterr().err
+
+
+class TestMultichipSentinelLeg:
+    """bench.py's --multichip leg: the parity hard gate, the real-mesh
+    0.8x ratio gate (virtual exempted), the burst host-routing gate, and
+    baseline parsing across BOTH MULTICHIP_r*.json schemas."""
+
+    def _gate_row(self, **kw):
+        row = {"config": "multichip-512x512", "gate": True, "virtual": True,
+               "parity": "exact", "sharded_ms": 400.0, "unsharded_ms": 2300.0,
+               "host_routed_pods": 0}
+        row.update(kw)
+        return row
+
+    def test_parity_mismatch_is_a_hard_gate(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            "multichip-512x512": self._gate_row(parity="mismatch"),
+        })
+        _, problems = bench._multichip_pairs()
+        assert any("parity" in p for p in problems)
+
+    def test_virtual_mesh_exempt_from_ratio_gate(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("PERF_MULTICHIP_PODS", "0")  # burst disabled
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            # sharded slower than 0.8x unsharded, but virtual: parity-only
+            "multichip-512x512": self._gate_row(sharded_ms=2200.0),
+        })
+        pairs, problems = bench._multichip_pairs()
+        assert problems == [] and pairs == []
+
+    def test_gate_row_fallback_reported_as_routing_not_divergence(
+            self, monkeypatch):
+        import bench
+
+        # parity=None means perf never ran the parity check (fallback
+        # rung) — the problem must name the engine, not claim the
+        # merge/repair diverged
+        monkeypatch.setenv("PERF_MULTICHIP_PODS", "0")
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            "multichip-512x512": self._gate_row(parity=None,
+                                                engine="replicated"),
+        })
+        _, problems = bench._multichip_pairs()
+        assert any("engine='replicated'" in p for p in problems)
+        assert not any("diverged" in p for p in problems)
+
+    def test_missing_burst_row_is_a_hard_gate(self, monkeypatch):
+        import bench
+
+        # the burst was NOT disabled via env, yet no burst row printed:
+        # the zero-host-routing gate must fail loudly, not pass by absence
+        monkeypatch.delenv("PERF_MULTICHIP_PODS", raising=False)
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            "multichip-512x512": self._gate_row(),
+        })
+        _, problems = bench._multichip_pairs()
+        assert any("no burst row" in p for p in problems)
+
+    def test_unmatched_baseline_label_not_cross_compared(
+            self, monkeypatch, capsys):
+        import bench
+
+        monkeypatch.setenv("PERF_MULTICHIP_PODS", "0")
+        # a row-schema baseline whose config has no fresh match must be
+        # skipped (legacy tail labels still judge the gate row)
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [
+            ("multichip-500000x1000", 55000.0),
+            ("multichip:legacy-dryrun-tail", 3277.7),
+        ])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            "multichip-512x512": self._gate_row(sharded_ms=400.0),
+        })
+        pairs, problems = bench._multichip_pairs()
+        assert problems == []
+        assert pairs == [("multichip:legacy-dryrun-tail", 3277.7, 400.0)]
+        assert "not compared" in capsys.readouterr().err
+
+    def test_real_mesh_ratio_gate(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            "multichip-512x512": self._gate_row(virtual=False,
+                                                sharded_ms=2200.0),
+        })
+        _, problems = bench._multichip_pairs()
+        assert any("0.8x" in p for p in problems)
+
+    def test_burst_host_routing_is_a_hard_gate(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            "multichip-512x512": self._gate_row(),
+            "multichip-500000x1000": {"config": "multichip-500000x1000",
+                                      "gate": False, "virtual": True,
+                                      "parity": "exact", "sharded_ms": 60000.0,
+                                      "host_routed_pods": 12},
+        })
+        _, problems = bench._multichip_pairs()
+        assert any("routed 12 pods" in p for p in problems)
+
+    def test_baseline_pairs_new_row_schema(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_baseline_multichip", lambda: [
+            ("multichip-512x512", 350.0),
+            ("multichip-500000x1000", 55000.0),
+        ])
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            "multichip-512x512": self._gate_row(sharded_ms=800.0),
+            "multichip-500000x1000": {"config": "multichip-500000x1000",
+                                      "gate": False, "virtual": True,
+                                      "parity": "exact",
+                                      "sharded_ms": 56000.0,
+                                      "host_routed_pods": 0},
+        })
+        pairs, problems = bench._multichip_pairs()
+        assert problems == []
+        assert ("multichip-512x512", 350.0, 800.0) in pairs
+        assert ("multichip-500000x1000", 55000.0, 56000.0) in pairs
+        regressed, _ = bench.regression_table(pairs)
+        assert regressed  # the gate row regressed >15%
+
+    def test_baseline_parses_both_schemas(self, tmp_path, monkeypatch):
+        import json
+
+        import bench
+
+        # legacy dryrun-capture schema: the timing line rides the tail
+        legacy = tmp_path / "MULTICHIP_r05.json"
+        legacy.write_text(json.dumps({
+            "n_devices": 8, "rc": 0, "ok": True,
+            "tail": "dryrun_multichip(8): ... parity=exact\n"
+                    "shard_timing: work=37748736 (gate 2097152, above) "
+                    "sharded_ms=3277.7 unsharded_ms=3193.6\n",
+        }))
+        monkeypatch.setattr(
+            bench, "_newest",
+            lambda pat: str(legacy) if "MULTICHIP" in pat else None)
+        assert bench._baseline_multichip() == [
+            ("multichip:legacy-dryrun-tail", 3277.7)]
+        # new perf-row schema: {"results": [rows]} keyed by config
+        fresh = tmp_path / "MULTICHIP_r06.json"
+        fresh.write_text(json.dumps({"results": [
+            {"config": "multichip-512x512", "sharded_ms": 400.0},
+            {"config": "multichip-500000x1000", "sharded_ms": 58000.0},
+            {"config": "junk"},
+        ]}))
+        monkeypatch.setattr(
+            bench, "_newest",
+            lambda pat: str(fresh) if "MULTICHIP" in pat else None)
+        assert bench._baseline_multichip() == [
+            ("multichip-512x512", 400.0),
+            ("multichip-500000x1000", 58000.0),
+        ]

@@ -195,3 +195,50 @@ class TestWavesExtras:
         h1, m1 = counts()
         assert m1 == m0 and h1 - h0 == s2.G  # all three subgroup rows reused
         assert (s1.g_zone_allowed == s2.g_zone_allowed).all()
+
+
+class TestBatchSignatureIdentityMemo:
+    """batch_signatures' whole-signature identity memo (the 500k
+    first-round per-pod-hash burn-down): tail-free pods sharing spec
+    sub-objects by reference dedup to one tuple build per distinct
+    shape, bit-identical to the per-pod path."""
+
+    def test_identity_dedup_matches_per_pod_signatures(self):
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.ops.tensorize import (
+            batch_signatures,
+            pod_signature,
+        )
+
+        GIB = 2**30
+        shapes = [
+            ({"cpu": 0.5, "memory": 1.0 * GIB}, {"arch": "amd64"}),
+            ({"cpu": 1.0, "memory": 2.0 * GIB}, {}),
+            ({"cpu": 2.0, "memory": 4.0 * GIB}, {"arch": "arm64"}),
+        ]
+        pods = []
+        for i in range(60):
+            req, sel = shapes[i % len(shapes)]  # shared refs, like clones
+            pods.append(Pod(metadata=ObjectMeta(name=f"p{i}"),
+                            requests=req, node_selector=sel))
+        sigs = batch_signatures(pods)
+        assert len(set(sigs)) == len(shapes)
+        for i in (0, 1, 2, 3, 59):
+            fresh = pods[i].clone()  # no cached attribute
+            assert pod_signature(fresh) == sigs[i]
+        # interned: equal signatures collapse to one canonical object
+        assert sigs[0] is sigs[3]
+
+    def test_labeled_pods_never_identity_share(self):
+        """A non-empty tail (labels here) must bypass the identity memo —
+        clone deep-copies those fields, so identity cannot vouch."""
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.ops.tensorize import batch_signatures
+
+        req = {"cpu": 0.5}
+        a = Pod(metadata=ObjectMeta(name="a", labels={"app": "x"}),
+                requests=req)
+        b = Pod(metadata=ObjectMeta(name="b", labels={"app": "y"}),
+                requests=req)
+        sa, sb = batch_signatures([a, b])
+        assert sa != sb
