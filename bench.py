@@ -41,6 +41,14 @@ perf-row schema parse). A >15% regression on any leg prints a delta
 table on stderr and
 exits 3 — the record is still on stdout, so drivers always get their
 line. KARPENTER_BENCH_SENTINEL=0 disables the gate (noisy shared boxes).
+
+The sentinel also gates on the DECISION PLANE (obs/decisions.py): the
+fresh record carries the timed solves' rung summary (detail.rungs), and a
+site that ran a rung strictly below the committed baseline's — the
+headline solved on the host rung, the multichip gate row on the
+replicated or unsharded rung — exits 3 loudly even when the wall clock
+happens to pass (same-engine/same-metric gated, like the ms pair;
+baselines older than the ledger anchor on device_stats.engine).
 """
 
 from __future__ import annotations
@@ -136,11 +144,19 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
     # best of 5: the chip rides a shared tunnel whose round-trip latency
     # jitters by tens of ms between polls; the minimum is the solve's
     # actual capability (every run does identical work)
+    from karpenter_tpu.obs import decisions
+
+    dec0 = decisions.counts()
     elapsed = float("inf")
     for _ in range(5):
         t0 = time.perf_counter()
         res = solver.solve(pods, templates, its)
         elapsed = min(elapsed, time.perf_counter() - t0)
+    # the timed solves' rung summary (obs/decisions.py): the sentinel
+    # fails the run when a site is off its committed baseline top rung —
+    # a headline "solved" on the host rung is a routing regression even
+    # when the wall clock happens to pass
+    rungs = decisions.rung_delta(dec0, decisions.counts())
 
     # pallas A/B on the real chip: the Mosaic compat kernel is kept as a
     # measured reference (ops/pallas_kernels.py STATUS); record both sides
@@ -188,6 +204,7 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
             "nodes": res.node_count(),
             "scheduled": res.scheduled_pod_count(),
             "device_stats": solver.last_device_stats,
+            "rungs": rungs,
             # decomposition context (device engine only): the tunneled chip
             # pays a FIXED ~64ms round trip per solve (kernel compute
             # itself is single-digit ms); host-side tensorize+decode is
@@ -235,6 +252,18 @@ def _newest(pattern: str):
 
 def _baseline_headline():
     """(value_ms, engine, metric) of the newest BENCH_r*.json, or None."""
+    rec = _baseline_headline_record()
+    if rec is None:
+        return None
+    value = rec.get("value")
+    if not isinstance(value, (int, float)):
+        return None
+    return (float(value), (rec.get("detail") or {}).get("engine"),
+            rec.get("metric"))
+
+
+def _baseline_headline_record() -> dict | None:
+    """The newest BENCH_r*.json's parsed record (full dict), or None."""
     path = _newest("BENCH_r*.json")
     if path is None:
         return None
@@ -243,12 +272,73 @@ def _baseline_headline():
             doc = json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
-    rec = doc.get("parsed") or {}
-    value = rec.get("value")
-    if not isinstance(value, (int, float)):
+    rec = doc.get("parsed")
+    return rec if isinstance(rec, dict) else None
+
+
+# pre-decision-ledger records carry only device_stats.engine: map it onto
+# the solver.route rung vocabulary so old baselines still anchor the gate
+# ("device" is the XLA kernel; a mesh-routed solve also reported "device",
+# so mapping to xla can only under-claim the baseline — safe direction)
+_ENGINE_RUNG = {"device": "xla", "native": "native", "host": "host",
+                "remote": "service", "mesh": "mesh"}
+
+
+def _record_rungs(rec: dict) -> dict:
+    """A bench record's {site: {rung: n}} summary; synthesized from
+    device_stats.engine for records older than the decision ledger."""
+    detail = rec.get("detail") or {}
+    rungs = detail.get("rungs")
+    if isinstance(rungs, dict) and rungs:
+        return rungs
+    engine = (detail.get("device_stats") or {}).get("engine")
+    rung = _ENGINE_RUNG.get(engine)
+    return {"solver.route": {rung: 1}} if rung else {}
+
+
+def _worst_rung(site: str, mix: dict) -> str | None:
+    """Worst-ranked rung present in one site's {rung: n} mix."""
+    from karpenter_tpu.obs import decisions
+
+    rungs = [r for r in (mix or {}) if r in decisions.SITES[site]["rungs"]]
+    if not rungs:
         return None
-    return (float(value), (rec.get("detail") or {}).get("engine"),
-            rec.get("metric"))
+    return max(rungs, key=lambda r: decisions.rung_rank(site, r))
+
+
+def _headline_rung_problems(record: dict) -> list:
+    """Hard-gate problems when the fresh headline ran a site on a rung
+    strictly below the committed baseline's worst rung for that site
+    (e.g. the 50k solve landing on the host rung). Engine- and
+    metric-gated exactly like the wall-clock pair — an axon baseline
+    never judges a cpu-ladder rescue."""
+    from karpenter_tpu.obs import decisions
+
+    base = _baseline_headline_record()
+    if base is None:
+        return []
+    if (base.get("detail") or {}).get("engine") != (
+            record.get("detail") or {}).get("engine"):
+        return []
+    if base.get("metric") != record.get("metric"):
+        return []
+    fresh_rungs = _record_rungs(record)
+    base_rungs = _record_rungs(base)
+    problems = []
+    for site in fresh_rungs:
+        if site not in decisions.SITES:
+            continue
+        fresh_worst = _worst_rung(site, fresh_rungs.get(site))
+        base_worst = _worst_rung(site, base_rungs.get(site))
+        if fresh_worst is None or base_worst is None:
+            continue
+        if (decisions.rung_rank(site, fresh_worst)
+                > decisions.rung_rank(site, base_worst)):
+            problems.append(
+                f"headline: {site} ran the {fresh_worst} rung (baseline "
+                f"top rung {base_worst}) — a routing regression, not a "
+                "wall-clock one")
+    return problems
 
 
 def _perf_baseline_rows() -> dict:
@@ -387,6 +477,32 @@ def _baseline_multichip() -> list:
     return []
 
 
+def _baseline_multichip_engines() -> dict:
+    """{config: engine} of the newest committed MULTICHIP_r*.json rows —
+    the baseline side of the mesh.partition rung gate (legacy dryrun-tail
+    captures carry no engine and leave the gate dormant)."""
+    path = _newest("MULTICHIP_r*.json")
+    if path is None:
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    rows = []
+    if isinstance(doc, dict) and isinstance(doc.get("results"), list):
+        rows = doc["results"]
+    elif isinstance(doc, list):
+        rows = doc
+    elif isinstance(doc, dict) and "sharded_ms" in doc:
+        rows = [doc]
+    return {
+        r["config"]: r["engine"]
+        for r in rows
+        if isinstance(r, dict) and r.get("config") and r.get("engine")
+    }
+
+
 def _multichip_pairs():
     """(sentinel pairs, hard-gate problems) for the partitioned multichip
     leg. The GATE row must be parity=exact always; on a real accelerator
@@ -447,6 +563,23 @@ def _multichip_pairs():
             "multichip: no burst row produced (PERF_MULTICHIP_PODS did not "
             "disable it) — the zero-host-routing gate was never evaluated")
     by_config = {r.get("config"): r for r in rows}
+    # mesh.partition rung gate: a fresh row running a rung strictly below
+    # its committed baseline row's (partitioned → replicated/unsharded)
+    # is a routing regression even when its wall clock slides under the
+    # 15% bar — exactly the failure mode that made MULTICHIP_r05 a
+    # replicated no-op for two PRs
+    from karpenter_tpu.obs import decisions as _decisions
+
+    for cfg, base_engine in _baseline_multichip_engines().items():
+        match = by_config.get(cfg)
+        if match is None or not match.get("engine"):
+            continue
+        if (_decisions.rung_rank("mesh.partition", match["engine"])
+                > _decisions.rung_rank("mesh.partition", base_engine)):
+            problems.append(
+                f"multichip: {cfg} ran the {match['engine']} rung "
+                f"(baseline top rung {base_engine}) — off the committed "
+                "mesh.partition rung")
     for label, base_ms in _baseline_multichip():
         # only the legacy dryrun capture (no config key) may judge the gate
         # row; a row-schema label with no matching fresh config must not be
@@ -488,6 +621,15 @@ def sentinel(record: dict, consolidation: bool = False,
             and base[2] == record.get("metric")):
         pairs.append((record.get("metric", "headline"), base[0],
                       float(fresh_value)))
+    # decision-plane gate: a site off its baseline top rung fails even
+    # when the wall clock passes (same engine/metric gating as the pair)
+    h_problems = _headline_rung_problems(record)
+    if h_problems:
+        print("bench: headline rung gate failed "
+              "(KARPENTER_BENCH_SENTINEL=0 to disable):", file=sys.stderr)
+        for p in h_problems:
+            print(f"bench:   {p}", file=sys.stderr)
+        return 3
     if consolidation:
         base_c = _baseline_consolidation()
         # only pay the fresh multi-minute perf run when a baseline exists

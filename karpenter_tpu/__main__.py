@@ -80,9 +80,12 @@ def _apply(env, plural: str, doc: dict) -> int:
 
 def serve_metrics(registry, port: int, host: str = ""):
     """Prometheus text endpoint (the operator.go:160 metrics mux analog)
-    plus the health/SLO surfaces: `/healthz` liveness and `/slo`, a JSON
-    snapshot of the device-plane SLO trackers (rolling request quantiles,
-    error-budget burn) and the compile ledger (obs/devplane.py).
+    plus the health/SLO/introspection surfaces: `/healthz` liveness,
+    `/slo` (a JSON snapshot of the device-plane SLO trackers and the
+    compile ledger, obs/devplane.py), and `/introspect` (the decision
+    plane: per-site rung mixes, last-K round rung summaries, the solve-
+    quality series, per-tenant rung mixes, retained anomalous rounds —
+    obs/decisions.py; `python -m karpenter_tpu.obs report` renders it).
     `host` defaults to all interfaces for containerized scrapes; deploys
     without a NetworkPolicy narrow it via KARPENTER_METRICS_BIND
     (deploy/README.md, network exposure)."""
@@ -90,7 +93,8 @@ def serve_metrics(registry, port: int, host: str = ""):
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path not in ("/metrics", "/healthz", "/slo"):
+            if self.path not in ("/metrics", "/healthz", "/slo",
+                                 "/introspect"):
                 self.send_response(404)
                 self.end_headers()
                 return
@@ -98,6 +102,11 @@ def serve_metrics(registry, port: int, host: str = ""):
                 from karpenter_tpu.obs import devplane
 
                 body = json.dumps(devplane.slo_snapshot()).encode()
+                ctype = "application/json"
+            elif self.path == "/introspect":
+                from karpenter_tpu.obs import decisions
+
+                body = json.dumps(decisions.introspect_snapshot()).encode()
                 ctype = "application/json"
             else:
                 body = (

@@ -39,6 +39,15 @@ runtime:
   deltas, mutate shared ledgers/windows, and feed metric registries:
   all host-side machinery that would freeze at trace time and race
   XLA's runtime (the same failure mode as GL401/402, one module over).
+- GL404 decision-ledger-in-trace: a decision-plane hook
+  (``record_decision``/``record_quality``/``note_round``, or
+  ``record``/``observe_quality`` on a decisions receiver —
+  ``decisions.*``/``DECISIONS``) inside jit-reachable code. The ledger
+  takes a process lock, mutates streak/quality state, feeds metric
+  registries, and can mark anomalies on the open trace — a trace-time
+  execution would freeze ONE batch's verdict into the compiled program
+  (every later solve would re-record it) and race the ledger from XLA's
+  runtime (the same failure mode as GL403, one plane over).
 
 Reachability is an inter-procedural taint pass: entry functions are those
 handed to jit/pallas_call (as decorator, call argument, or via
@@ -64,6 +73,7 @@ RULES = {
     "GL401": "obs tracer span enter/exit (span/round_trace) in jit-reachable code executes at trace time",
     "GL402": "obs flight-recorder mutation (anomaly/record/dump) in jit-reachable code executes at trace time",
     "GL403": "devplane telemetry hook (compile ledger / pad-waste / SLO observe) in jit-reachable code executes at trace time",
+    "GL404": "decision-ledger hook (record_decision / record_quality / decisions receiver) in jit-reachable code executes at trace time",
 }
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
@@ -89,6 +99,13 @@ _OBS_BASES = {"obs", "TRACER", "tracer", "RECORDER", "recorder",
 _DEVPLANE_FUNCS = {"record_dispatch", "record_padding", "record_compile"}
 _DEVPLANE_VERBS = {"observe"}
 _DEVPLANE_BASES = {"devplane", "LEDGER", "ledger"}
+# GL404 — the decision-ledger surface (karpenter_tpu/obs/decisions): the
+# hook names match by final attribute (decisions.record_decision,
+# DECISIONS.record, a bare import); the generic `record`/`observe_quality`
+# verbs only count on an unmistakably decisions receiver.
+_DECISION_FUNCS = {"record_decision", "record_quality", "note_round"}
+_DECISION_VERBS = {"record", "observe_quality"}
+_DECISION_BASES = {"decisions", "DECISIONS"}
 
 
 def _const_names(node) -> set:
@@ -542,6 +559,16 @@ class _TaintVisitor:
                 f"devplane telemetry hook `{fname}(...)` inside "
                 f"jit-reachable `{self.fn.name}` executes at trace time "
                 "(record from the host-side dispatch site)",
+            )
+        elif last in _DECISION_FUNCS or (
+            last in _DECISION_VERBS and base in _DECISION_BASES
+        ):
+            self._flag(
+                "GL404",
+                node.lineno,
+                f"decision-ledger hook `{fname}(...)` inside "
+                f"jit-reachable `{self.fn.name}` executes at trace time "
+                "(record the verdict from the host-side ladder site)",
             )
 
         # GL103 side effects

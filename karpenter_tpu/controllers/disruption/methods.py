@@ -297,8 +297,11 @@ def _device_probe(ctx, probe_fn, method_label, cands, pool):
     only WARNING+ reaches the lastResort stderr handler — the
     models/solver.py precedent)."""
     from karpenter_tpu.models.solver import TPUSolver
+    from karpenter_tpu.obs import decisions
 
     if not isinstance(getattr(ctx.provisioner, "solver", None), TPUSolver):
+        decisions.record_decision("probe.confirm", "sequential", "no-device",
+                                  registry=ctx.registry)
         return None
     try:
         with obs.span("probe", method=method_label, candidates=len(cands)):
@@ -325,6 +328,8 @@ def _device_probe(ctx, probe_fn, method_label, cands, pool):
         # failing stage is attributable from the dump, not just counted
         obs.anomaly("probe-fallback", registry=ctx.registry,
                     method=method_label)
+        decisions.record_decision("probe.confirm", "sequential",
+                                  "probe-error", registry=ctx.registry)
         logging.getLogger(__name__).warning(
             "device consolidation probe (%s) failed; using the sequential "
             "search", method_label, exc_info=True)
@@ -337,6 +342,11 @@ def _device_probe(ctx, probe_fn, method_label, cands, pool):
             "counterfactual rows ranked per batched probe dispatch",
             buckets=m.PROBE_BATCH_BUCKETS,
         ).observe(len(cands), method=method_label)
+    else:
+        # the probe could not express the scenario (no bundle, invisible
+        # candidate, unmapped pods): the method runs the reference search
+        decisions.record_decision("probe.confirm", "sequential",
+                                  "inexpressible", registry=ctx.registry)
     return out
 
 
@@ -414,6 +424,17 @@ class MultiNodeConsolidation(Method):
         if probed is not None:
             k, definitive = probed
             self.last_probe = "device"
+            # the round's probe.confirm verdict (obs/decisions.py): a
+            # definitive ladder pays ONE confirming simulation; a
+            # non-definitive one keeps the gallop/search around the seed.
+            # The sequential rungs were recorded by _device_probe.
+            from karpenter_tpu.obs import decisions
+
+            decisions.record_decision(
+                "probe.confirm",
+                "definitive" if definitive else "gallop",
+                "ok" if definitive else "non-definitive",
+                registry=self.ctx.registry)
             if k < 2:
                 # paranoia confirm of the smallest prefix guards the
                 # probe's residual false-negative corner (f32 rounding);
@@ -536,6 +557,15 @@ class SingleNodeConsolidation(Method):
             return None if res is _TIMED_OUT else res
         feas, definitive = probed
         self.last_probe = "device"
+        # one probe.confirm verdict per ladder descent, mirroring
+        # MultiNode's (sequential rungs recorded by _device_probe)
+        from karpenter_tpu.obs import decisions
+
+        decisions.record_decision(
+            "probe.confirm",
+            "definitive" if definitive else "gallop",
+            "ok" if definitive else "non-definitive",
+            registry=self.ctx.registry)
         # confirm hits in disruption-cost order; probe misses are only
         # SKIPPED, never discarded: when a hit confirms, any miss that
         # precedes it is back-checked first so a probe false negative can

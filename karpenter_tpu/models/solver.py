@@ -18,7 +18,7 @@ import time
 import numpy as np
 
 from karpenter_tpu import obs
-from karpenter_tpu.obs import devplane
+from karpenter_tpu.obs import decisions, devplane
 from karpenter_tpu.api import labels as wk
 from karpenter_tpu.models.inflight import InFlightNodeClaim
 from karpenter_tpu.models.scheduler import NullTopology, Scheduler, SchedulerResults
@@ -197,6 +197,16 @@ def _native_cutoff() -> int:
     return int(os.environ.get("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS))
 
 
+def _exact_skip_enabled() -> bool:
+    """KARPENTER_DECODE_EXACT_SKIP: the decoder's multi-group exact-skip
+    A/B kill switch (resolved per call — decode is host-side)."""
+    import os
+
+    return os.environ.get(
+        "KARPENTER_DECODE_EXACT_SKIP", "1"
+    ).strip().lower() not in ("0", "false", "off", "no")
+
+
 # memoized: is the jax "device" an actual accelerator? On an install whose
 # default backend is plain CPU the XLA path is an emulation of the device
 # kernel — it pays trace/compile and a bin-sequential scan with none of the
@@ -249,6 +259,10 @@ class TPUSolver(Solver):
         self._mesh = None
         self._mesh_checked = False
         self._last_engine = "device"
+        # (rung, reason) of the most recent kernel dispatch, recorded as
+        # the solve's ONE "solver.route" decision-ledger verdict (rungs
+        # mesh/native/xla/service/host — obs/decisions.py)
+        self._route: tuple | None = None
 
     def _maybe_mesh(self):
         """The device mesh when >1 accelerator is attached (ICI on real
@@ -316,6 +330,7 @@ class TPUSolver(Solver):
                 host_routed={reason: len(pods)} if pods else {},
                 cold_compiles=0, pad_waste_ratio=0.0,
             )
+            decisions.record_decision("solver.route", "host", reason)
             return res
         existing_nodes = list(existing_nodes)
         # per-stage wall clock of this solve (waves compile / tensorize /
@@ -369,6 +384,8 @@ class TPUSolver(Solver):
                     host_routed=host_routed, cold_compiles=0,
                     pad_waste_ratio=0.0, **stages,
                 )
+                decisions.record_decision("solver.route", "host",
+                                          "no-device-groups")
                 return self.host.solve(
                     pods,
                     templates,
@@ -407,6 +424,8 @@ class TPUSolver(Solver):
                     host_routed=host_routed, cold_compiles=0,
                     pad_waste_ratio=0.0,
                 )
+                decisions.record_decision("solver.route", "host",
+                                          "no-eligible")
                 return self.host.solve(
                     pods,
                     templates,
@@ -438,8 +457,14 @@ class TPUSolver(Solver):
                 esnap = tensorize_existing(snap, existing_nodes, device_plan)
                 stages["tensorize_ms"] = stages.get("tensorize_ms", 0.0) + (
                     time.perf_counter() - t0) * 1000.0
+        self._route = None
         claims, retry, ecommits = self._run_and_decode(
             snap, esnap, max_bins, stages)
+        if self._route is not None:
+            # the solve's ONE solver.route verdict: which engine the
+            # kernel ultimately ran on (a doubled re-run overwrites — the
+            # final rung is the round's answer)
+            decisions.record_decision("solver.route", *self._route)
         _pad_padded = devplane.STATS["pad_cells_padded"] - _dp0[2]
         _pad_actual = devplane.STATS["pad_cells_actual"] - _dp0[1]
         self.last_device_stats = dict(
@@ -529,6 +554,7 @@ class TPUSolver(Solver):
         R = len(snap.resources)
         M = len(snap.templates)
         total_pods = int(snap.g_count.sum())
+        floor = None  # the demand lower bound (the quality account's floor)
         if max_bins:
             B = max_bins
         else:
@@ -569,6 +595,7 @@ class TPUSolver(Solver):
                 cls_lb = np.ceil(cnt.sum(axis=0) / np.maximum(cap_c, 1)).max()
                 cap_lb = max(cap_lb, int(cls_lb))
             est = max(est, min(cap_lb, total_pods))
+            floor = est
             # 1.5x FFD headroom: the doubling re-run below catches a miss
             B = min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
         Gp, Tp, Bp = _bucket(G), _bucket(T), _bucket(B)
@@ -655,6 +682,20 @@ class TPUSolver(Solver):
             if retry and grow:
                 B, Bp = B2, Bp2
                 continue
+            if floor is not None and floor > 0 and claims and not retry:
+                # solve-quality account: bins opened vs. the demand lower
+                # bound this very method computed — the live analog of the
+                # perf rows' nodes-vs-floor headline. A steady-state ratio
+                # drift fires the solve-overhead-drift anomaly
+                # (obs/decisions.py; family = the compiled shape bucket so
+                # only comparable solves share a baseline). Retry-bearing
+                # solves are excluded: their claims cover only part of the
+                # floor's demand, and the artificially low ratio would
+                # ratchet the family baseline below what any complete
+                # solve can reach — every later healthy solve would then
+                # read as drift.
+                decisions.record_quality(len(claims), floor,
+                                         family=f"{Gp}x{Tp}")
             return claims, retry, ecommits
 
     def _invoke(self, args, key, max_bins):
@@ -695,6 +736,10 @@ class TPUSolver(Solver):
             if native_ok:
                 try:
                     self._last_engine = "native"
+                    self._route = ("native",
+                                   "small-batch" if total <= cutoff
+                                   else "work-floor" if work < min_work
+                                   else "cpu-backend")
                     with obs.span("solve.native", kind="device"):
                         return native.solve_step(args, max_bins)
                 except Exception:
@@ -724,8 +769,10 @@ class TPUSolver(Solver):
             # the shard-stage decomposition (shard.pad/tensorize/dispatch/
             # block/merge device leaves + the mesh.shard compile-ledger
             # family) lives inside the parallel module
+            self._route = ("mesh", "ok")
             return sharded_solve_host(mesh, args, max_bins,
                                       level_bits=key[-2])
+        self._route = ("xla", "ok")
         # dispatch vs block bracketed separately: JAX dispatch is async, so
         # the first span is host-side launch cost (plus any compile) and
         # the second is the actual device wait — the trace's host/device
@@ -848,11 +895,24 @@ class TPUSolver(Solver):
             ]
             snap._tmpl_keymeta = tmeta
         tkeys, off_free = tmeta[m]
-        exact = (
-            off_free
-            and all(tkeys.isdisjoint(snap.group_reqs[g].keys()) for g in gset)
-            and (len(gset) == 1 or self._decomposable(snap, gset))
-        )
+        # the decision tree below is the same predicate the old one-liner
+        # evaluated — split so the decode.recheck verdict can carry WHY
+        # the exactness argument did not apply (obs/decisions.py)
+        if not off_free:
+            exact, why = False, "offering-keys"
+        elif not all(
+            tkeys.isdisjoint(snap.group_reqs[g].keys()) for g in gset
+        ):
+            exact, why = False, "group-key-overlap"
+        elif len(gset) == 1 or self._decomposable(snap, gset):
+            exact, why = True, "ok"
+        elif not _exact_skip_enabled():
+            exact, why = False, "disabled"
+        else:
+            exact, why = False, "non-decomposable"
+        decisions.record_decision(
+            "decode.recheck", "skip" if exact else "full",
+            "no-candidates" if exact and not tsel.size else why)
         if exact and tsel.size:
             # count only bins where a merged re-check was actually
             # avoided — with zero surviving candidates the re-check is a
@@ -949,11 +1009,7 @@ class TPUSolver(Solver):
         few row compares per DISTINCT (template, group-set) key, amortized
         by the compat cache. KARPENTER_DECODE_EXACT_SKIP=0 disables this
         arm for A/B (the seeded parity suite pins on/off equality)."""
-        import os
-
-        if os.environ.get("KARPENTER_DECODE_EXACT_SKIP", "1").strip().lower() in (
-            "0", "false", "off", "no",
-        ):
+        if not _exact_skip_enabled():
             return False
         has = snap.g_has
         mask = snap.g_mask
@@ -1208,6 +1264,7 @@ class NativeSolver(TPUSolver):
         from karpenter_tpu import native
 
         self._last_engine = "native"
+        self._route = ("native", "ok")
         return native.solve_step(args, max_bins)
 
 

@@ -16,9 +16,15 @@ Contract (cross-referenced from ops/consolidate.py and ops/tensorize.py):
   ``cold-compile-in-steady-state`` anomaly), pow-2 padding-waste
   accounting, and the SLO trackers behind the metrics server's ``/slo``
   endpoint. Its hooks are host-only under the same gate (GL403).
+- :mod:`karpenter_tpu.obs.decisions` is the decision plane: every
+  fallback-ladder site records one ``(site, rung, reason)`` verdict per
+  invocation (``karpenter_decision_total``, the ``rung-regression`` and
+  ``solve-overhead-drift`` anomalies, the ``/introspect`` surface and
+  ``python -m karpenter_tpu.obs report``). Its hooks are host-only under
+  GL404.
 """
 
-from karpenter_tpu.obs import devplane
+from karpenter_tpu.obs import decisions, devplane
 from karpenter_tpu.obs.recorder import FlightRecorder, chrome_events
 from karpenter_tpu.obs.trace import (
     RECORDER,
@@ -39,6 +45,7 @@ from karpenter_tpu.obs.trace import (
 __all__ = [
     "FlightRecorder",
     "chrome_events",
+    "decisions",
     "devplane",
     "RECORDER",
     "TRACER",

@@ -1,0 +1,141 @@
+"""``python -m karpenter_tpu.obs report`` — human rendering of the fleet
+introspection surface.
+
+Fetches the ``/introspect`` JSON (decision-ledger rung mixes, last-K round
+rung summaries, the solve-quality series, per-tenant rung mixes, retained
+anomalous rounds — obs/decisions.py) from a running metrics server
+(``--url http://host:port``) or reads a saved snapshot (``--file``), and
+with neither renders THIS process's ledger (useful from a REPL or a test).
+
+    python -m karpenter_tpu.obs report --url http://127.0.0.1:8080
+    python -m karpenter_tpu.obs report --file introspect.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["render_report", "main"]
+
+
+def _fmt_mix(rungs: dict) -> str:
+    parts = []
+    for rung, reasons in sorted(rungs.items()):
+        if isinstance(reasons, dict):
+            n = sum(reasons.values())
+            why = ",".join(
+                f"{r}:{c}" for r, c in sorted(reasons.items()) if r != "ok"
+            )
+            parts.append(f"{rung}={n}" + (f" ({why})" if why else ""))
+        else:
+            parts.append(f"{rung}={reasons}")
+    return "  ".join(parts)
+
+
+def render_report(snapshot: dict) -> str:
+    """The introspect JSON as a human-readable report (pure — the CLI
+    smoke test feeds it a canned snapshot)."""
+    lines = ["decision plane"]
+    lines.append("=" * 64)
+    sites = snapshot.get("sites") or {}
+    if not sites:
+        lines.append("  (no decisions recorded)")
+    for site, srow in sorted(sites.items()):
+        last = srow.get("last") or {}
+        held = srow.get("held") or {}
+        head = f"  {site:18s} last={last.get('rung', '-')}"
+        if last.get("reason") and last.get("reason") != "ok":
+            head += f"/{last['reason']}"
+        if held:
+            head += f"  held={held.get('rung')}x{held.get('streak')}"
+        lines.append(head)
+        lines.append(f"    {_fmt_mix(srow.get('rungs') or {})}")
+    quality = snapshot.get("quality") or {}
+    series = quality.get("series") or []
+    if series:
+        lines.append("")
+        lines.append("solve quality (nodes / pods-cap floor)")
+        for fam, st in sorted((quality.get("families") or {}).items()):
+            flag = "  DRIFTING" if st.get("violating") else ""
+            lines.append(
+                f"  {fam:12s} baseline={st.get('baseline')} "
+                f"streak={st.get('streak')}{flag}")
+        tail = series[-5:]
+        lines.append("  recent: " + "  ".join(
+            f"{s.get('nodes')}/{s.get('floor')}={s.get('ratio')}"
+            for s in tail))
+    rounds = snapshot.get("rounds") or []
+    if rounds:
+        lines.append("")
+        lines.append(f"last {len(rounds)} rounds")
+        for r in rounds:
+            mix = "; ".join(
+                f"{site}:" + ",".join(
+                    f"{rung}x{sum(reasons.values())}"
+                    for rung, reasons in sorted(srow.items()))
+                for site, srow in sorted((r.get("decisions") or {}).items())
+            )
+            lines.append(f"  {r.get('round')} [{r.get('trace_id')}]  {mix}")
+    tenants = snapshot.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append("per-tenant rung mix")
+        for tenant, mix in sorted(tenants.items()):
+            row = "; ".join(
+                f"{site}:" + _fmt_mix(rungs)
+                for site, rungs in sorted(mix.items()))
+            lines.append(f"  {tenant:16s} {row}")
+    anomalies = snapshot.get("anomalies") or []
+    if anomalies:
+        lines.append("")
+        lines.append("active anomalies (flight-recorder ring)")
+        for a in anomalies:
+            lines.append(
+                f"  {a.get('round')} [{a.get('trace_id')}]  "
+                f"{','.join(a.get('kinds') or [])}  "
+                f"dump={a.get('dump') or '-'}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="karpenter_tpu.obs")
+    sub = ap.add_subparsers(dest="cmd")
+    rep = sub.add_parser(
+        "report", help="render the /introspect decision-plane snapshot")
+    rep.add_argument("--url", default=None,
+                     help="metrics-server base URL (fetches <url>/introspect)")
+    rep.add_argument("--file", default=None,
+                     help="read a saved introspect JSON instead of fetching")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the raw JSON instead of the rendered report")
+    rep.add_argument("-k", type=int, default=16,
+                     help="rounds/anomalies to include (in-process source)")
+    args = ap.parse_args(argv)
+    if args.cmd != "report":
+        ap.print_help()
+        return 2
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            args.url.rstrip("/") + "/introspect", timeout=10
+        ) as r:
+            snapshot = json.loads(r.read().decode())
+    elif args.file:
+        with open(args.file) as f:
+            snapshot = json.load(f)
+    else:
+        from karpenter_tpu.obs import decisions
+
+        snapshot = decisions.introspect_snapshot(k=args.k)
+    if args.json:
+        print(json.dumps(snapshot, indent=2, sort_keys=True))
+    else:
+        print(render_report(snapshot))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,6 +55,7 @@ __all__ = [
     "STATS",
     "record_dispatch",
     "record_padding",
+    "record_shard_balance",
     "record_shard_fallback",
     "record_shard_overlap",
     "record_shard_repair",
@@ -82,6 +83,9 @@ STATS = {
     "shard_overlap_ms": 0.0,
     "shard_repair_pods": 0,
     "shard_fallbacks": 0,
+    # max/mean hybrid shard weight of the most recent partition plan
+    # (1.0 = perfectly balanced; the ROADMAP's next mesh lever)
+    "shard_balance_ratio": 0.0,
 }
 _STATS_LOCK = threading.Lock()
 
@@ -263,6 +267,22 @@ def record_shard_repair(pods: int, registry=None) -> None:
         _m.SHARD_REPAIR_PODS,
         "straddling pods re-packed by the partitioned mesh repair pass",
     ).inc(pods)
+
+
+def record_shard_balance(ratio: float, registry=None) -> None:
+    """Shard-balance quality of one partition plan: max/mean hybrid shard
+    weight (parallel/mesh.py plan_shards). 1.0 is a perfectly balanced
+    partition; the hybrid weight bounds it at ~2x without minimizing it,
+    and this gauge is the surface the ROADMAP's balance lever reads."""
+    ratio = max(float(ratio), 0.0)
+    with _STATS_LOCK:
+        STATS["shard_balance_ratio"] = ratio
+    from karpenter_tpu.operator import metrics as _m
+
+    _resolve_registry(registry).gauge(
+        _m.SHARD_BALANCE_RATIO,
+        "max/mean shard weight of the most recent partitioned mesh plan",
+    ).set(ratio)
 
 
 def record_shard_fallback(reason: str, registry=None) -> None:
@@ -479,4 +499,5 @@ def reset():
             cold_compiles=0, compile_ms=0.0, warm_dispatches=0,
             pad_dispatches=0, pad_cells_actual=0.0, pad_cells_padded=0.0,
             shard_overlap_ms=0.0, shard_repair_pods=0, shard_fallbacks=0,
+            shard_balance_ratio=0.0,
         )

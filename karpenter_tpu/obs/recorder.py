@@ -143,6 +143,13 @@ class FlightRecorder:
                     "wall_start": trace.wall_start,
                     "anomalies": [k for k, _, _ in trace.anomalies],
                     "dropped_spans": trace.dropped,
+                    # the round's decision-ledger verdicts (obs/decisions):
+                    # which rungs this round ran, right next to its spans
+                    "decisions": [
+                        {"site": s, "rung": r, "reason": why, "n": n}
+                        for (s, r, why), n in sorted(
+                            getattr(trace, "decisions", {}).items())
+                    ],
                 },
             }
             with open(path, "w", encoding="utf-8") as f:

@@ -125,6 +125,9 @@ class Trace:
         self.wall_start = time.time()
         self.root = Span(name, "host", threading.get_ident(), attrs)
         self.anomalies: list = []  # (kind, attrs, perf_counter stamp)
+        # (site, rung, reason) -> count: the round's decision-ledger
+        # verdicts (obs/decisions.py), carried into the Chrome dump
+        self.decisions: dict = {}
         self.dropped = 0
         self.dump_path: str | None = None
         # an idle round (the owner found nothing to do) opts out of the
@@ -147,6 +150,11 @@ class Trace:
     def add_anomaly(self, kind: str, attrs: dict | None):
         with self._lock:
             self.anomalies.append((kind, attrs, time.perf_counter()))
+
+    def add_decision(self, site: str, rung: str, reason: str):
+        with self._lock:
+            key = (site, rung, reason)
+            self.decisions[key] = self.decisions.get(key, 0) + 1
 
     # -- derived views (call after the round closed) ----------------------
     def spans(self):
@@ -387,6 +395,12 @@ class Tracer:
         if trace.discarded and not trace.anomalies:
             return
         self._feed_metrics(trace)
+        if trace.decisions:
+            # the decision ledger keeps a last-K ring of per-round rung
+            # summaries for the /introspect surface (obs/decisions.py)
+            from karpenter_tpu.obs import decisions as _decisions
+
+            _decisions.note_round(trace)
         rec = self.recorder
         if rec is not None:
             rec.record(trace)
@@ -490,13 +504,18 @@ def configure(enabled: bool | None = None, dump_dir: str | None = None,
 
 def reset():
     """Restore env defaults and clear the ring + this thread's stack
-    (test isolation)."""
+    (test isolation). Also clears the decision ledger — its streak state
+    feeds anomalies into rounds this tracer records, so the two must
+    reset together or a prior test's held rung leaks a regression."""
     TRACER.enabled = _env_enabled()
     TRACER._tls.trace = None
     TRACER._tls.stack = []
     RECORDER.configure(dump_dir=_env_dir(), capacity=_env_capacity(),
                        dump_all=_env_dump_all())
     RECORDER.clear()
+    from karpenter_tpu.obs import decisions as _decisions
+
+    _decisions.reset()
     return TRACER, RECORDER
 
 

@@ -303,6 +303,15 @@ SOLVER_REQUEST_BYTES_BUCKETS = (
     1e3, 1e4, 1e5, 1e6, 5e6, 1e7, 5e7, 1e8, 2.56e8,
 )
 SOLVER_BLEED_CHECKS = f"{NAMESPACE}_solver_bleed_checks_total"
+# decision plane (karpenter_tpu/obs/decisions.py): one ladder verdict per
+# site invocation (labels site/rung/reason, reasons drawn from the closed
+# per-site enums so cardinality is bounded), the per-solve node-count
+# overhead over the solver's pods-cap floor, and per-multichip-solve shard
+# balance (max/mean hybrid shard weight, parallel/mesh.py plan_shards) —
+# see deploy/README.md "Decision plane"
+DECISION_TOTAL = f"{NAMESPACE}_decision_total"
+SOLVE_OVERHEAD_RATIO = f"{NAMESPACE}_solve_overhead_ratio"
+SHARD_BALANCE_RATIO = f"{NAMESPACE}_shard_balance_ratio"
 # span-derived families fed by the reconcile flight recorder
 # (karpenter_tpu/obs): per-span self time, round durations, anomaly
 # trigger counts, and trace files written

@@ -105,6 +105,16 @@ family in the compile ledger (``probe.kernel`` — a cold compile during a
 long warm streak trips the ``cold-compile-in-steady-state`` trace dump).
 Metric semantics live in deploy/README.md ("Device-plane & SLO
 telemetry").
+
+The same transitions also feed the DECISION ledger
+(:mod:`karpenter_tpu.obs.decisions`): every stale-bundle resolution
+records exactly one ``("snapshot.advance", delta|rebuild, reason)``
+verdict — the rebuild reason is the actual inexpressible-delta cause
+(opaque-entry / journal-gap / plan / limits / unseen-signature /
+unseen-pending / ineligible-pending / churn / candidate-widened, a closed
+enum) — so a delta path that quietly dies in steady state fires the
+``rung-regression`` trace dump instead of only nudging a miss counter.
+See deploy/README.md "Decision plane".
 """
 
 from __future__ import annotations
@@ -222,6 +232,10 @@ class DisruptionSnapshot:
         self._shared = None
         self._dims = None
         self._claimable = None
+        # why the most recent delta-advance attempt declined (the
+        # snapshot.advance decision ledger's rebuild reason — one of the
+        # site's closed-enum causes, obs/decisions.py)
+        self.advance_refusal: str | None = None
 
     def columns_for(self, candidates):
         """Existing-node columns for the queried candidates; None when any
@@ -343,16 +357,20 @@ class DisruptionSnapshot:
     def _advance(self, cluster, store, deltas, generation, registry) -> bool:
         from karpenter_tpu.utils import pod as pod_util
 
+        self.advance_refusal = None
         if self.plan is not None or self.topology is None:
+            self.advance_refusal = "plan"
             return False
         if self.inputs[3]:
             # nodepool limits are remaining = spec - usage: every node
             # add/delete moves usage, and the cached inputs would go stale
+            self.advance_refusal = "limits"
             return False
         dirty_pids: set = set()
         pod_events = []
         for d in deltas:
             if d is None:
+                self.advance_refusal = "opaque-entry"
                 return False  # opaque: nodepool/daemonset/resync
             if d[0] == "node":
                 dirty_pids.add(d[1])
@@ -372,6 +390,7 @@ class DisruptionSnapshot:
                 continue
             if not device_basic_eligible(pod):
                 if not node_name:
+                    self.advance_refusal = "ineligible-pending"
                     return False  # pending pods must stay expressible
                 sn = cluster.node_by_name(node_name)
                 if sn is not None:
@@ -383,6 +402,7 @@ class DisruptionSnapshot:
                 continue
             g = self.sig_to_group.get(interned_signature(pod))
             if g is None:
+                self.advance_refusal = "unseen-signature"
                 return False  # unseen scheduling shape: new group/vocab
             self.gidx_of[pod.uid] = g
 
@@ -407,6 +427,7 @@ class DisruptionSnapshot:
                 self.col_by_pid.pop(pid, None)
         churn = len(dirty_nodes) + len(removed) + len(added_nodes)
         if churn > max(16, esnap.E // 2):
+            self.advance_refusal = "churn"
             return False  # a wave: rebuilding also re-compacts the E axis
         esnap.apply_delta(
             self.snap, dirty=dirty_nodes, removed=removed, added=added_nodes,
@@ -426,6 +447,7 @@ class DisruptionSnapshot:
         for p in pending:
             g = self.gidx_of.get(p.uid)
             if g is None:
+                self.advance_refusal = "unseen-pending"
                 return False  # a pod the journal never surfaced
             base[g] += 1
         self.pending = pending
@@ -782,6 +804,7 @@ class SnapshotCache:
     def __init__(self):
         self._bundle = None
         self._neg = None  # (generation, build_key) of a failed build
+        self._last_refusal = None  # why the last delta-advance declined
 
     def get(self, provisioner, cluster, store, candidates, registry=None):
         from karpenter_tpu.operator import metrics as m
@@ -789,6 +812,7 @@ class SnapshotCache:
         generation = cluster.consolidation_state()
         key = frozenset(c.provider_id for c in candidates)
         b = self._bundle
+        advanced = None
         if b is not None and b.generation == generation and key <= b.build_key:
             if registry is not None:
                 registry.counter(
@@ -801,8 +825,13 @@ class SnapshotCache:
             # structured delta journal instead of re-tensorizing the fleet
             # (tensorize.py "Existing-node delta contract"); anything the
             # journal can't express falls through to the full rebuild below
-            b2 = self._try_advance(cluster, store, generation, registry)
+            b2 = advanced = self._try_advance(cluster, store, generation,
+                                             registry)
             if b2 is not None and key <= b2.build_key:
+                from karpenter_tpu.obs import decisions
+
+                decisions.record_decision("snapshot.advance", "delta",
+                                          registry=registry)
                 return b2
         if self._neg == (generation, key):
             # an inexpressible build is generation-stable: don't re-pay the
@@ -827,6 +856,21 @@ class SnapshotCache:
             # entry, inexpressible churn) or the candidate key widened.
             # The round's trace shows which; the first-ever build of a
             # process is NOT an anomaly (there was nothing to advance).
+            # The decision ledger records the same transition with the
+            # actual inexpressible-delta cause (closed enum,
+            # obs/decisions.py) — a delta path quietly dying shows up as a
+            # rung regression, not just a miss counter.
+            from karpenter_tpu.obs import decisions
+
+            b_old = self._bundle
+            if b_old.generation >= generation or advanced is not None:
+                # same-generation (or already-advanced) displacement: only
+                # a wider candidate key forces the rebuild
+                reason = "candidate-widened"
+            else:
+                reason = self._last_refusal or "journal-gap"
+            decisions.record_decision("snapshot.advance", "rebuild", reason,
+                                      registry=registry)
             obs.anomaly("snapshot-rebuild", registry=registry,
                         generation=generation)
         b = build_disruption_snapshot(provisioner, cluster, store, candidates)
@@ -865,7 +909,13 @@ class SnapshotCache:
         if b.generation == generation:
             return b
         if b.generation < generation:
-            return self._try_advance(cluster, store, generation, registry)
+            b2 = self._try_advance(cluster, store, generation, registry)
+            if b2 is not None:
+                from karpenter_tpu.obs import decisions
+
+                decisions.record_decision("snapshot.advance", "delta",
+                                          registry=registry)
+            return b2
         return None
 
     def _try_advance(self, cluster, store, generation, registry):
@@ -874,10 +924,14 @@ class SnapshotCache:
         Returns the advanced bundle or None (opaque/inexpressible/gap)."""
         b = self._bundle
         deltas = getattr(cluster, "deltas_since", lambda g: None)(b.generation)
-        if deltas is None or not b.advance(
-            cluster, store, deltas, generation, registry=registry
-        ):
+        if deltas is None:
+            self._last_refusal = "journal-gap"
             return None
+        if not b.advance(cluster, store, deltas, generation,
+                         registry=registry):
+            self._last_refusal = b.advance_refusal or "opaque-entry"
+            return None
+        self._last_refusal = None
         if registry is not None:
             from karpenter_tpu.operator import metrics as m
 

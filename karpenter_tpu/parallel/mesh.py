@@ -58,7 +58,15 @@ conflict/spread/affinity classes (cross-GROUP bin state) route to the
 replicated sharded program (`_replicated_solve`, bit-identical to the
 unsharded kernel — the pre-partition contract); a degenerate mesh or a
 repair overflow routes to the plain unsharded solve. ``LAST_RUN`` records
-which rung ran and why.
+which rung ran and why, and every ``sharded_solve`` call additionally
+records exactly one ``("mesh.partition", rung, reason)`` verdict on the
+decision ledger (:mod:`karpenter_tpu.obs.decisions` — reasons are the
+refusal causes above, drawn from the site's closed enum), so a
+steady-state loss of the partitioned rung fires the ``rung-regression``
+trace dump instead of hiding in a diagnostics dict; ``plan_shards`` also
+exports each plan's shard-balance quality (max/mean hybrid shard weight,
+``karpenter_shard_balance_ratio``). See deploy/README.md "Decision
+plane".
 
 Stage attribution (obs flight recorder + devplane): ``shard.tensorize``
 (per-shard host slice/pad/placement), ``shard.dispatch`` (async launch,
@@ -348,6 +356,16 @@ def plan_shards(args: dict, n_shards: int, max_bins: int | None = None
     if len(bounds) < 2:
         LAST_RUN["plan_refusal"] = "single-slice"
         return None
+    # shard-balance quality of this plan: max/mean hybrid shard weight.
+    # The hybrid weight bounds imbalance at ~2x but doesn't minimize it
+    # (ROADMAP names shard balance as the next mesh lever) — the ratio is
+    # its first surface (karpenter_shard_balance_ratio gauge + the
+    # multichip perf rows via LAST_RUN).
+    shard_w = np.array([float(w[lo:hi].sum()) for lo, hi in bounds])
+    mean_w = float(shard_w.mean()) if shard_w.size else 0.0
+    balance = float(shard_w.max() / mean_w) if mean_w > 0 else 1.0
+    LAST_RUN["balance_ratio"] = round(balance, 4)
+    devplane.record_shard_balance(balance)
     g_demand = np.asarray(args["g_demand"]).astype(np.float64)
     need = []
     for blo, bhi in bounds:
@@ -806,10 +824,14 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
 
     Either return shape is consumable via :func:`sharded_solve_host`
     (numpy dicts pass through; lazy dicts block + gather)."""
+    from karpenter_tpu.obs import decisions
+
     LAST_RUN.clear()
     n_devices = int(mesh.devices.size)
     if n_devices <= 1:
         LAST_RUN.update(engine="unsharded", reason="degenerate-mesh")
+        decisions.record_decision("mesh.partition", "unsharded",
+                                  "degenerate-mesh")
         max_minv = (int(np.asarray(args["m_minv"]).max())
                     if "m_minv" in args else 0)
         return _jitted_solve_step(max_bins, max_minv, level_bits)(args)
@@ -819,6 +841,8 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
         # shape) — no second blocker scan over the group tensors here
         LAST_RUN.update(engine="replicated",
                         reason=LAST_RUN.get("plan_refusal", "no-plan"))
+        decisions.record_decision("mesh.partition", "replicated",
+                                  LAST_RUN.get("reason", "no-plan"))
         return _replicated_solve(mesh, args, max_bins, level_bits)
     LAST_RUN.update(engine="partitioned", n_shards=plan.n_shards,
                     budget=plan.budget, g_pad=plan.g_pad)
@@ -829,7 +853,10 @@ def sharded_solve(mesh: Mesh, args: dict, max_bins: int, level_bits: int = 20):
         # budgets carry 1.5x headroom, so this is the adversarial tail)
         LAST_RUN.update(engine="unsharded", reason="repair-bound")
         devplane.record_shard_fallback("repair-bound")
+        decisions.record_decision("mesh.partition", "unsharded",
+                                  "repair-bound")
         return _jitted_solve_step(max_bins, 0, level_bits)(args)
+    decisions.record_decision("mesh.partition", "partitioned")
     return merged
 
 

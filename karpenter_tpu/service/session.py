@@ -48,7 +48,6 @@ resyncs.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import uuid
@@ -114,34 +113,11 @@ class CrossTenantBleed(SessionError):
     status = "INTERNAL"
 
 
-def env_int(name: str, default: int, minimum: int | None = None) -> int:
-    """The service plane's ONE env-knob parser (shared with coalesce.py
-    and solver_service.py so empty-string/garbage/clamp behavior cannot
-    drift between knobs): empty or unparseable falls back to `default`,
-    `minimum` clamps the floor."""
-    try:
-        v = int(os.environ.get(name, "") or default)
-    except ValueError:
-        v = default
-    return v if minimum is None else max(v, minimum)
-
-
-def env_float(name: str, default: float,
-              minimum: float | None = None) -> float:
-    try:
-        v = float(os.environ.get(name, "") or default)
-    except ValueError:
-        v = default
-    return v if minimum is None else max(v, minimum)
-
-
-def env_bool(name: str, default: bool) -> bool:
-    """Unset/empty falls back to `default`; 0/false/off/no (any case)
-    disable, anything else enables."""
-    v = os.environ.get(name, "").strip().lower()
-    if not v:
-        return default
-    return v not in ("0", "false", "off", "no")
+# the ONE env-knob parser trio, hoisted to utils/envknobs.py when the
+# decision ledger needed the same semantics below the service layer;
+# re-exported here so every existing importer (coalesce, solver_service,
+# perf, bench) keeps its spelling
+from karpenter_tpu.utils.envknobs import env_bool, env_float, env_int  # noqa: E402,F401
 
 
 class TenantSession:

@@ -352,6 +352,23 @@ class _SolverHandler:
                     client_trace=meta.get("trace_id") or None,
                     tenant=tenant,
                 ):
+                    # the server half of the session.sync decision ledger:
+                    # one tenant-labeled verdict per request, feeding the
+                    # /introspect per-tenant rung mix (the client records
+                    # its own half in its process). Full-upload reasons
+                    # ride the client's `sync_reason` meta, clamped into
+                    # the site's closed enum.
+                    from karpenter_tpu.obs import decisions
+
+                    if meta.get("mode") == "delta":
+                        decisions.record_decision(
+                            "session.sync", "delta",
+                            registry=self._registry, tenant=tenant)
+                    else:
+                        decisions.record_decision(
+                            "session.sync", "resync",
+                            meta.get("sync_reason") or "initial",
+                            registry=self._registry, tenant=tenant)
                     out = self._dispatch(args, key, max_bins)
             return _pack(self._outputs(out), {
                 "mode": meta.get("mode", "full"),
@@ -620,6 +637,13 @@ class RemoteSolver(TPUSolver):
         reason = self._fallback_reason(e)
         if reason == "transport" and self._retryable(e):
             reason = "transport-retryable"
+        # bounded label cardinality (the decision-ledger stance): a server
+        # exception class outside the known set clamps to "server-error"
+        # instead of minting a fresh series per novel bug
+        from karpenter_tpu.obs import decisions
+
+        if reason not in decisions.SOLVER_FALLBACK_REASONS:
+            reason = "server-error"
         trace_id = obs.current_trace_id()
         self._registry.counter(
             _metrics.SOLVER_REMOTE_FALLBACKS,
@@ -627,7 +651,13 @@ class RemoteSolver(TPUSolver):
         ).inc(code=code, reason=reason)
         self._log.warn("solver service unavailable; solving in-process",
                        code=code, reason=reason, trace=trace_id or "")
-        return super()._invoke(args, key, max_bins)
+        out = super()._invoke(args, key, max_bins)
+        if self._route is not None:
+            # the solve's solver.route verdict keeps the in-process rung
+            # the rescue actually ran, but the REASON says why it left the
+            # service rung — the downgrade is visible on the ledger
+            self._route = (self._route[0], "remote-fallback")
+        return out
 
     def _record_payload(self, kind: str, nbytes: int, codec: str | None):
         from karpenter_tpu.operator import metrics as _metrics
@@ -661,6 +691,7 @@ class RemoteSolver(TPUSolver):
         except grpc.RpcError as e:
             return self._fallback(e, args, key, max_bins)
         self._last_engine = "remote"
+        self._route = ("service", "ok")
         arrays, _ = _unpack(blob)
         arrays["used"] = arrays["used"].astype(bool)
         arrays["F"] = arrays["F"].astype(bool)
@@ -669,6 +700,7 @@ class RemoteSolver(TPUSolver):
     # -- session mode ----------------------------------------------------
 
     def _count_resync(self, reason: str):
+        from karpenter_tpu.obs import decisions
         from karpenter_tpu.operator import metrics as _metrics
 
         self.session_stats["resyncs"] += 1
@@ -676,7 +708,11 @@ class RemoteSolver(TPUSolver):
             _metrics.SOLVER_SESSION_RESYNCS,
             "session full re-uploads by cause (journal gaps, opaque "
             "deltas, server resync demands)",
-        ).inc(reason=reason)
+        ).inc(
+            # the label universe IS the session.sync decision enum: a new
+            # server error class can never mint an unbounded series here
+            # while the ledger stays closed (obs/decisions.py)
+            reason=decisions.canonical_reason("session.sync", reason))
 
     def _register_session(self, st: _FamilyState):
         req: dict = {"tenant": self._tenant}
@@ -741,6 +777,8 @@ class RemoteSolver(TPUSolver):
         fallback."""
         import grpc
 
+        from karpenter_tpu.obs import decisions
+
         args = {k: np.asarray(v) for k, v in args.items()}
         st = self._family_state(args)
         payload, pending = self._session_payload(args, meta_base, st)
@@ -763,19 +801,30 @@ class RemoteSolver(TPUSolver):
                 st.stale = st.session_id
                 st.session_id = None
             st.sent = None  # the server's view is gone either way
-            payload, pending = self._session_payload(args, meta_base, st)
+            payload, pending = self._session_payload(args, meta_base, st,
+                                                     demand_reason=head)
             blob = self._call_with_retry(self._call_session, payload)
+        decision = pending.pop("decision")
         self._commit_session(st, **pending)
+        # the round's ONE client-side session.sync verdict: the rung the
+        # round ultimately shipped (a demand-answered round records the
+        # resync rung with the server's demand class as the reason)
+        decisions.record_decision("session.sync", *decision,
+                                  registry=self._registry,
+                                  tenant=self._tenant)
         return blob
 
-    def _session_payload(self, args, meta_base: dict,
-                         st: _FamilyState) -> tuple:
+    def _session_payload(self, args, meta_base: dict, st: _FamilyState,
+                         demand_reason: str | None = None) -> tuple:
         """(wire payload, commit kwargs). Decides full vs delta: full on
         first contact with this shape family, a journal gap, or an opaque
         journal entry; delta otherwise — changed arrays only, row-spliced
         when less than half the leading axis moved. `args` shapes always
         match `st.sent` by construction (the family key IS every array's
-        name/shape/dtype), so there is no shape-change case."""
+        name/shape/dtype), so there is no shape-change case.
+        `demand_reason` names the server demand a re-upload answers (the
+        session.sync decision's reason, also shipped as `sync_reason`
+        meta so the server's ledger half attributes the full upload)."""
         from karpenter_tpu.service.session import ROWS_SUFFIX, VALS_SUFFIX
 
         if st.session_id is None:
@@ -799,11 +848,14 @@ class RemoteSolver(TPUSolver):
         if full_reason is not None:
             if full_reason:
                 self._count_resync(full_reason)
-            meta.update(mode="full", generation=generation)
+            sync_reason = demand_reason or full_reason or "initial"
+            meta.update(mode="full", generation=generation,
+                        sync_reason=sync_reason)
             codec = self._upload_codec()
             payload = _pack(args, meta, codec=codec)
             self._record_payload("full", len(payload), codec)
             stat = "full_uploads"
+            decision = ("resync", sync_reason)
         else:
             patch: dict = {}
             wire: dict = {}
@@ -832,8 +884,9 @@ class RemoteSolver(TPUSolver):
             payload = _pack(wire, meta)  # deltas are small: no codec
             self._record_payload("delta", len(payload), None)
             stat = "delta_rounds"
+            decision = ("delta", "ok")
         return payload, dict(args=args, seq=seq, generation=generation,
-                             stat=stat)
+                             stat=stat, decision=decision)
 
     def _commit_session(self, st: _FamilyState, args, seq, generation, stat):
         st.sent = args
@@ -879,7 +932,7 @@ def main(argv=None) -> int:
             host=os.environ.get("KARPENTER_METRICS_BIND", ""),
         )
         print(f"solver service: metrics on :{args.metrics_port} "
-              f"(/metrics /healthz /slo)", flush=True)
+              f"(/metrics /healthz /slo /introspect)", flush=True)
     print(f"solver service: listening on {args.host}:{bound} "
           f"({'native' if args.native else 'device'} engine)", flush=True)
     stop.wait()

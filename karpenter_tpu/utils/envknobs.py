@@ -1,0 +1,43 @@
+"""The ONE env-knob parser trio (int/float/bool).
+
+Originally grown in service/session.py so the service plane's knobs could
+not drift in empty-string/garbage/clamp behavior; hoisted here when the
+decision ledger (obs/decisions.py) needed the same semantics from a layer
+that must not import the service plane (service → models → obs would
+cycle). service/session.py re-exports these names, so every existing
+importer keeps working.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_int", "env_float", "env_bool"]
+
+
+def env_int(name: str, default: int, minimum: int | None = None) -> int:
+    """Empty or unparseable falls back to `default`; `minimum` clamps the
+    floor."""
+    try:
+        v = int(os.environ.get(name, "") or default)
+    except ValueError:
+        v = default
+    return v if minimum is None else max(v, minimum)
+
+
+def env_float(name: str, default: float,
+              minimum: float | None = None) -> float:
+    try:
+        v = float(os.environ.get(name, "") or default)
+    except ValueError:
+        v = default
+    return v if minimum is None else max(v, minimum)
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Unset/empty falls back to `default`; 0/false/off/no (any case)
+    disable, anything else enables."""
+    v = os.environ.get(name, "").strip().lower()
+    if not v:
+        return default
+    return v not in ("0", "false", "off", "no")

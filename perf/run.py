@@ -90,9 +90,11 @@ ORACLE_POD_CAP = int(os.environ.get("PERF_ORACLE_CAP", "20000"))
 
 def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
     from karpenter_tpu.models import HostSolver, TPUSolver
+    from karpenter_tpu.obs import decisions
 
     solver = TPUSolver()
     _solve_timed(solver, pods, pools, catalog, **solver_kw)  # warm compile + caches
+    dec0 = decisions.counts()
     trace_out = None
     if trace:
         # the timed solve runs as one traced round: the row embeds the
@@ -139,6 +141,10 @@ def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
         # rows — the warmup solve above owns the compile cost)
         "pad_waste_ratio": stats.get("pad_waste_ratio", 0.0),
         "cold_compiles": stats.get("cold_compiles", 0),
+        # per-row rung summary (obs/decisions.py): which ladder rungs the
+        # timed solve ran — bench.py's sentinel fails loudly when a site
+        # leaves its baseline top rung (e.g. the headline on the host rung)
+        "rungs": decisions.rung_delta(dec0, decisions.counts()),
         "breakdown": breakdown,
     }
     if trace_out is not None:
@@ -161,11 +167,14 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
     # re-exports the tensorize FUNCTION under that name, shadowing the module
     _tz = importlib.import_module("karpenter_tpu.ops.tensorize")
 
+    from karpenter_tpu.obs import decisions
+
     n_nodes = n_nodes or int(os.environ.get("PERF_CONSOLIDATION_NODES", "300"))
     env = C.config4_consolidation_env(n_nodes)
     start_nodes = len(env.store.list("nodes"))
     start_pods = len([p for p in env.store.list("pods") if p.node_name])
     stats0 = dict(_tz.STATS)  # process-wide: delta against the env build
+    dec0 = decisions.counts()
     t0 = time.perf_counter()
     rounds = 0
     stable = 0
@@ -274,6 +283,10 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
         ),
         # reference budget: ≤60s per multi-node search (multinodeconsolidation.go:37)
         "within_1min_budget": bool(hist.sum(method="MultiNodeConsolidation") <= 60.0),
+        # the run's rung mix (probe.confirm / snapshot.advance /
+        # solver.route …): the decision-plane complement of the cache and
+        # confirm counters above
+        "rungs": decisions.rung_delta(dec0, decisions.counts()),
         **out_extra,
     }))
 
@@ -312,12 +325,15 @@ def _multichip_row(jax, mesh, snap, args, trace, gate=False,
         pcap = float(snap.t_alloc[:, snap.resources.index(resutil.PODS)].max())
         if 0 < pcap < 1 << 18:
             level_bits = max(4, int(np.ceil(np.log2(2 * pcap + 4))))
+    from karpenter_tpu.obs import decisions
+
     sharded_solve_host(mesh, args, B, level_bits=level_bits)  # warm compile
     dp0 = (devplane.STATS["cold_compiles"],
            devplane.STATS["pad_cells_actual"],
            devplane.STATS["pad_cells_padded"],
            devplane.STATS["shard_overlap_ms"],
            devplane.STATS["shard_repair_pods"])
+    dec0 = decisions.counts()
     t0 = time.perf_counter()
     with obs.round_trace(config) as tr:
         host = sharded_solve_host(mesh, args, B, level_bits=level_bits)
@@ -405,6 +421,11 @@ def _multichip_row(jax, mesh, snap, args, trace, gate=False,
         "per_shard": per_shard,
         "pad_waste_ratio": round(1.0 - pa / pp, 4) if pp > 0 else 0.0,
         "cold_compiles": devplane.STATS["cold_compiles"] - dp0[0],
+        # shard-balance quality of the partition plan (max/mean hybrid
+        # shard weight — karpenter_shard_balance_ratio's perf-row twin)
+        "balance_ratio": LAST_RUN.get("balance_ratio"),
+        # the timed solve's mesh.partition verdict, for bench's rung gate
+        "rungs": decisions.rung_delta(dec0, decisions.counts()),
     }
     if trace and tr is not None:
         out["trace"] = {
@@ -701,9 +722,13 @@ def run_multitenant(n_tenants: int | None = None, rounds: int | None = None,
             pre, "karpenter_solver_coalesce_batch_size_sum"))
         coalesced0 = sum(v for _, v in _prom(
             pre, "karpenter_solver_coalesced_requests_total"))
+        from karpenter_tpu.obs import decisions
+
+        dec0 = decisions.counts()
         sizes: dict = {}
         fleet_errors: dict = {}
         total_ms = run_fleet("tenant", sizes, errors=fleet_errors)
+        fleet_rungs = decisions.rung_delta(dec0, decisions.counts())
         missing = [f"tenant-{i}" for i in range(n_tenants)
                    if f"tenant-{i}" not in sizes]
         if missing:
@@ -805,6 +830,10 @@ def run_multitenant(n_tenants: int | None = None, rounds: int | None = None,
                 and deltas["resyncs"] == 0
             ),
             "isolation_ok": isolation_ok,
+            # client-side rung mix of the measured phase (session.sync
+            # delta-vs-resync, solver.route service-vs-rescue): steady
+            # state reads all-delta / all-service
+            "rungs": fleet_rungs,
             # >0 means some solves never crossed the service: the latency
             # fields describe a degraded run (the sentinel skips it); a
             # zero single-tenant p99 means the baseline itself never hit

@@ -1,0 +1,842 @@
+"""Decision ledger (karpenter_tpu/obs/decisions): the site×rung×reason
+matrix (closed enums, unknown reasons clamped, unknown sites/rungs
+raising), exactly one record per ladder-site invocation across the real
+producers (mesh routing, solver routing, decode re-check, snapshot
+advance, probe confirm, session sync), the rung-regression anomaly
+(steady-streak downgrade fires exactly one trace dump, first-sight
+exempt), the solve-quality drift anomaly, the /introspect endpoint, and
+the `python -m karpenter_tpu.obs report` CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs import decisions
+from karpenter_tpu.obs.decisions import (
+    DecisionLedger,
+    SITES,
+    canonical_reason,
+    rung_delta,
+    rung_rank,
+)
+from karpenter_tpu.operator import metrics as m
+from karpenter_tpu.operator.metrics import Registry
+
+GIB = 2**30
+
+
+@pytest.fixture
+def rec(tmp_path):
+    """Isolated ledger + tracer/recorder state, dumps at tmp_path."""
+    obs.configure(enabled=True, dump_dir=str(tmp_path), capacity=8,
+                  dump_all=False)
+    obs.RECORDER.clear()
+    decisions.reset()
+    yield tmp_path
+    decisions.reset()
+    obs.reset()
+
+
+def dumps_in(tmp_path):
+    return sorted(p for p in os.listdir(tmp_path) if p.endswith(".trace.json"))
+
+
+# ---------------------------------------------------------------------------
+# the site × rung × reason matrix
+# ---------------------------------------------------------------------------
+
+class TestSiteMatrix:
+    def test_every_site_rung_and_reason_is_recordable(self, rec):
+        """The full closed matrix: every (site, rung, enum reason) records
+        and counts under its own labels."""
+        reg = Registry()
+        n = 0
+        for site, spec in SITES.items():
+            for rung in spec["rungs"]:
+                for reason in sorted(spec["reasons"]):
+                    got = decisions.record_decision(site, rung, reason,
+                                                    registry=reg)
+                    assert got == reason
+                    n += 1
+        counts = decisions.counts()
+        assert sum(counts.values()) == n
+        for site, spec in SITES.items():
+            for rung in spec["rungs"]:
+                for reason in spec["reasons"]:
+                    assert counts[(site, rung, reason)] == 1
+                    assert reg.counter(m.DECISION_TOTAL).value(
+                        site=site, rung=rung, reason=reason) == 1
+
+    def test_unknown_reason_clamps_to_other(self, rec):
+        reg = Registry()
+        got = decisions.record_decision(
+            "session.sync", "resync", "SomeNovelServerError", registry=reg)
+        assert got == "other"
+        assert reg.counter(m.DECISION_TOTAL).value(
+            site="session.sync", rung="resync", reason="other") == 1
+        # no series under the raw string: cardinality stays bounded
+        assert reg.counter(m.DECISION_TOTAL).value(
+            site="session.sync", rung="resync",
+            reason="SomeNovelServerError") == 0
+
+    def test_unknown_site_and_rung_raise(self, rec):
+        with pytest.raises(ValueError):
+            decisions.record_decision("no.such.site", "x")
+        with pytest.raises(ValueError):
+            decisions.record_decision("mesh.partition", "no-such-rung")
+
+    def test_canonical_reason_and_rank_helpers(self):
+        assert canonical_reason("mesh.partition", "") == "ok"
+        assert canonical_reason("mesh.partition", None) == "ok"
+        assert canonical_reason("mesh.partition", "min-values") == "min-values"
+        assert canonical_reason("mesh.partition", "???") == "other"
+        assert rung_rank("mesh.partition", "partitioned") == 0
+        assert rung_rank("mesh.partition", "unsharded") == 2
+        assert rung_rank("mesh.partition", "bogus") == 3
+
+    def test_rung_delta_between_snapshots(self, rec):
+        c0 = decisions.counts()
+        decisions.record_decision("solver.route", "xla")
+        decisions.record_decision("solver.route", "xla")
+        decisions.record_decision("decode.recheck", "skip")
+        assert rung_delta(c0, decisions.counts()) == {
+            "solver.route": {"xla": 2},
+            "decode.recheck": {"skip": 1},
+        }
+
+    def test_record_attaches_to_open_round_trace(self, rec):
+        with obs.round_trace("demo") as tr:
+            decisions.record_decision("solver.route", "native", "small-batch")
+            decisions.record_decision("solver.route", "native", "small-batch")
+        assert tr.decisions == {
+            ("solver.route", "native", "small-batch"): 2}
+        # and the Chrome dump carries them in otherData
+        path = obs.RECORDER.dump(tr)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["otherData"]["decisions"] == [
+            {"site": "solver.route", "rung": "native",
+             "reason": "small-batch", "n": 2}]
+
+
+class TestProducerEnumsClosed:
+    """Satellite pin: the scattered producers' literal reason strings are
+    members of the per-site closed enums, so the existing counters'
+    labels can never drift from the decision ledger's."""
+
+    def test_mesh_refusal_causes_are_enum_members(self):
+        import inspect
+
+        from karpenter_tpu.parallel import mesh
+
+        src = inspect.getsource(mesh)
+        import re
+
+        produced = set(re.findall(r'plan_refusal"\] = "([^"]+)"', src))
+        produced |= {"no-plan", "repair-bound", "degenerate-mesh"}
+        assert produced, "refusal producers vanished — update the pin"
+        assert produced <= SITES["mesh.partition"]["reasons"]
+
+    def test_session_resync_reasons_are_enum_members(self):
+        produced = {
+            "initial", "journal-gap", "opaque-delta",
+            # the server demand classes the client re-uploads for
+            "ResyncRequired", "SessionExpired", "UnknownSession",
+            "OutOfOrderDelta",
+        }
+        assert produced <= SITES["session.sync"]["reasons"]
+
+    def test_snapshot_advance_refusals_are_enum_members(self):
+        import inspect
+
+        from karpenter_tpu.ops import consolidate
+
+        src = inspect.getsource(consolidate)
+        import re
+
+        produced = set(re.findall(r'advance_refusal = "([^"]+)"', src))
+        produced |= set(re.findall(r'_last_refusal = "([^"]+)"', src))
+        assert produced, "refusal producers vanished — update the pin"
+        assert produced <= SITES["snapshot.advance"]["reasons"]
+
+    def test_remote_fallback_reason_set_bounds_cardinality(self):
+        assert "transport" in decisions.SOLVER_FALLBACK_REASONS
+        assert "transport-retryable" in decisions.SOLVER_FALLBACK_REASONS
+        assert "server-error" in decisions.SOLVER_FALLBACK_REASONS
+
+
+# ---------------------------------------------------------------------------
+# rung-regression anomaly
+# ---------------------------------------------------------------------------
+
+class TestRungRegression:
+    def _anoms(self, reg):
+        return reg.counter(m.TRACE_ANOMALIES).value(kind="rung-regression")
+
+    def test_steady_downgrade_fires_exactly_once(self, rec):
+        led = DecisionLedger(steady_after=3)
+        reg = Registry()
+        for _ in range(3):
+            led.record("mesh.partition", "partitioned", registry=reg)
+        assert self._anoms(reg) == 0
+        led.record("mesh.partition", "replicated", "existing-nodes",
+                   registry=reg)
+        assert self._anoms(reg) == 1
+        # the downgraded rung is now held: repeating it never refires
+        for _ in range(5):
+            led.record("mesh.partition", "replicated", "existing-nodes",
+                       registry=reg)
+        assert self._anoms(reg) == 1
+
+    def test_first_sight_exemption(self, rec):
+        led = DecisionLedger(steady_after=1)
+        reg = Registry()
+        # a site's FIRST record is never a regression, even straight onto
+        # the bottom rung
+        led.record("mesh.partition", "unsharded", "degenerate-mesh",
+                   registry=reg)
+        assert self._anoms(reg) == 0
+
+    def test_short_streak_does_not_fire(self, rec):
+        led = DecisionLedger(steady_after=4)
+        reg = Registry()
+        for _ in range(3):  # below the steady threshold
+            led.record("solver.route", "xla", registry=reg)
+        led.record("solver.route", "host", "no-eligible", registry=reg)
+        assert self._anoms(reg) == 0
+
+    def test_refires_after_recovery_and_new_streak(self, rec):
+        led = DecisionLedger(steady_after=2)
+        reg = Registry()
+        for _ in range(2):
+            led.record("session.sync", "delta", registry=reg)
+        led.record("session.sync", "resync", "journal-gap", registry=reg)
+        assert self._anoms(reg) == 1
+        for _ in range(2):  # recover and re-hold the top rung
+            led.record("session.sync", "delta", registry=reg)
+        led.record("session.sync", "resync", "opaque-delta", registry=reg)
+        assert self._anoms(reg) == 2
+
+    def test_benign_reason_neither_fires_nor_breaks_the_streak(self, rec):
+        """A new shape family's initial upload mid-delta-streak is
+        expected universe growth (the client's family LRU churning), not
+        a regression — and it must not reset the held streak, so a REAL
+        resync after it still fires."""
+        led = DecisionLedger(steady_after=3)
+        reg = Registry()
+        for _ in range(3):
+            led.record("session.sync", "delta", registry=reg)
+        led.record("session.sync", "resync", "initial", registry=reg)
+        assert self._anoms(reg) == 0
+        led.record("session.sync", "delta", registry=reg)  # streak continues
+        led.record("session.sync", "resync", "journal-gap", registry=reg)
+        assert self._anoms(reg) == 1
+
+    def test_calibrated_routing_flip_is_benign(self, rec):
+        """A bigger batch leaving the native crossover (xla after a
+        native streak) is the router doing its job."""
+        led = DecisionLedger(steady_after=2)
+        reg = Registry()
+        for _ in range(4):
+            led.record("solver.route", "native", "small-batch", registry=reg)
+        led.record("solver.route", "xla", registry=reg)  # rank below native
+        assert self._anoms(reg) == 0
+        # but the armed reasons still fire: a host route after the streak
+        led.record("solver.route", "host", "no-eligible", registry=reg)
+        assert self._anoms(reg) == 1
+
+    def test_upgrade_never_fires(self, rec):
+        led = DecisionLedger(steady_after=1)
+        reg = Registry()
+        for _ in range(4):
+            led.record("solver.route", "native", "small-batch", registry=reg)
+        led.record("solver.route", "mesh", registry=reg)  # an upgrade
+        assert self._anoms(reg) == 0
+
+    def test_forced_steady_state_downgrade_dumps_exactly_one_trace(
+            self, rec, monkeypatch):
+        """The acceptance path, against the REAL producers: mesh.partition
+        and snapshot.advance each held their top rung, then downgraded —
+        the round that paid the downgrade dumps exactly once."""
+        monkeypatch.setenv("KARPENTER_RUNG_STEADY_AFTER", "3")
+        decisions.reset()
+        reg = Registry()
+        # mesh.partition: simulate via the ledger's public hook with the
+        # producer's literal strings (the sharded_solve integration is
+        # pinned separately below)
+        for i in range(3):
+            with obs.round_trace(f"solve-{i}", registry=reg):
+                decisions.record_decision("mesh.partition", "partitioned",
+                                          registry=reg)
+        assert dumps_in(rec) == []
+        with obs.round_trace("solve-downgrade", registry=reg):
+            decisions.record_decision("mesh.partition", "replicated",
+                                      "partition-disabled", registry=reg)
+        assert len(dumps_in(rec)) == 1
+        # snapshot.advance: same machinery, second site — exactly one MORE
+        for i in range(3):
+            with obs.round_trace(f"disrupt-{i}", registry=reg):
+                decisions.record_decision("snapshot.advance", "delta",
+                                          registry=reg)
+        with obs.round_trace("disrupt-downgrade", registry=reg):
+            decisions.record_decision("snapshot.advance", "rebuild",
+                                      "opaque-entry", registry=reg)
+        assert len(dumps_in(rec)) == 2
+        # the dump names the trigger
+        newest = [p for p in dumps_in(rec) if "disrupt-downgrade" in p]
+        with open(os.path.join(rec, newest[0])) as f:
+            doc = json.load(f)
+        assert "rung-regression" in doc["otherData"]["anomalies"]
+
+
+# ---------------------------------------------------------------------------
+# solve-quality account
+# ---------------------------------------------------------------------------
+
+class TestQualityAccount:
+    def _drifts(self, reg):
+        return reg.counter(m.TRACE_ANOMALIES).value(
+            kind="solve-overhead-drift")
+
+    def _led(self, steady=3, tol=0.1, min_floor=0):
+        led = DecisionLedger()
+        led.q_steady_after = steady
+        led.q_tol = tol
+        led.q_min_floor = min_floor
+        return led
+
+    def test_gauge_and_series(self, rec):
+        reg = Registry()
+        ratio = decisions.record_quality(12, 10, family="64x64", registry=reg)
+        assert ratio == pytest.approx(1.2)
+        assert reg.gauge(m.SOLVE_OVERHEAD_RATIO).value(
+            family="64x64") == pytest.approx(1.2)
+        q = decisions.DECISIONS.quality_summary()
+        assert q["series"][-1]["nodes"] == 12
+        assert q["series"][-1]["floor"] == 10
+
+    def test_steady_state_drift_fires_exactly_once(self, rec):
+        led = self._led(steady=3, tol=0.1)
+        reg = Registry()
+        for _ in range(3):
+            led.observe_quality(10, 10, family="f", registry=reg)
+        assert self._drifts(reg) == 0
+        led.observe_quality(14, 10, family="f", registry=reg)  # +40%
+        assert self._drifts(reg) == 1
+        # still violating: no refire until it recovers and re-holds
+        led.observe_quality(14, 10, family="f", registry=reg)
+        assert self._drifts(reg) == 1
+        for _ in range(3):
+            led.observe_quality(10, 10, family="f", registry=reg)
+        led.observe_quality(14, 10, family="f", registry=reg)
+        assert self._drifts(reg) == 2
+
+    def test_no_drift_without_steady_streak(self, rec):
+        led = self._led(steady=4, tol=0.1)
+        reg = Registry()
+        led.observe_quality(10, 10, family="f", registry=reg)
+        led.observe_quality(14, 10, family="f", registry=reg)
+        assert self._drifts(reg) == 0
+
+    def test_small_floors_never_arm_the_detector(self, rec):
+        led = self._led(steady=1, tol=0.1, min_floor=8)
+        reg = Registry()
+        for _ in range(5):
+            led.observe_quality(1, 1, family="toy", registry=reg)
+        led.observe_quality(3, 1, family="toy", registry=reg)
+        assert self._drifts(reg) == 0
+
+    def test_families_isolated(self, rec):
+        led = self._led(steady=2, tol=0.1)
+        reg = Registry()
+        for _ in range(2):
+            led.observe_quality(10, 10, family="a", registry=reg)
+        # a different family's high ratio is ITS baseline, not a's drift
+        led.observe_quality(30, 10, family="b", registry=reg)
+        assert self._drifts(reg) == 0
+
+
+# ---------------------------------------------------------------------------
+# one record per invocation — the real producers
+# ---------------------------------------------------------------------------
+
+def _nodepool(name="default"):
+    from karpenter_tpu.api.nodepool import NodePool
+    from karpenter_tpu.api.objects import ObjectMeta
+
+    return NodePool(metadata=ObjectMeta(name=name))
+
+
+def _pods(n):
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+
+    return [Pod(metadata=ObjectMeta(name=f"p{i}"),
+                requests={"cpu": 0.5 + (i % 4) * 0.5, "memory": 1 * GIB})
+            for i in range(n)]
+
+
+class TestSolverRouteInvocations:
+    def test_device_solve_records_exactly_one_route(self, rec):
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.inflight import ClaimTemplate
+
+        pool = _nodepool()
+        its = {pool.name: benchmark_catalog(8)}
+        s = TPUSolver()
+        c0 = decisions.counts()
+        s.solve([p.clone() for p in _pods(6)], [ClaimTemplate(pool)], its)
+        delta = rung_delta(c0, decisions.counts())
+        assert sum(delta.get("solver.route", {}).values()) == 1
+        # conftest pins KARPENTER_NATIVE_CUTOFF=0: the XLA rung
+        assert delta["solver.route"] == {"xla": 1}
+
+    def test_no_templates_records_host_rung(self, rec):
+        from karpenter_tpu.models import TPUSolver
+
+        s = TPUSolver()
+        c0 = decisions.counts()
+        s.solve([p.clone() for p in _pods(2)], [], {})
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["solver.route"] == {"host": 1}
+        assert decisions.counts()[
+            ("solver.route", "host", "no-templates")] >= 1
+
+    def test_decode_recheck_records_per_compat_entry(self, rec):
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.inflight import ClaimTemplate
+
+        pool = _nodepool()
+        its = {pool.name: benchmark_catalog(8)}
+        s = TPUSolver()
+        c0 = decisions.counts()
+        res = s.solve([p.clone() for p in _pods(6)], [ClaimTemplate(pool)],
+                      its)
+        assert res.all_pods_scheduled()
+        delta = rung_delta(c0, decisions.counts())
+        # one verdict per computed (template, group-set) entry; the plain
+        # burst shape hits the exact-skip rung
+        assert set(delta.get("decode.recheck", {})) == {"skip"}
+
+    def test_retry_bearing_solve_records_no_quality(self, rec):
+        """A solve whose kernel left pods for the host retry covers only
+        part of the floor's demand: recording it would ratchet the family
+        baseline below any complete solve's reach (false drift later)."""
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.models.inflight import ClaimTemplate
+
+        pool = _nodepool()
+        its = {pool.name: benchmark_catalog(8)}
+        s = TPUSolver()
+        workload = _pods(4) + [Pod(
+            metadata=ObjectMeta(name="whale"),
+            requests={"cpu": 100000.0, "memory": GIB})]
+        series0 = len(decisions.DECISIONS.quality_summary()["series"])
+        res = s.solve([p.clone() for p in workload], [ClaimTemplate(pool)],
+                      its)
+        assert s.last_device_stats["retry_pods"] >= 1 or res.pod_errors
+        assert len(decisions.DECISIONS.quality_summary()["series"]) \
+            == series0
+
+    def test_quality_recorded_per_sized_solve(self, rec):
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import TPUSolver
+        from karpenter_tpu.models.inflight import ClaimTemplate
+
+        pool = _nodepool()
+        its = {pool.name: benchmark_catalog(8)}
+        s = TPUSolver()
+        series0 = len(decisions.DECISIONS.quality_summary()["series"])
+        s.solve([p.clone() for p in _pods(6)], [ClaimTemplate(pool)], its)
+        series = decisions.DECISIONS.quality_summary()["series"]
+        assert len(series) == series0 + 1
+        assert series[-1]["ratio"] >= 1.0 or series[-1]["nodes"] <= \
+            series[-1]["floor"]
+
+
+@pytest.mark.skipif(
+    __import__("jax").devices().__len__() < 2,
+    reason="needs the virtual multi-device mesh")
+class TestMeshPartitionInvocations:
+    def _args(self, n_groups=16, n_types=8):
+        import __graft_entry__ as graft
+
+        snap = graft._wide_snapshot(n_groups=n_groups, n_types=n_types)
+        return graft._snapshot_args(snap)
+
+    def test_partitioned_solve_records_one_verdict(self, rec):
+        from karpenter_tpu.parallel import make_mesh
+        from karpenter_tpu.parallel.mesh import sharded_solve
+
+        args = self._args()
+        c0 = decisions.counts()
+        sharded_solve(make_mesh(), args, 64)
+        delta = rung_delta(c0, decisions.counts())
+        assert sum(delta["mesh.partition"].values()) == 1
+        assert set(delta["mesh.partition"]) == {"partitioned"}
+        # the shard-balance satellite rode along
+        from karpenter_tpu.obs import devplane
+        from karpenter_tpu.parallel.mesh import LAST_RUN
+
+        assert LAST_RUN.get("balance_ratio", 0) >= 1.0
+        assert devplane.STATS["shard_balance_ratio"] >= 1.0
+
+    def test_blocked_solve_records_replicated_with_cause(self, rec,
+                                                         monkeypatch):
+        from karpenter_tpu.parallel import make_mesh
+        from karpenter_tpu.parallel.mesh import sharded_solve
+
+        monkeypatch.setenv("KARPENTER_SHARD_PARTITION", "0")
+        args = self._args()
+        c0 = decisions.counts()
+        sharded_solve(make_mesh(), args, 64)
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["mesh.partition"] == {"replicated": 1}
+        assert decisions.counts()[
+            ("mesh.partition", "replicated", "partition-disabled")] == 1
+
+    def test_shard_balance_gauge_exported(self, rec):
+        from karpenter_tpu.parallel.mesh import plan_shards
+
+        reg = Registry()
+        with obs.round_trace("plan", registry=reg):
+            plan = plan_shards(self._args(), 8, 64)
+        assert plan is not None
+        assert reg.gauge(m.SHARD_BALANCE_RATIO).value() >= 1.0
+
+
+class _FakeBundle:
+    def __init__(self, generation, build_key, ok=True, refusal=None):
+        self.generation = generation
+        self.build_key = set(build_key)
+        self._ok = ok
+        self.advance_refusal = None
+        self._refusal = refusal
+
+    def advance(self, cluster, store, deltas, generation, registry=None):
+        if self._ok:
+            self.generation = generation
+            return True
+        self.advance_refusal = self._refusal
+        return False
+
+
+class _FakeCluster:
+    def __init__(self, generation, deltas=()):
+        self._generation = generation
+        self._deltas = deltas
+
+    def consolidation_state(self):
+        return self._generation
+
+    def deltas_since(self, g):
+        return self._deltas
+
+
+def _cand(pid):
+    return SimpleNamespace(provider_id=pid)
+
+
+class TestSnapshotAdvanceInvocations:
+    def test_delta_advance_records_delta(self, rec):
+        from karpenter_tpu.ops.consolidate import SnapshotCache
+
+        cache = SnapshotCache()
+        cache._bundle = _FakeBundle(1, {"a"}, ok=True)
+        c0 = decisions.counts()
+        got = cache.get(None, _FakeCluster(2), None, [_cand("a")])
+        assert got is cache._bundle
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["snapshot.advance"] == {"delta": 1}
+
+    def test_declined_advance_records_rebuild_with_cause(self, rec,
+                                                         monkeypatch):
+        from karpenter_tpu.ops import consolidate as cz
+
+        cache = cz.SnapshotCache()
+        old = cache._bundle = _FakeBundle(1, {"a"}, ok=False,
+                                          refusal="churn")
+        rebuilt = _FakeBundle(2, {"a"})
+        monkeypatch.setattr(cz, "build_disruption_snapshot",
+                            lambda *a, **k: rebuilt)
+        c0 = decisions.counts()
+        got = cache.get(None, _FakeCluster(2), None, [_cand("a")])
+        assert got is rebuilt and got is not old
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["snapshot.advance"] == {"rebuild": 1}
+        assert decisions.counts()[
+            ("snapshot.advance", "rebuild", "churn")] == 1
+
+    def test_journal_gap_records_rebuild_journal_gap(self, rec,
+                                                     monkeypatch):
+        from karpenter_tpu.ops import consolidate as cz
+
+        cache = cz.SnapshotCache()
+        cache._bundle = _FakeBundle(1, {"a"})
+        monkeypatch.setattr(cz, "build_disruption_snapshot",
+                            lambda *a, **k: _FakeBundle(2, {"a"}))
+        c0 = decisions.counts()
+        cache.get(None, _FakeCluster(2, deltas=None), None, [_cand("a")])
+        assert decisions.counts()[
+            ("snapshot.advance", "rebuild", "journal-gap")] \
+            == c0.get(("snapshot.advance", "rebuild", "journal-gap"), 0) + 1
+
+    def test_candidate_widening_records_rebuild(self, rec, monkeypatch):
+        from karpenter_tpu.ops import consolidate as cz
+
+        cache = cz.SnapshotCache()
+        cache._bundle = _FakeBundle(2, {"a"})
+        monkeypatch.setattr(cz, "build_disruption_snapshot",
+                            lambda *a, **k: _FakeBundle(2, {"a", "b"}))
+        c0 = decisions.counts()
+        cache.get(None, _FakeCluster(2), None, [_cand("a"), _cand("b")])
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["snapshot.advance"] == {"rebuild": 1}
+        assert decisions.counts()[
+            ("snapshot.advance", "rebuild", "candidate-widened")] >= 1
+
+    def test_first_build_records_nothing(self, rec, monkeypatch):
+        from karpenter_tpu.ops import consolidate as cz
+
+        cache = cz.SnapshotCache()
+        monkeypatch.setattr(cz, "build_disruption_snapshot",
+                            lambda *a, **k: _FakeBundle(2, {"a"}))
+        c0 = decisions.counts()
+        cache.get(None, _FakeCluster(2), None, [_cand("a")])
+        assert rung_delta(c0, decisions.counts()) == {}
+
+    def test_cache_hit_records_nothing(self, rec):
+        from karpenter_tpu.ops.consolidate import SnapshotCache
+
+        cache = SnapshotCache()
+        cache._bundle = _FakeBundle(2, {"a"})
+        c0 = decisions.counts()
+        cache.get(None, _FakeCluster(2), None, [_cand("a")])
+        assert rung_delta(c0, decisions.counts()) == {}
+
+
+class TestProbeConfirmInvocations:
+    def _ctx(self):
+        from karpenter_tpu.models import TPUSolver
+
+        return SimpleNamespace(
+            clock=SimpleNamespace(now=lambda: 0.0),
+            registry=Registry(),
+            provisioner=SimpleNamespace(solver=TPUSolver()),
+            cluster=None, store=None,
+            snapshot_cache=None,
+        )
+
+    def test_host_solver_records_sequential_no_device(self, rec):
+        from karpenter_tpu.controllers.disruption.methods import (
+            _device_probe,
+        )
+
+        ctx = self._ctx()
+        ctx.provisioner = SimpleNamespace(solver=object())
+        c0 = decisions.counts()
+        assert _device_probe(ctx, lambda *a, **k: None, "multi", [], []) \
+            is None
+        assert decisions.counts()[
+            ("probe.confirm", "sequential", "no-device")] \
+            == c0.get(("probe.confirm", "sequential", "no-device"), 0) + 1
+
+    def test_inexpressible_records_sequential(self, rec):
+        from karpenter_tpu.controllers.disruption.methods import (
+            _device_probe,
+        )
+
+        ctx = self._ctx()
+        c0 = decisions.counts()
+        assert _device_probe(
+            ctx, lambda *a, **k: None, "multi", [], []) is None
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["probe.confirm"] == {"sequential": 1}
+        assert decisions.counts()[
+            ("probe.confirm", "sequential", "inexpressible")] >= 1
+
+    def test_probe_error_records_sequential(self, rec):
+        from karpenter_tpu.controllers.disruption.methods import (
+            _device_probe,
+        )
+
+        def boom(*a, **k):
+            raise RuntimeError("probe died")
+
+        ctx = self._ctx()
+        c0 = decisions.counts()
+        assert _device_probe(ctx, boom, "multi", [], []) is None
+        assert decisions.counts()[
+            ("probe.confirm", "sequential", "probe-error")] \
+            == c0.get(("probe.confirm", "sequential", "probe-error"), 0) + 1
+
+    def _method(self, probed):
+        from karpenter_tpu.controllers.disruption.methods import (
+            MultiNodeConsolidation,
+        )
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        meth = MultiNodeConsolidation(self._ctx())
+        meth._probe = lambda cands, pool=None: probed
+        meth._confirm = lambda prefix: (
+            Command(list(prefix), reason="Underutilized")
+            if len(prefix) >= 2 else None)
+        return meth
+
+    def _cands(self, n=4):
+        from karpenter_tpu.api.nodepool import (
+            CONSOLIDATION_WHEN_UNDERUTILIZED,
+        )
+
+        pool = SimpleNamespace(
+            name="default",
+            spec=SimpleNamespace(disruption=SimpleNamespace(
+                consolidation_policy=CONSOLIDATION_WHEN_UNDERUTILIZED)),
+        )
+        from karpenter_tpu.api.nodepool import REASON_UNDERUTILIZED
+
+        return [
+            SimpleNamespace(node_pool=pool, disruption_cost=float(i),
+                            provider_id=f"n{i}")
+            for i in range(n)
+        ], {"default": {REASON_UNDERUTILIZED: n}}
+
+    def test_definitive_ladder_records_definitive(self, rec):
+        cands, budgets = self._cands(4)
+        meth = self._method((4, True))
+        c0 = decisions.counts()
+        cmd = meth.compute_command(cands, budgets)
+        assert cmd is not None
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["probe.confirm"] == {"definitive": 1}
+
+    def test_non_definitive_ladder_records_gallop(self, rec):
+        cands, budgets = self._cands(4)
+        meth = self._method((2, False))
+        c0 = decisions.counts()
+        meth.compute_command(cands, budgets)
+        delta = rung_delta(c0, decisions.counts())
+        assert delta["probe.confirm"] == {"gallop": 1}
+        assert decisions.counts()[
+            ("probe.confirm", "gallop", "non-definitive")] >= 1
+
+
+class TestSessionSyncInvocations:
+    @pytest.fixture
+    def server(self):
+        grpc = pytest.importorskip("grpc")  # noqa: F841
+        from karpenter_tpu.service.solver_service import serve
+
+        srv, port = serve(port=0)
+        yield f"127.0.0.1:{port}"
+        srv.stop(grace=None)
+
+    def test_initial_then_delta_records_both_ends(self, rec, server):
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models.inflight import ClaimTemplate
+        from karpenter_tpu.service import RemoteSolver
+
+        pool = _nodepool()
+        its = {pool.name: benchmark_catalog(8)}
+        s = RemoteSolver(server, registry=Registry(), tenant="acme")
+        c0 = decisions.counts()
+        s.solve([p.clone() for p in _pods(6)], [ClaimTemplate(pool)], its)
+        delta = rung_delta(c0, decisions.counts())
+        # loopback: client AND server halves live in this process — the
+        # first round is the initial full upload on both ledger halves
+        assert delta["session.sync"].get("resync", 0) >= 2
+        assert decisions.counts()[
+            ("session.sync", "resync", "initial")] >= 2
+        # steady state rides the delta rung on both ends
+        c1 = decisions.counts()
+        s.solve([p.clone() for p in _pods(6)], [ClaimTemplate(pool)], its)
+        delta2 = rung_delta(c1, decisions.counts())
+        assert set(delta2["session.sync"]) == {"delta"}
+        assert delta2["session.sync"]["delta"] >= 2
+        # per-tenant rung mix reached the introspection surface
+        mix = decisions.DECISIONS.tenant_mix()
+        assert "acme" in mix and "session.sync" in mix["acme"]
+
+
+# ---------------------------------------------------------------------------
+# round summaries, /introspect, and the CLI report
+# ---------------------------------------------------------------------------
+
+class TestIntrospection:
+    def _populate(self, reg):
+        with obs.round_trace("provision", registry=reg):
+            decisions.record_decision("solver.route", "xla", registry=reg)
+            decisions.record_decision("decode.recheck", "skip", registry=reg)
+        decisions.record_quality(12, 10, family="64x64", registry=reg)
+
+    def test_round_ring_holds_rung_summaries(self, rec):
+        reg = Registry()
+        self._populate(reg)
+        rounds = decisions.DECISIONS.rounds()
+        assert rounds and rounds[-1]["round"] == "provision"
+        assert rounds[-1]["decisions"]["solver.route"]["xla"]["ok"] == 1
+
+    def test_introspect_snapshot_shape(self, rec):
+        reg = Registry()
+        self._populate(reg)
+        snap = decisions.introspect_snapshot()
+        assert set(snap) == {"sites", "rounds", "quality", "tenants",
+                             "anomalies"}
+        assert snap["sites"]["solver.route"]["last"]["rung"] == "xla"
+        assert snap["quality"]["series"]
+        json.dumps(snap)  # endpoint-serializable
+
+    def test_introspect_endpoint(self, rec):
+        from karpenter_tpu.__main__ import serve_metrics
+
+        reg = Registry()
+        self._populate(reg)
+        server = serve_metrics(reg, 0, host="127.0.0.1")
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/introspect", timeout=10
+            ) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["sites"]["solver.route"]["rungs"]["xla"]["ok"] == 1
+            assert doc["rounds"][-1]["round"] == "provision"
+        finally:
+            server.shutdown()
+
+    def test_report_cli_smoke(self, rec, tmp_path, capsys):
+        from karpenter_tpu.obs.__main__ import main, render_report
+
+        reg = Registry()
+        self._populate(reg)
+        decisions.record_decision("mesh.partition", "replicated",
+                                  "existing-nodes", registry=reg,
+                                  tenant="acme")
+        snap = decisions.introspect_snapshot()
+        text = render_report(snap)
+        assert "solver.route" in text and "mesh.partition" in text
+        assert "existing-nodes" in text
+        assert "acme" in text
+        # the file-fed CLI renders the same snapshot
+        path = tmp_path / "introspect.json"
+        path.write_text(json.dumps(snap))
+        assert main(["report", "--file", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "decision plane" in out and "solver.route" in out
+
+    def test_report_cli_in_process_source(self, rec, capsys):
+        from karpenter_tpu.obs.__main__ import main
+
+        decisions.record_decision("solver.route", "native", "small-batch")
+        assert main(["report"]) == 0
+        assert "solver.route" in capsys.readouterr().out

@@ -663,6 +663,7 @@ class TestObsRules:
 
     def test_rules_registered(self):
         assert "GL401" in RULES and "GL402" in RULES and "GL403" in RULES
+        assert "GL404" in RULES
 
 
 class TestDevplaneRules:
@@ -760,6 +761,104 @@ class TestDevplaneRules:
             "    return x\n"
             "\n"
             "fn = jax.jit(kernel, static_argnames=('hist',))\n"
+        )})
+        assert findings == []
+
+
+class TestDecisionLedgerRules:
+    """GL404: the decision-ledger hooks (obs/decisions.py) must stay
+    jit-unreachable — `record_decision`/`record_quality` take a process
+    lock, mutate streak state, and can mark anomalies on the open trace,
+    all host-side machinery exactly like the GL403 devplane hooks."""
+
+    def test_positive_record_decision_and_quality_in_jitted_function(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import decisions\n"
+            "\n"
+            "def kernel(x):\n"
+            "    decisions.record_decision('solver.route', 'xla')\n"
+            "    decisions.record_quality(10, 8)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL404", "GL404"]
+        assert "record_decision" in findings[0].message
+
+    def test_positive_bare_import_and_receiver_verb_spellings(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs.decisions import DECISIONS, "
+            "record_decision\n"
+            "\n"
+            "def kernel(x):\n"
+            "    record_decision('decode.recheck', 'skip')\n"
+            "    DECISIONS.record('decode.recheck', 'skip')\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL404", "GL404"]
+
+    def test_positive_hook_reached_through_call_edge(self):
+        """Reachability carries GL404 across modules like GL401/403: the
+        verdict hides in a helper the jitted entry calls."""
+        findings, _ = analyze_sources({
+            "pkg.a": (
+                "import jax\n"
+                "from pkg.b import helper\n"
+                "\n"
+                "def entry(x):\n"
+                "    return helper(x)\n"
+                "\n"
+                "fn = jax.jit(entry)\n"
+            ),
+            "pkg.b": (
+                "from karpenter_tpu.obs import decisions\n"
+                "\n"
+                "def helper(t):\n"
+                "    decisions.record_decision('mesh.partition', "
+                "'partitioned')\n"
+                "    return t * 2\n"
+            ),
+        })
+        assert rules_of(findings) == ["GL404"]
+        assert findings[0].path.endswith("pkg/b.py")
+
+    def test_negative_host_side_ladder_site_not_flagged(self):
+        """The production pattern — decide the rung host-side, dispatch
+        the kernel, record the verdict — never flags (parallel/mesh.py,
+        models/solver.py, ops/consolidate.py all hook exactly this
+        way)."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import decisions\n"
+            "\n"
+            "def kernel(x):\n"
+            "    return x * 2\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+            "\n"
+            "def dispatch(args):\n"
+            "    out = fn(args)\n"
+            "    decisions.record_decision('solver.route', 'xla')\n"
+            "    return out\n"
+        )})
+        assert findings == []
+
+    def test_negative_generic_record_verb_not_flagged(self):
+        """`record` on non-decisions receivers (a topology engine) stays
+        quiet inside jitted code — only the decisions receivers make the
+        verb GL404 (GL402 owns the obs-plane receivers)."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "\n"
+            "def kernel(x, topo):\n"
+            "    topo.record(x.shape[0])\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel, static_argnames=('topo',))\n"
         )})
         assert findings == []
 
@@ -872,11 +971,11 @@ class TestPackageGate:
         for rule in ("GL101", "GL102", "GL103", "GL104",
                      "GL201", "GL202", "GL203",
                      "GL301", "GL302", "GL303",
-                     "GL401", "GL402", "GL403"):
+                     "GL401", "GL402", "GL403", "GL404"):
             assert rule in out
         assert set(RULES) == {
             "GL101", "GL102", "GL103", "GL104",
             "GL201", "GL202", "GL203",
             "GL301", "GL302", "GL303",
-            "GL401", "GL402", "GL403",
+            "GL401", "GL402", "GL403", "GL404",
         }
