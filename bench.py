@@ -42,6 +42,14 @@ table on stderr and
 exits 3 — the record is still on stdout, so drivers always get their
 line. KARPENTER_BENCH_SENTINEL=0 disables the gate (noisy shared boxes).
 
+`--replay-verify` adds the replay-capsule leg (obs/capsule.py): one fresh
+interpreter re-solves the headline row inside a round trace and writes
+its capsule (`--child-capture`), a second fresh interpreter replays it
+(`python -m karpenter_tpu.obs replay --json`), and the run exits 3 when
+the replay is not bit-identical to the captured outputs or the capture
+child solved on a different solver.route rung than the benched record —
+the "capture here, reproduce anywhere" contract, machine-checked.
+
 The sentinel also gates on the DECISION PLANE (obs/decisions.py): the
 fresh record carries the timed solves' rung summary (detail.rungs), and a
 site that ran a rung strictly below the committed baseline's — the
@@ -215,6 +223,155 @@ def run_bench(engine: str, n_pods: int, n_types: int) -> dict:
             **({"pallas": pallas} if pallas is not None else {}),
         },
     }
+
+
+def run_capture(engine: str, n_pods: int, n_types: int, path: str) -> dict:
+    """--child-capture body: solve the headline workload once inside a
+    round trace and serialize the solve's replay capsule (obs/capsule.py)
+    to ``path`` — the capture half of the --replay-verify leg."""
+    if engine == "cpu":
+        _force_cpu_jax()
+    if engine == "native":
+        from karpenter_tpu.models import NativeSolver as Solver
+    else:
+        from karpenter_tpu.models import TPUSolver as Solver
+
+    from karpenter_tpu import obs
+    from karpenter_tpu.obs import capsule, decisions
+
+    pods, templates, its = build_workload(n_pods, n_types)
+    solver = Solver()
+    solver.solve(pods, templates, its)  # warm the compile families
+    dec0 = decisions.counts()
+    with obs.round_trace("bench-headline") as tr:
+        solver.solve(pods, templates, its)
+    rungs = decisions.rung_delta(dec0, decisions.counts())
+    # the thread's last-capture slot outlives the round (a clean round
+    # releases its own pending reference at close — obs/capsule.py)
+    rec = capsule.last_capture()
+    written = None
+    if rec is not None:
+        written = capsule.write_capsule(rec, trace=tr, path=path,
+                                        why="forced")
+    return {
+        "capsule": written,
+        "engine": (rec or {}).get("meta", {}).get("engine"),
+        "rungs": rungs,
+    }
+
+
+def replay_verify_problems(record: dict, capture: dict,
+                           reply: dict) -> list:
+    """Pure evaluation of the --replay-verify leg: the capture child must
+    have solved on the same solver.route rung the benched record did (a
+    fresh interpreter routing differently is a decision-rung mismatch, not
+    a replay bug), and the fresh-interpreter replay must reproduce the
+    captured outputs bit-identically."""
+    problems = []
+    if not capture.get("capsule"):
+        problems.append("replay-verify: the capture child produced no "
+                        "capsule (its output tail follows)")
+        return problems
+    rec_rungs = _record_rungs(record).get("solver.route")
+    cap_rungs = (capture.get("rungs") or {}).get("solver.route")
+    rec_worst = _worst_rung("solver.route", rec_rungs)
+    cap_worst = _worst_rung("solver.route", cap_rungs)
+    if rec_worst is not None and cap_worst is not None and (
+            rec_worst != cap_worst):
+        problems.append(
+            f"replay-verify: the capture child solved on the {cap_worst} "
+            f"rung but the benched record ran {rec_worst} — decision-rung "
+            "mismatch")
+    r = (reply or {}).get("replay") or {}
+    if r.get("error"):
+        problems.append(f"replay-verify: replay failed: {r['error']}")
+    elif r.get("parity") != "exact":
+        problems.append(
+            f"replay-verify: parity={r.get('parity')!r} (nodes "
+            f"{r.get('nodes')} vs captured {r.get('captured_nodes')}) — "
+            "the captured solve did not reproduce bit-identically")
+    elif not r.get("rung_match", True):
+        problems.append(
+            f"replay-verify: replay executed the {r.get('rung')} rung but "
+            f"the capture ran {r.get('captured_rung')}")
+    return problems
+
+
+def replay_verify(record: dict, n_pods: int, n_types: int) -> int:
+    """The --replay-verify leg: capture the headline row's solve in one
+    fresh interpreter, replay the capsule in ANOTHER fresh interpreter
+    (`python -m karpenter_tpu.obs replay --json`), and exit 3 on any
+    parity or decision-rung mismatch. Engine-gated like the sentinel: a
+    run that never produced an engine record has nothing to verify."""
+    import tempfile
+
+    engine = (record.get("detail") or {}).get("engine")
+    if engine in (None, "none", "probe"):
+        print("bench: replay-verify skipped (no engine record)",
+              file=sys.stderr)
+        return 0
+    path = os.path.join(tempfile.mkdtemp(prefix="bench-capsule-"),
+                        "headline.capsule.npz")
+    env = dict(os.environ)
+    if engine != "axon":
+        env["JAX_PLATFORMS"] = "cpu"
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        cap_proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child-capture",
+             engine, str(n_pods), str(n_types), path],
+            capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    except subprocess.TimeoutExpired:
+        print("bench: replay-verify: capture child timed out",
+              file=sys.stderr)
+        return 3
+    def _tail(proc, label):
+        # the children run with captured output: on failure their stderr
+        # must reach the operator or the exit-3 is undiagnosable
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        for line in tail:
+            print(f"bench:   {label}: {line}", file=sys.stderr)
+
+    capture: dict = {}
+    for line in reversed(cap_proc.stdout.strip().splitlines()):
+        try:
+            capture = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    reply: dict = {}
+    rep_proc = None
+    if capture.get("capsule"):
+        try:
+            rep_proc = subprocess.run(
+                [sys.executable, "-m", "karpenter_tpu.obs", "replay",
+                 path, "--json"],
+                capture_output=True, text=True, timeout=900, env=env,
+                cwd=here)
+        except subprocess.TimeoutExpired:
+            print("bench: replay-verify: replay child timed out",
+                  file=sys.stderr)
+            return 3
+        for line in reversed(rep_proc.stdout.strip().splitlines()):
+            try:
+                reply = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    problems = replay_verify_problems(record, capture, reply)
+    if problems:
+        print("bench: replay-verify gate failed:", file=sys.stderr)
+        for p in problems:
+            print(f"bench:   {p}", file=sys.stderr)
+        if not capture.get("capsule"):
+            _tail(cap_proc, "capture child")
+        elif rep_proc is not None and not reply:
+            _tail(rep_proc, "replay child")
+        return 3
+    r = (reply.get("replay") or {})
+    print(f"bench: replay-verify ok (rung={r.get('rung')} parity=exact, "
+          f"capsule {path})", file=sys.stderr)
+    return 0
 
 
 # --------------------------------------------------------------------------
@@ -708,6 +865,12 @@ def _attempt(engine: str, n_pods: int, n_types: int, timeout: float):
 
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if "--child-capture" in sys.argv:
+        # bench.py --child-capture <engine> <n_pods> <n_types> <path>
+        engine, n_pods, n_types, path = (
+            args[0], int(args[1]), int(args[2]), args[3])
+        print(json.dumps(run_capture(engine, n_pods, n_types, path)))
+        return
     if "--child" in sys.argv:
         engine = sys.argv[sys.argv.index("--child") + 1]
         n_pods = int(args[1]) if len(args) > 1 else 50_000
@@ -757,10 +920,15 @@ def main():
                 rec.setdefault("detail", {})["attempts"] = attempts
                 print(json.dumps(rec))
                 # the record is out; now gate on the committed baselines
-                sys.exit(sentinel(
+                rc = sentinel(
                     rec, consolidation="--consolidation" in sys.argv,
                     multitenant="--multitenant" in sys.argv,
-                    multichip="--multichip" in sys.argv))
+                    multichip="--multichip" in sys.argv)
+                if rc == 0 and "--replay-verify" in sys.argv:
+                    # capture the headline solve, replay it in a fresh
+                    # interpreter, exit 3 on parity/rung mismatch
+                    rc = replay_verify(rec, n_pods, n_types)
+                sys.exit(rc)
     # every engine failed: still emit a parseable record (value null) with
     # the full diagnostic trail — never exit silent/nonzero without one
     print(

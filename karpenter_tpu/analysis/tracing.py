@@ -48,6 +48,16 @@ runtime:
   execution would freeze ONE batch's verdict into the compiled program
   (every later solve would re-record it) and race the ledger from XLA's
   runtime (the same failure mode as GL403, one plane over).
+- GL405 capsule-in-trace: a replay-capsule hook
+  (``record_capture``/``write_capsule``/``maybe_write_round``, or
+  ``capture`` on a capsule receiver —
+  ``capsule.*``) inside jit-reachable code. The capture hook takes the
+  module lock, mutates thread-local/trace state, and the serializers do
+  disk I/O — executed at trace time they would freeze ONE batch's
+  capture into the compiled program (every later solve would re-record
+  stale tensors as "its" capsule — corrupting the exact bit-parity
+  replay exists to guarantee) and race the capsule index from XLA's
+  runtime (the same failure mode as GL401-404, one plane over).
 
 Reachability is an inter-procedural taint pass: entry functions are those
 handed to jit/pallas_call (as decorator, call argument, or via
@@ -74,6 +84,7 @@ RULES = {
     "GL402": "obs flight-recorder mutation (anomaly/record/dump) in jit-reachable code executes at trace time",
     "GL403": "devplane telemetry hook (compile ledger / pad-waste / SLO observe) in jit-reachable code executes at trace time",
     "GL404": "decision-ledger hook (record_decision / record_quality / decisions receiver) in jit-reachable code executes at trace time",
+    "GL405": "replay-capsule hook (record_capture / write_capsule / capsule receiver) in jit-reachable code executes at trace time",
 }
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
@@ -106,6 +117,13 @@ _DEVPLANE_BASES = {"devplane", "LEDGER", "ledger"}
 _DECISION_FUNCS = {"record_decision", "record_quality", "note_round"}
 _DECISION_VERBS = {"record", "observe_quality"}
 _DECISION_BASES = {"decisions", "DECISIONS"}
+# GL405 — the replay-capsule surface (karpenter_tpu/obs/capsule): the
+# capture/serialize hooks match by final attribute (capsule.record_capture,
+# a bare import); the generic `capture` verb only counts on an
+# unmistakably capsule receiver.
+_CAPSULE_FUNCS = {"record_capture", "write_capsule", "maybe_write_round"}
+_CAPSULE_VERBS = {"capture"}
+_CAPSULE_BASES = {"capsule", "CAPSULES"}
 
 
 def _const_names(node) -> set:
@@ -569,6 +587,16 @@ class _TaintVisitor:
                 f"decision-ledger hook `{fname}(...)` inside "
                 f"jit-reachable `{self.fn.name}` executes at trace time "
                 "(record the verdict from the host-side ladder site)",
+            )
+        elif last in _CAPSULE_FUNCS or (
+            last in _CAPSULE_VERBS and base in _CAPSULE_BASES
+        ):
+            self._flag(
+                "GL405",
+                node.lineno,
+                f"replay-capsule hook `{fname}(...)` inside "
+                f"jit-reachable `{self.fn.name}` executes at trace time "
+                "(capture from the host-side dispatch site)",
             )
 
         # GL103 side effects

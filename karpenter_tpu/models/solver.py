@@ -9,10 +9,16 @@ path can't express (pod affinity, topology waves before M2, validation
 failures, leftovers) flows through `HostSolver` — the faithful FFD loop —
 seeded with the device-produced claims. Shapes are bucketed so XLA compiles
 once per bucket.
+
+Every kernel dispatch also records a replay capture (exact tensor inputs +
+outputs, engine/rung, static params) onto the open round trace; anomalous
+rounds serialize it as a replay capsule replayable bit-identically offline
+— :mod:`karpenter_tpu.obs.capsule` and deploy/README.md "Replay capsules".
 """
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -258,11 +264,33 @@ class TPUSolver(Solver):
         self.last_device_stats: dict = {}
         self._mesh = None
         self._mesh_checked = False
-        self._last_engine = "device"
+        # engine/route of the most recent kernel dispatch are THREAD-LOCAL:
+        # the solver service drives one shared solver from concurrent gRPC
+        # worker threads (the same reason mesh.LAST_RUN is thread-local),
+        # and a tenant's replay capture stamped with another tenant's
+        # engine would replay on the wrong rung. Within one solve() every
+        # read follows its own thread's dispatch, so single-threaded
+        # callers see no change.
+        self._eng_tls = threading.local()
+
+    @property
+    def _last_engine(self) -> str:
+        return getattr(self._eng_tls, "engine", "device")
+
+    @_last_engine.setter
+    def _last_engine(self, value: str):
+        self._eng_tls.engine = value
+
+    @property
+    def _route(self):
         # (rung, reason) of the most recent kernel dispatch, recorded as
         # the solve's ONE "solver.route" decision-ledger verdict (rungs
         # mesh/native/xla/service/host — obs/decisions.py)
-        self._route: tuple | None = None
+        return getattr(self._eng_tls, "route", None)
+
+    @_route.setter
+    def _route(self, value):
+        self._eng_tls.route = value
 
     def _maybe_mesh(self):
         """The device mesh when >1 accelerator is attached (ICI on real
@@ -649,6 +677,24 @@ class TPUSolver(Solver):
                 stages["solve_ms"] = stages.get("solve_ms", 0.0) + (
                     time.perf_counter() - t0) * 1000.0
             pull = None
+            # replay capsule (obs/capsule.py): this dispatch's exact tensor
+            # inputs + outputs by REFERENCE onto the open round trace — an
+            # anomalous round serializes the last one next to its Chrome
+            # dump. The mesh rung skips here: sharded_solve_host captured
+            # the same dispatch at the mesh seam with the shard metadata
+            # replay needs (a doubled re-run overwrites — last wins).
+            if self._route is None or self._route[0] != "mesh":
+                from karpenter_tpu.obs import capsule as _capsule
+                from karpenter_tpu.ops.kernels import pallas_enabled
+
+                _capsule.record_capture(
+                    "solver.invoke", args, host,
+                    engine=self._last_engine,
+                    rung=self._route[0] if self._route else None,
+                    reason=self._route[1] if self._route else None,
+                    max_bins=Bp, level_bits=level_bits, max_minv=max_minv,
+                    family=f"{Gp}x{Tp}", pallas=pallas_enabled(),
+                )
             used = host["used"]
             exhausted = bool(used[:B].all())
             grow = max_bins is None and exhausted and B < bin_cap

@@ -22,9 +22,16 @@ Contract (cross-referenced from ops/consolidate.py and ops/tensorize.py):
   ``solve-overhead-drift`` anomalies, the ``/introspect`` surface and
   ``python -m karpenter_tpu.obs report``). Its hooks are host-only under
   GL404.
+- :mod:`karpenter_tpu.obs.capsule` is the replay plane: every hot-path
+  dispatch seam captures the solve's exact tensor inputs/outputs by
+  reference; anomalous rounds serialize ONE schema-versioned
+  ``.capsule.npz`` next to their Chrome dump, and
+  ``python -m karpenter_tpu.obs replay <capsule> [--ab]`` re-executes it
+  bit-identically offline (and A/Bs every eligible rung). Its hooks are
+  host-only under GL405.
 """
 
-from karpenter_tpu.obs import decisions, devplane
+from karpenter_tpu.obs import capsule, decisions, devplane
 from karpenter_tpu.obs.recorder import FlightRecorder, chrome_events
 from karpenter_tpu.obs.trace import (
     RECORDER,
@@ -45,6 +52,7 @@ from karpenter_tpu.obs.trace import (
 __all__ = [
     "FlightRecorder",
     "chrome_events",
+    "capsule",
     "decisions",
     "devplane",
     "RECORDER",

@@ -1,14 +1,22 @@
-"""``python -m karpenter_tpu.obs report`` — human rendering of the fleet
-introspection surface.
+"""``python -m karpenter_tpu.obs report|replay`` — the obs-plane CLI.
 
-Fetches the ``/introspect`` JSON (decision-ledger rung mixes, last-K round
-rung summaries, the solve-quality series, per-tenant rung mixes, retained
-anomalous rounds — obs/decisions.py) from a running metrics server
-(``--url http://host:port``) or reads a saved snapshot (``--file``), and
-with neither renders THIS process's ledger (useful from a REPL or a test).
+``report`` renders the ``/introspect`` JSON (decision-ledger rung mixes,
+last-K round rung summaries, the solve-quality series, per-tenant rung
+mixes, retained anomalous rounds, the replay-capsule index —
+obs/decisions.py) from a running metrics server (``--url``), a saved
+snapshot (``--file``), or THIS process's ledger.
+
+``replay`` re-executes a captured hot-path solve offline (obs/capsule.py)
+and asserts bit-parity against the capsule's recorded outputs; ``--ab``
+additionally races the same capsule across every eligible rung
+(partitioned / replicated / xla / native / host-FFD) and prints a
+parity + nodes + wall-clock + decision table. Exit codes: 0 parity exact,
+1 parity mismatch or replay failure — bench.py's ``--replay-verify`` leg
+drives this in a fresh interpreter.
 
     python -m karpenter_tpu.obs report --url http://127.0.0.1:8080
-    python -m karpenter_tpu.obs report --file introspect.json
+    python -m karpenter_tpu.obs replay /tmp/karpenter-traces/x.capsule.npz
+    python -m karpenter_tpu.obs replay x.capsule.npz --ab
 """
 
 from __future__ import annotations
@@ -17,7 +25,7 @@ import argparse
 import json
 import sys
 
-__all__ = ["render_report", "main"]
+__all__ = ["render_report", "render_ab", "run_replay", "main"]
 
 
 def _fmt_mix(rungs: dict) -> str:
@@ -95,8 +103,86 @@ def render_report(snapshot: dict) -> str:
             lines.append(
                 f"  {a.get('round')} [{a.get('trace_id')}]  "
                 f"{','.join(a.get('kinds') or [])}  "
-                f"dump={a.get('dump') or '-'}")
+                f"dump={a.get('dump') or '-'}"
+                + (f"  capsule={a['capsule']}" if a.get("capsule") else ""))
+    capsules = snapshot.get("capsules") or []
+    if capsules:
+        lines.append("")
+        lines.append("replay capsules (python -m karpenter_tpu.obs replay)")
+        for c in capsules:
+            tenant = f" tenant={c['tenant']}" if c.get("tenant") else ""
+            lines.append(
+                f"  {c.get('round') or '-'} [{c.get('trace_id') or '-'}]  "
+                f"seam={c.get('seam')} engine={c.get('engine')}{tenant}  "
+                f"{c.get('why')}  {c.get('path')}")
     return "\n".join(lines)
+
+
+def render_ab(rows: list) -> str:
+    """The ``replay --ab`` table: one line per rung — parity vs the
+    captured outputs, nodes, wall clock, and whether the rung matches the
+    one the capture actually ran (the decision diff)."""
+    lines = [f"{'rung':12s} {'parity':8s} {'nodes':>7s} {'ms':>10s}  decision"]
+    for r in rows:
+        if not r.get("eligible", True):
+            lines.append(f"{r['rung']:12s} {'-':8s} {'-':>7s} {'-':>10s}  "
+                         f"ineligible: {r.get('why')}")
+            continue
+        decision = ("= captured rung" if r.get("rung_match")
+                    else f"captured rung was {r.get('captured_rung')}")
+        nodes = r.get("nodes")
+        lines.append(
+            f"{r['rung']:12s} {r.get('parity', '?'):8s} "
+            f"{nodes if nodes is not None else '-':>7} "
+            f"{r.get('ms', 0.0):>10.2f}  {decision}")
+    return "\n".join(lines)
+
+
+def run_replay(path: str, ab: bool = False, rung: str | None = None,
+               as_json: bool = False) -> int:
+    """The ``replay`` subcommand body (pure-ish: prints + returns the
+    exit code, so tests drive it in-process)."""
+    from karpenter_tpu.obs import capsule as _capsule
+
+    try:
+        cap = _capsule.load(path)
+    except (OSError, ValueError) as e:
+        print(f"replay: {e}", file=sys.stderr)
+        return 1
+    out: dict = {
+        "capsule": path,
+        "seam": cap.seam,
+        "engine": cap.engine,
+        "round": cap.meta.get("round"),
+        "trace_id": cap.meta.get("trace_id"),
+        "anomalies": cap.meta.get("anomalies") or [],
+        "decisions": cap.meta.get("decisions") or [],
+    }
+    try:
+        out["replay"] = _capsule.replay(cap, rung=rung)
+    except Exception as e:
+        print(f"replay: {type(e).__name__}: {e}", file=sys.stderr)
+        out["replay"] = {"error": f"{type(e).__name__}: {e}"}
+        if as_json:
+            print(json.dumps(out))
+        return 1
+    if ab:
+        out["ab"] = _capsule.ab_compare(cap)
+    if as_json:
+        print(json.dumps(out))
+    else:
+        r = out["replay"]
+        print(f"capsule {path}")
+        print(f"  seam={cap.seam} engine={cap.engine} "
+              f"round={cap.meta.get('round')} "
+              f"anomalies={','.join(out['anomalies']) or '-'}")
+        print(f"  replay rung={r['rung']} parity={r['parity']} "
+              f"nodes={r['nodes']} (captured {r['captured_nodes']}) "
+              f"ms={r['ms']}")
+        if ab:
+            print()
+            print(render_ab(out["ab"]))
+    return 0 if out["replay"].get("parity") == "exact" else 1
 
 
 def main(argv=None) -> int:
@@ -112,7 +198,22 @@ def main(argv=None) -> int:
                      help="emit the raw JSON instead of the rendered report")
     rep.add_argument("-k", type=int, default=16,
                      help="rounds/anomalies to include (in-process source)")
+    rpl = sub.add_parser(
+        "replay", help="re-execute a replay capsule offline (bit-parity "
+                       "asserted against its captured outputs)")
+    rpl.add_argument("capsule", help="path to a .capsule.npz file")
+    rpl.add_argument("--ab", action="store_true",
+                     help="also run the capsule across every eligible rung "
+                          "and print the parity/nodes/wall-clock table")
+    rpl.add_argument("--rung", default=None,
+                     help="override the replay rung (partitioned/replicated/"
+                          "xla/native/host; probe capsules: device/native)")
+    rpl.add_argument("--json", action="store_true",
+                     help="emit the result as one JSON line")
     args = ap.parse_args(argv)
+    if args.cmd == "replay":
+        return run_replay(args.capsule, ab=args.ab, rung=args.rung,
+                          as_json=args.json)
     if args.cmd != "report":
         ap.print_help()
         return 2

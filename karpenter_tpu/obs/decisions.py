@@ -499,7 +499,9 @@ def rung_delta(before: dict, after: dict) -> dict:
 def introspect_snapshot(k: int = 16) -> dict:
     """The ``/introspect`` endpoint body: per-site rung mixes, the last-K
     rounds' rung summaries, the quality account, per-tenant rung mixes,
-    and the flight recorder's retained anomalous rounds."""
+    the flight recorder's retained anomalous rounds, and the replay
+    capsules written by this process (obs/capsule.py)."""
+    from karpenter_tpu.obs import capsule as _capsule
     from karpenter_tpu.obs import trace as _trace
 
     anomalies = []
@@ -511,6 +513,7 @@ def introspect_snapshot(k: int = 16) -> dict:
             "trace_id": tr.trace_id,
             "kinds": [kind for kind, _, _ in tr.anomalies],
             "dump": tr.dump_path,
+            "capsule": tr.capsule_path,
         })
     return {
         "sites": DECISIONS.site_summary(),
@@ -518,6 +521,7 @@ def introspect_snapshot(k: int = 16) -> dict:
         "quality": DECISIONS.quality_summary(),
         "tenants": DECISIONS.tenant_mix(),
         "anomalies": anomalies[-k:],
+        "capsules": _capsule.index(k),
     }
 
 

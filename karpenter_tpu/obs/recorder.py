@@ -20,6 +20,13 @@ Disk writes never take down a reconcile loop: a failed dump logs a
 WARNING (the stderr lastResort handler reaches it) and the round
 continues. Each recorded trace dumps at most once per round — re-dumping
 on demand (``dump(trace)``) reuses the path.
+
+An anomalous round also serializes its pending **replay capsule** — the
+round's most recent hot-path solve as a runnable artifact (exact tensor
+inputs, outputs, engine/rung, env knobs) — next to the Chrome dump; see
+:mod:`karpenter_tpu.obs.capsule` and deploy/README.md "Replay capsules"
+(``python -m karpenter_tpu.obs replay <capsule>`` re-executes it
+bit-identically offline, ``replay --ab`` races every eligible rung).
 """
 
 from __future__ import annotations
@@ -101,6 +108,13 @@ class FlightRecorder:
             self._ring.append(trace)
         if trace.anomalies or self.dump_all:
             self.dump(trace)
+        # replay capsule (obs/capsule.py): an anomalous round's pending
+        # solve capture serializes next to the Chrome dump written above
+        # (KARPENTER_CAPSULE=1 forces it for every recorded round); the
+        # writer never raises — a capsule failure must not fail the round
+        from karpenter_tpu.obs import capsule as _capsule
+
+        _capsule.maybe_write_round(trace, self.dump_dir)
 
     def traces(self) -> list:
         """Retained traces, oldest first."""

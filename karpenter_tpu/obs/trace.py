@@ -130,6 +130,13 @@ class Trace:
         self.decisions: dict = {}
         self.dropped = 0
         self.dump_path: str | None = None
+        # the round's pending replay capture (obs/capsule.py): the most
+        # recent hot-path solve's tensorized inputs+outputs, kept by
+        # REFERENCE (no copy, no serialization) so anomaly-free rounds pay
+        # ~nothing; serialized to a capsule file next to the Chrome dump
+        # only when the round closes anomalous (or KARPENTER_CAPSULE=1)
+        self.capsule_pending: dict | None = None
+        self.capsule_path: str | None = None
         # an idle round (the owner found nothing to do) opts out of the
         # ring and the histograms so it cannot churn real rounds out; an
         # anomaly overrides the discard — anomalous rounds always keep
@@ -155,6 +162,12 @@ class Trace:
         with self._lock:
             key = (site, rung, reason)
             self.decisions[key] = self.decisions.get(key, 0) + 1
+
+    def add_capture(self, record: dict):
+        """Attach a replay-capture record (last one wins — the round's
+        most recent solve is the one an anomaly usually indicts)."""
+        with self._lock:
+            self.capsule_pending = record
 
     # -- derived views (call after the round closed) ----------------------
     def spans(self):
@@ -513,9 +526,11 @@ def reset():
     RECORDER.configure(dump_dir=_env_dir(), capacity=_env_capacity(),
                        dump_all=_env_dump_all())
     RECORDER.clear()
+    from karpenter_tpu.obs import capsule as _capsule
     from karpenter_tpu.obs import decisions as _decisions
 
     _decisions.reset()
+    _capsule.reset()
     return TRACER, RECORDER
 
 

@@ -319,6 +319,16 @@ TRACE_SPAN_SECONDS = f"{NAMESPACE}_trace_span_self_seconds"
 TRACE_ROUND_SECONDS = f"{NAMESPACE}_trace_round_duration_seconds"
 TRACE_ANOMALIES = f"{NAMESPACE}_trace_anomalies_total"
 TRACE_DUMPS = f"{NAMESPACE}_trace_dumps_total"
+# replay capsules (karpenter_tpu/obs/capsule.py): capsule files written
+# next to the Chrome dumps (labels seam + why = anomaly|forced), and
+# captures skipped by the KARPENTER_CAPSULE_BYTES size budget
+CAPSULE_WRITES = f"{NAMESPACE}_capsule_writes_total"
+CAPSULE_SKIPPED = f"{NAMESPACE}_capsule_skipped_total"
+# session-GC sweeps on the solver fleet service (service/session.py
+# SessionRegistry.sweep): each sweep reaps expired sessions and releases
+# their bundle bytes from the LRU budget without waiting for a client
+# access to trip the reap-on-access path
+SOLVER_SESSION_SWEEPS = f"{NAMESPACE}_solver_session_sweeps_total"
 NODES_ALLOCATABLE = f"{NAMESPACE}_nodes_allocatable"
 NODES_TOTAL = f"{NAMESPACE}_nodes_count"
 NODEPOOL_USAGE = f"{NAMESPACE}_nodepool_usage"

@@ -115,6 +115,12 @@ unseen-pending / ineligible-pending / churn / candidate-widened, a closed
 enum) — so a delta path that quietly dies in steady state fires the
 ``rung-regression`` trace dump instead of only nudging a miss counter.
 See deploy/README.md "Decision plane".
+
+Probe dispatches also record a replay capture (the shared snapshot, the
+counterfactual rows, and their zeroed-column sets — everything
+``dispatch_counterfactual_rows`` needs to re-execute the exact chunked
+program offline): an anomalous disruption round yields a replay capsule
+(:mod:`karpenter_tpu.obs.capsule`, deploy/README.md "Replay capsules").
 """
 
 from __future__ import annotations
@@ -577,46 +583,43 @@ class DisruptionSnapshot:
                     "native probe dispatch failed; using the XLA kernel",
                     exc_info=True)
         shared, (Gp, Ep) = self._shared_args()
-        R = len(self.snap.resources)
         rows = g_count_k.shape[0]
-        placed_g = np.empty((rows, Gp), dtype=np.int64)
-        used = np.empty(rows, dtype=np.int64)
         with obs.span("probe.dispatch", rows=rows, engine="device"):
-            for lo in range(0, rows, PROBE_CHUNK_ROWS):
-                hi = min(lo + PROBE_CHUNK_ROWS, rows)
-                n = hi - lo
-                Np = _pow2(n, lo=4)
-                e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
-                for i in range(n):
-                    cols = e_zero_cols[lo + i]
-                    if cols is not None and len(cols):
-                        e_chunk[i, cols, :] = 0.0
-                varying = dict(
-                    g_count=pad(g_count_k[lo:hi], (Np, Gp)),
-                    e_avail=pad(e_chunk, (Np, Ep, R)),
-                )
-                # pow-2 row-ladder waste of this chunk (real counterfactual
-                # rows vs the padded batch axis the kernel vmapped over)
-                devplane.record_padding("probe.rows", n, Np)
-                # dispatch + host pull in one device-kind leaf: the probe
-                # kernel is synchronous-by-consumption (np.asarray blocks)
-                with obs.span("probe.kernel", kind="device", rows=n):
-                    kfn = _batched_kernel(1, self.max_minv)
-                    t0 = time.perf_counter()
-                    out_placed, out_used = kfn(varying, shared)
-                    # first sight of this (row axis, snapshot shapes)
-                    # family paid its XLA compile inside the call above;
-                    # the key mirrors the solver's base_key dims — R and
-                    # the mask widths change the compiled program even
-                    # when the padded axes do not
-                    devplane.record_dispatch(
-                        "probe.kernel",
-                        (Np, shared["g_mask"].shape, shared["t_mask"].shape,
-                         Ep, R, self.max_minv),
-                        time.perf_counter() - t0)
-                    placed_g[lo:hi] = np.asarray(out_placed)[:n]
-                    used[lo:hi] = np.asarray(out_used)[:n]
+            placed_g, used = dispatch_counterfactual_rows(
+                shared, Gp, Ep, self.esnap.e_avail, self.max_minv,
+                g_count_k, e_zero_cols)
+        self._capture(shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
+                      used, "device")
         return placed_g, used
+
+    def _capture(self, shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
+                 used, engine):
+        """Replay capture of this probe dispatch (obs/capsule.py): the
+        shared snapshot by reference plus the counterfactual rows and
+        their zeroed-column sets (flattened idx+len, None rows as -1) —
+        everything ``dispatch_counterfactual_rows`` needs to re-execute
+        the exact same chunked program offline."""
+        from karpenter_tpu.obs import capsule as _capsule
+
+        if not _capsule.capture_enabled():
+            return
+        lens = np.array(
+            [-1 if c is None else len(c) for c in e_zero_cols],
+            dtype=np.int64)
+        parts = [np.asarray(c, dtype=np.int64).ravel()
+                 for c in e_zero_cols if c is not None and len(c)]
+        idx = (np.concatenate(parts) if parts
+               else np.zeros(0, dtype=np.int64))
+        inputs = dict(shared)
+        inputs[_capsule.CF_PREFIX + "g_count_rows"] = np.asarray(g_count_k)
+        inputs[_capsule.CF_PREFIX + "e_avail"] = np.asarray(
+            self.esnap.e_avail)
+        inputs[_capsule.CF_PREFIX + "e_zero_idx"] = idx
+        inputs[_capsule.CF_PREFIX + "e_zero_len"] = lens
+        _capsule.record_capture(
+            "probe.dispatch", inputs,
+            {"placed_g": placed_g, "used": used},
+            engine=engine, max_minv=self.max_minv, Gp=Gp, Ep=Ep)
 
     def _native_routable(self) -> bool:
         """The solver's engine-routing gate applied to the probe: the same
@@ -647,34 +650,98 @@ class DisruptionSnapshot:
         counterfactual row in-process, returning only the per-row
         reductions — the old path re-entered the engine (and re-derived
         F/template overlap, and marshalled the full snapshot) once per row."""
-        from karpenter_tpu import native
-
         shared, (Gp, Ep) = self._shared_args()
-        R = len(self.snap.resources)
         rows = g_count_k.shape[0]
-        placed_g = np.empty((rows, Gp), dtype=np.int64)
-        used = np.empty(rows, dtype=np.int64)
         with obs.span("probe.dispatch", rows=rows, engine="native"):
-            for lo in range(0, rows, PROBE_CHUNK_ROWS):
-                hi = min(lo + PROBE_CHUNK_ROWS, rows)
-                n = hi - lo
-                e_chunk = np.repeat(self.esnap.e_avail[None, :, :], n, axis=0)
-                for i in range(n):
-                    cols = e_zero_cols[lo + i]
-                    if cols is not None and len(cols):
-                        e_chunk[i, cols, :] = 0.0
-                with obs.span("probe.native", kind="device", rows=n):
-                    pg, u = native.solve_probe_batch(
-                        shared,
-                        pad(np.asarray(g_count_k[lo:hi], dtype=np.int32),
-                            (n, Gp)),
-                        pad(e_chunk.astype(np.float32, copy=False),
-                            (n, Ep, R)),
-                        1,
-                    )
-                placed_g[lo:hi] = pg
-                used[lo:hi] = u
+            placed_g, used = dispatch_counterfactual_rows_native(
+                shared, Gp, Ep, self.esnap.e_avail, self.max_minv,
+                g_count_k, e_zero_cols)
+        self._capture(shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
+                      used, "native")
         return placed_g, used
+
+
+def dispatch_counterfactual_rows(shared, Gp, Ep, e_avail, max_minv,
+                                 g_count_k, e_zero_cols):
+    """The XLA probe dispatch over EXPLICIT tensors: chunked at
+    PROBE_CHUNK_ROWS, the chunk axis padded on the pow-2 ladder, each
+    chunk one vmapped device call. ONE body shared by
+    ``DisruptionSnapshot.dispatch`` and the replay capsule's offline probe
+    replay (obs/capsule.py) — sharing the code is what makes the replay
+    bit-exact by construction instead of by re-implementation."""
+    R = e_avail.shape[1]
+    rows = g_count_k.shape[0]
+    placed_g = np.empty((rows, Gp), dtype=np.int64)
+    used = np.empty(rows, dtype=np.int64)
+    for lo in range(0, rows, PROBE_CHUNK_ROWS):
+        hi = min(lo + PROBE_CHUNK_ROWS, rows)
+        n = hi - lo
+        Np = _pow2(n, lo=4)
+        e_chunk = np.repeat(e_avail[None, :, :], n, axis=0)
+        for i in range(n):
+            cols = e_zero_cols[lo + i]
+            if cols is not None and len(cols):
+                e_chunk[i, cols, :] = 0.0
+        varying = dict(
+            g_count=pad(g_count_k[lo:hi], (Np, Gp)),
+            e_avail=pad(e_chunk, (Np, Ep, R)),
+        )
+        # pow-2 row-ladder waste of this chunk (real counterfactual
+        # rows vs the padded batch axis the kernel vmapped over)
+        devplane.record_padding("probe.rows", n, Np)
+        # dispatch + host pull in one device-kind leaf: the probe
+        # kernel is synchronous-by-consumption (np.asarray blocks)
+        with obs.span("probe.kernel", kind="device", rows=n):
+            kfn = _batched_kernel(1, max_minv)
+            t0 = time.perf_counter()
+            out_placed, out_used = kfn(varying, shared)
+            # first sight of this (row axis, snapshot shapes)
+            # family paid its XLA compile inside the call above;
+            # the key mirrors the solver's base_key dims — R and
+            # the mask widths change the compiled program even
+            # when the padded axes do not
+            devplane.record_dispatch(
+                "probe.kernel",
+                (Np, shared["g_mask"].shape, shared["t_mask"].shape,
+                 Ep, R, max_minv),
+                time.perf_counter() - t0)
+            placed_g[lo:hi] = np.asarray(out_placed)[:n]
+            used[lo:hi] = np.asarray(out_used)[:n]
+    return placed_g, used
+
+
+def dispatch_counterfactual_rows_native(shared, Gp, Ep, e_avail, max_minv,
+                                        g_count_k, e_zero_cols):
+    """The native-engine half of :func:`dispatch_counterfactual_rows` —
+    same chunking, same counterfactual materialization, the C++ batched
+    probe entry per chunk. ``max_minv`` rides only for capture symmetry
+    (the native entry reads m_minv from the arg dict itself)."""
+    from karpenter_tpu import native
+
+    R = e_avail.shape[1]
+    rows = g_count_k.shape[0]
+    placed_g = np.empty((rows, Gp), dtype=np.int64)
+    used = np.empty(rows, dtype=np.int64)
+    for lo in range(0, rows, PROBE_CHUNK_ROWS):
+        hi = min(lo + PROBE_CHUNK_ROWS, rows)
+        n = hi - lo
+        e_chunk = np.repeat(e_avail[None, :, :], n, axis=0)
+        for i in range(n):
+            cols = e_zero_cols[lo + i]
+            if cols is not None and len(cols):
+                e_chunk[i, cols, :] = 0.0
+        with obs.span("probe.native", kind="device", rows=n):
+            pg, u = native.solve_probe_batch(
+                shared,
+                pad(np.asarray(g_count_k[lo:hi], dtype=np.int32),
+                    (n, Gp)),
+                pad(e_chunk.astype(np.float32, copy=False),
+                    (n, Ep, R)),
+                1,
+            )
+        placed_g[lo:hi] = pg
+        used[lo:hi] = u
+    return placed_g, used
 
 
 def build_disruption_snapshot(provisioner, cluster, store, candidates):

@@ -881,6 +881,26 @@ def sharded_solve_host(mesh: Mesh, args: dict, max_bins: int,
         except AttributeError:
             pass  # already host-side (partitioned rung or mocked path)
     with obs.span("shard.merge", kind="device", engine="mesh"):
-        return jax.device_get(
+        host = jax.device_get(
             {k: out[k] for k in ("assign", "assign_e", "used", "tmpl", "F")}
         )
+    # replay capture (obs/capsule.py, seam mesh.solve): the mesh solve's
+    # exact inputs/outputs + rung + shard count. The partitioned rung
+    # replays through partitioned_reference — bit-identical to this
+    # execution by the module's exactness contract — which is what makes
+    # "capture on the ICI mesh, replay on a one-chip dev box" work; the
+    # replicated/unsharded rungs replay through the plain kernel (same
+    # contract). models/solver.py skips its own solver.invoke capture on
+    # the mesh rung so one dispatch yields one capture.
+    from karpenter_tpu.obs import capsule as _capsule
+
+    _capsule.record_capture(
+        "mesh.solve", args, host,
+        engine=LAST_RUN.get("engine"),
+        reason=LAST_RUN.get("reason"),
+        max_bins=max_bins, level_bits=level_bits,
+        n_shards=int(mesh.devices.size),
+        balance_ratio=LAST_RUN.get("balance_ratio"),
+        repaired_pods=LAST_RUN.get("repaired_pods"),
+    )
+    return host

@@ -44,6 +44,13 @@ written) and is visible on
 ``karpenter_solver_session_cache_bytes`` gauge — a fleet whose tenants
 thrash each other's snapshots shows up on the scrape, not as mystery
 resyncs.
+
+Expiry is enforced twice: reap-on-access (``lookup``/``register``) and a
+periodic **sweep** (:meth:`SessionRegistry.sweep`, run by the service's
+sweeper thread every ``KARPENTER_SESSION_SWEEP_S``) that reaps idle
+expired sessions and releases their bundle bytes without any client
+touching the server — counted on
+``karpenter_solver_session_sweeps_total``.
 """
 
 from __future__ import annotations
@@ -234,6 +241,59 @@ class SessionRegistry:
                 if now - s.last_used > self.ttl_s]
         for s in dead:
             self._drop(s)
+
+    def sweep(self, registry=None) -> int:
+        """One GC sweep: reap every expired session and release its bundle
+        bytes from the LRU budget NOW, instead of waiting for some client
+        access to trip the reap-on-access path — an idle expired tenant's
+        multi-MB bundle must not squat the shared budget (evicting healthy
+        tenants) just because nobody happened to touch the server. Counts
+        ``karpenter_solver_session_sweeps_total`` and refreshes the
+        session/bytes gauges; returns the number of sessions reaped."""
+        with self._lock:
+            before = len(self._sessions)
+            self._reap(self._now())
+            count = len(self._sessions)
+            total = self._total_bytes
+        reaped = before - count
+        if registry is not None:
+            from karpenter_tpu.operator import metrics as m
+
+            registry.counter(
+                m.SOLVER_SESSION_SWEEPS,
+                "periodic session-GC sweeps (expired sessions reaped and "
+                "their bundle bytes released without a client access)",
+            ).inc()
+            registry.gauge(
+                m.SOLVER_SESSIONS,
+                "live tenant sessions on this solver service",
+            ).set(count)
+            registry.gauge(
+                m.SOLVER_SESSION_CACHE_BYTES,
+                "bytes of cached per-tenant solve bundles (LRU budget "
+                "KARPENTER_SESSION_CACHE_BYTES)",
+            ).set(total)
+        return reaped
+
+    def start_sweeper(self, interval_s: float | None = None, registry=None):
+        """Run :meth:`sweep` every ``interval_s`` seconds (default
+        ``KARPENTER_SESSION_SWEEP_S``, 60; <= 0 disables) on a daemon
+        thread. Returns a ``threading.Event`` — set it to stop the
+        sweeper — or None when disabled."""
+        if interval_s is None:
+            interval_s = env_float("KARPENTER_SESSION_SWEEP_S", 60.0)
+        if interval_s <= 0:
+            return None
+        stop = threading.Event()
+
+        def _loop():
+            while not stop.wait(interval_s):
+                self.sweep(registry=registry)
+
+        t = threading.Thread(target=_loop, name="session-sweeper",
+                             daemon=True)
+        t.start()
+        return stop
 
     def _drop(self, sess: TenantSession):
         # caller holds the lock

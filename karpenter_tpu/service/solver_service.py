@@ -184,6 +184,13 @@ class _SolverHandler:
             "solver_service", latency_slo=_env_latency_slo()
         )
         self.sessions = SessionRegistry()
+        # sweep-driven session GC: a periodic sweep releases expired
+        # sessions' bundle bytes from the LRU budget instead of waiting
+        # for a client access to trip the reap (an idle expired tenant
+        # would otherwise squat its multi-MB bundle for as long as nobody
+        # touched the server). KARPENTER_SESSION_SWEEP_S=0 disables.
+        self._sweeper_stop = self.sessions.start_sweeper(
+            registry=self._registry)
         window = coalesce_window_s()
         self._coalescer = None
         self._cpu_pool = None
@@ -210,8 +217,13 @@ class _SolverHandler:
     # -- dispatch (shared by Solve and SessionSolve) ---------------------
 
     def _dispatch_one(self, item: dict):
-        return self._solver._invoke(
+        out = self._solver._invoke(
             item["args"], item["key"], item["max_bins"])
+        # the engine THIS dispatch ran, read on the dispatching thread
+        # (the solver's engine slot is thread-local): the replay capture
+        # must never stamp another tenant's rung onto this item
+        item["engine"] = self._solver._last_engine
+        return out
 
     def _dispatch_many(self, items: list):
         from karpenter_tpu.models.solver import (
@@ -233,30 +245,66 @@ class _SolverHandler:
                 return [self._dispatch_one(items[0])]
             return list(self._cpu_pool.map(self._dispatch_one, items))
         first = items[0]
+        for it in items:
+            it["engine"] = "device"  # the vmapped fold IS the device path
         return batched_invoke(
             [it["args"] for it in items], first["max_bins"],
             level_bits=first["key"][-2], max_minv=first["key"][-1])
 
     def _dispatch(self, args: dict, key: tuple, max_bins: int):
+        """Returns ``(outputs, engine)`` — the engine rides the item dict
+        (set by whichever thread actually dispatched it, before the
+        coalescer hands the result back), so the replay capture is exact
+        even for folded/concurrent requests."""
         item = {"args": args, "key": key, "max_bins": max_bins}
         if self._coalescer is None:
-            return self._dispatch_one(item)
-        # bucket = the executable identity: static params + every array's
-        # padded shape/dtype — exactly what the compile ledger keys on, so
-        # folded requests share one compiled program by construction
-        bucket = (
-            max_bins, key[-2], key[-1],
-            tuple(sorted(
-                (k, np.asarray(v).shape, np.asarray(v).dtype.str)
-                for k, v in args.items()
-            )),
-        )
-        return self._coalescer.submit(bucket, item)
+            out = self._dispatch_one(item)
+        else:
+            # bucket = the executable identity: static params + every
+            # array's padded shape/dtype — exactly what the compile ledger
+            # keys on, so folded requests share one compiled program by
+            # construction
+            bucket = (
+                max_bins, key[-2], key[-1],
+                tuple(sorted(
+                    (k, np.asarray(v).shape, np.asarray(v).dtype.str)
+                    for k, v in args.items()
+                )),
+            )
+            out = self._coalescer.submit(bucket, item)
+        return out, item.get("engine", "device")
+
+    def close(self):
+        """Release background resources: stop the session sweeper and the
+        CPU fan-out pool. Wired into the server's stop() so an in-process
+        service (tests, perf) does not leak a waking thread per
+        instance."""
+        if self._sweeper_stop is not None:
+            self._sweeper_stop.set()
+        if self._cpu_pool is not None:
+            self._cpu_pool.shutdown(wait=False)
 
     @staticmethod
     def _outputs(out: dict) -> dict:
         return {k: np.asarray(out[k])
                 for k in ("assign", "assign_e", "used", "tmpl", "F")}
+
+    def _capture(self, args, key, max_bins, out, engine, tenant=None):
+        """Service-boundary replay capture (obs/capsule.py): attached to
+        the server's open round trace, tenant-scoped on session solves —
+        an anomalous serving round yields a capsule replayable offline
+        with the exact tensors this tenant shipped. ``engine`` is the
+        per-item engine `_dispatch` threads back (never the shared
+        solver's slot — a concurrent tenant's rung must not leak in)."""
+        from karpenter_tpu.obs import capsule as _capsule
+
+        if not _capsule.capture_enabled():
+            return
+        _capsule.record_capture(
+            "service.solve", args, self._outputs(out), tenant=tenant,
+            engine=engine,
+            max_bins=int(max_bins), level_bits=int(key[-2]),
+            max_minv=int(key[-1]))
 
     # -- RPC bodies ------------------------------------------------------
 
@@ -281,7 +329,8 @@ class _SolverHandler:
             # own, linked to the client's reconcile round by trace id
             with obs.round_trace("solver-service", registry=self._registry,
                                  client_trace=meta.get("trace_id") or None):
-                out = self._dispatch(args, key, max_bins)
+                out, engine = self._dispatch(args, key, max_bins)
+                self._capture(args, key, max_bins, out, engine)
             return _pack(self._outputs(out), {})
         except Exception as e:
             outcome = "error"
@@ -369,7 +418,9 @@ class _SolverHandler:
                             "session.sync", "resync",
                             meta.get("sync_reason") or "initial",
                             registry=self._registry, tenant=tenant)
-                    out = self._dispatch(args, key, max_bins)
+                    out, engine = self._dispatch(args, key, max_bins)
+                    self._capture(args, key, max_bins, out, engine,
+                                  tenant=tenant)
             return _pack(self._outputs(out), {
                 "mode": meta.get("mode", "full"),
                 "full_uploads": sess.full_uploads,
@@ -439,6 +490,16 @@ def serve(port: int = 0, use_native: bool = False, max_workers: int = 4,
     # exposed for tests (fault injection on the serving solver) and for
     # embedding callers that want the SLO tracker / session registry
     server.solver_handler = handler
+    # stop() must also release the handler's background resources (the
+    # session sweeper thread, the CPU fan-out pool): grpc's stop knows
+    # nothing about them, so wrap it
+    _grpc_stop = server.stop
+
+    def _stop(grace=None):
+        handler.close()
+        return _grpc_stop(grace)
+
+    server.stop = _stop
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise RuntimeError(f"solver service: failed to bind {host}:{port}")

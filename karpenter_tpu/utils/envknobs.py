@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["env_int", "env_float", "env_bool"]
+__all__ = ["env_int", "env_float", "env_bool", "snapshot"]
 
 
 def env_int(name: str, default: int, minimum: int | None = None) -> int:
@@ -41,3 +41,11 @@ def env_bool(name: str, default: bool) -> bool:
     if not v:
         return default
     return v not in ("0", "false", "off", "no")
+
+
+def snapshot(prefix: str = "KARPENTER_") -> dict:
+    """Every set env knob under ``prefix`` — the replay capsule's
+    environment record (obs/capsule.py): a capture's routing/partition/
+    repair knobs ride along so an offline replay can reproduce the exact
+    ladder decisions the capturing process made."""
+    return {k: v for k, v in os.environ.items() if k.startswith(prefix)}

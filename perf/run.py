@@ -88,6 +88,23 @@ import os
 ORACLE_POD_CAP = int(os.environ.get("PERF_ORACLE_CAP", "20000"))
 
 
+def pod_error_breakdown(res) -> dict:
+    """{reason: count} over a solve result's unscheduled pods. The host
+    FFD's per-pod errors are pod-specific strings (every nodepool attempt
+    joined with "; ", details after the second comma); collapsing each to
+    its first attempt's leading clauses yields a bounded reason vocabulary
+    — 'incompatible with nodepool "x", incompatible requirements',
+    'no nodepool available', … — so a grid row that schedules 47/50 names
+    the 3 misses instead of silently under-counting (VERDICT weak #4)."""
+    out: dict = {}
+    for err in (res.pod_errors or {}).values():
+        s = " ".join(str(err).strip().split()) or "unknown"
+        s = s.split(";", 1)[0]
+        s = ", ".join(p.strip() for p in s.split(",")[:2])
+        out[s[:120] or "unknown"] = out.get(s[:120] or "unknown", 0) + 1
+    return out
+
+
 def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
     from karpenter_tpu.models import HostSolver, TPUSolver
     from karpenter_tpu.obs import decisions
@@ -125,6 +142,7 @@ def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
     }
     breakdown["cache_hits"] = stats.get("group_row_cache_hits", 0)
     breakdown["cache_misses"] = stats.get("group_row_cache_misses", 0)
+    scheduled = res.scheduled_pod_count()
     out = {
         "config": name,
         "pods": len(pods),
@@ -132,7 +150,7 @@ def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
         "ms": round(elapsed * 1000, 2),
         "pods_per_sec": round(pps),
         "nodes": nodes,
-        "scheduled": res.scheduled_pod_count(),
+        "scheduled": scheduled,
         "floor_ok": bool(pps >= 100.0) if len(pods) > 100 else True,
         "engine": stats.get("engine"),
         "host_routed": stats.get("host_routed") or {},
@@ -147,6 +165,12 @@ def run_solve_config(name, pods, pools, catalog, trace=False, **solver_kw):
         "rungs": decisions.rung_delta(dec0, decisions.counts()),
         "breakdown": breakdown,
     }
+    if scheduled < len(pods):
+        # a row that quietly schedules 47/50 is a silent failure: name the
+        # WHY per reason — the host FFD's per-pod errors collapsed to a
+        # bounded reason vocabulary, beside the host-route reasons (waves
+        # host_reasons / solver routing) already in host_routed above
+        out["pod_errors"] = pod_error_breakdown(res)
     if trace_out is not None:
         out["trace"] = trace_out
     if len(pods) <= ORACLE_POD_CAP or os.environ.get("PERF_FULL_ORACLE"):

@@ -1,0 +1,523 @@
+"""Replay capsules (obs/capsule.py): capture any hot-path solve, replay
+it bit-exactly offline, A/B every rung.
+
+The determinism contract this suite pins:
+
+- every anomalous round yields exactly ONE ``.capsule.npz`` next to its
+  Chrome dump (clean rounds none; KARPENTER_CAPSULE=1 forces all,
+  KARPENTER_CAPSULE=0 disables capture outright);
+- replay bit-parity holds per engine — xla and native solver captures,
+  the probe's chunked counterfactual dispatch, and the partitioned mesh
+  rung via ``partitioned_reference`` (the one-device oracle that is
+  bit-identical to the multi-device execution);
+- the schema round-trips and FORWARD versions are rejected (a capsule
+  from a newer build must not be silently misread);
+- the size budget (``KARPENTER_CAPSULE_BYTES``) refuses oversized
+  captures instead of wedging the round on disk I/O;
+- capture overhead on anomaly-free rounds stays ≤2% (slow-marked,
+  interleaved off/on sampling like the tracer's own overhead test).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs import capsule, decisions
+
+GIB = 2**30
+
+
+@pytest.fixture
+def rec(tmp_path):
+    """Isolated tracer/recorder/capsule state in a fresh dump dir."""
+    obs.configure(enabled=True, dump_dir=str(tmp_path), capacity=8,
+                  dump_all=False)
+    obs.RECORDER.clear()
+    capsule.reset()
+    decisions.reset()
+    yield tmp_path
+    obs.reset()
+
+
+def capsules_in(tmp_path) -> list:
+    return sorted(p for p in os.listdir(tmp_path)
+                  if p.endswith(".capsule.npz"))
+
+
+def small_workload(n_pods=40, n_types=20):
+    from karpenter_tpu.api.nodepool import NodePool
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+    from karpenter_tpu.models import ClaimTemplate
+
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    catalog = benchmark_catalog(n_types)
+    pods = [Pod(metadata=ObjectMeta(name=f"p{i}"),
+                requests={"cpu": 0.5, "memory": 1 * GIB})
+            for i in range(n_pods)]
+    return pods, [ClaimTemplate(pool)], {pool.name: catalog}
+
+
+def solve_capturing(solver=None):
+    """One small solve; returns (solver, results)."""
+    from karpenter_tpu.models import TPUSolver
+
+    solver = solver or TPUSolver()
+    pods, templates, its = small_workload()
+    res = solver.solve([p.clone() for p in pods], templates, its)
+    return solver, res
+
+
+# every recorder trigger wired today: the PR-5 five, the devplane's
+# cold-compile, and the decision plane's two (obs/trace.py docstring)
+TRIGGERS = (
+    "probe-fallback", "multi-host-confirms", "snapshot-rebuild",
+    "host-routed", "negative-avail", "cold-compile-in-steady-state",
+    "rung-regression", "solve-overhead-drift",
+)
+
+
+class TestCaptureLifecycle:
+    def test_clean_round_writes_no_capsule(self, rec):
+        with obs.round_trace("clean") as tr:
+            solve_capturing()
+            assert tr.capsule_pending is not None  # the cheap reference
+        # a clean round RELEASES its pending tensors at close — the
+        # recorder ring must not pin 32 rounds' snapshots for nothing;
+        # the thread's last-capture slot still holds the newest one
+        assert tr.capsule_pending is None
+        assert tr.capsule_path is None
+        assert capsules_in(rec) == []
+        assert capsule.last_capture() is not None
+
+    def test_written_round_releases_pending(self, rec):
+        with obs.round_trace("kept") as tr:
+            solve_capturing()
+            obs.anomaly("host-routed")
+        assert tr.capsule_path is not None
+        assert tr.capsule_pending is None  # on disk, not pinned in RAM
+
+    @pytest.mark.parametrize("kind", TRIGGERS)
+    def test_anomalous_round_writes_exactly_one(self, rec, kind):
+        with obs.round_trace("anomalous") as tr:
+            solve_capturing()
+            obs.anomaly(kind)
+        files = capsules_in(rec)
+        assert len(files) == 1, files
+        assert tr.capsule_path == os.path.join(str(rec), files[0])
+        cap = capsule.load(tr.capsule_path)
+        assert kind in (cap.meta.get("anomalies") or [])
+        # idempotent: re-recording the trace must not mint a second file
+        obs.RECORDER.record(tr)
+        assert len(capsules_in(rec)) == 1
+
+    def test_forced_rung_regression_yields_replayable_capsule(
+            self, rec, monkeypatch):
+        """The acceptance scenario: a steady-state solver.route downgrade
+        fires rung-regression THROUGH the ledger, and the round's capsule
+        replays bit-identically offline."""
+        monkeypatch.setenv("KARPENTER_RUNG_STEADY_AFTER", "4")
+        decisions.reset()
+        for _ in range(4):
+            decisions.record_decision("solver.route", "xla")
+        with obs.round_trace("regressed") as tr:
+            solve_capturing()  # holds the xla rung (streak continues)
+            # the forced downgrade: a host-rung verdict with a non-benign
+            # reason (the producer contracts are pinned in test_decisions)
+            decisions.record_decision("solver.route", "host", "no-templates")
+        assert any(k == "rung-regression"
+                   for k, _, _ in tr.anomalies), tr.anomalies
+        assert tr.capsule_path is not None
+        cap = capsule.load(tr.capsule_path)
+        r = capsule.replay(cap)
+        assert r["parity"] == "exact" and r["rung_match"]
+        # the capsule carries the round's ledger verdicts
+        sites = {d["site"] for d in cap.meta["decisions"]}
+        assert "solver.route" in sites
+
+    def test_forced_solve_overhead_drift_yields_capsule(
+            self, rec, monkeypatch):
+        monkeypatch.setenv("KARPENTER_QUALITY_STEADY_AFTER", "2")
+        decisions.reset()
+        for _ in range(2):
+            decisions.record_quality(10, 10, family="t")
+        with obs.round_trace("drifting") as tr:
+            solve_capturing()
+            decisions.record_quality(20, 10, family="t")  # 2.0 vs 1.0
+        assert any(k == "solve-overhead-drift" for k, _, _ in tr.anomalies)
+        assert tr.capsule_path is not None
+        assert capsule.replay(capsule.load(tr.capsule_path))["parity"] == \
+            "exact"
+
+    def test_forced_capture_writes_without_anomaly(self, rec, monkeypatch):
+        monkeypatch.setenv("KARPENTER_CAPSULE", "1")
+        with obs.round_trace("forced"):
+            solve_capturing()
+        files = capsules_in(rec)
+        assert len(files) == 1
+        cap = capsule.load(os.path.join(str(rec), files[0]))
+        assert cap.meta["why"] == "forced"
+
+    def test_capture_off_switch(self, rec, monkeypatch):
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        with obs.round_trace("off") as tr:
+            solve_capturing()
+            obs.anomaly("host-routed")
+        assert tr.capsule_pending is None
+        assert capsules_in(rec) == []
+
+    def test_index_and_introspect_surface(self, rec):
+        with obs.round_trace("indexed"):
+            solve_capturing()
+            obs.anomaly("host-routed")
+        idx = capsule.index()
+        assert len(idx) == 1 and idx[0]["seam"] == "solver.invoke"
+        snap = decisions.introspect_snapshot()
+        assert snap["capsules"] and snap["capsules"][0]["path"].endswith(
+            ".capsule.npz")
+        assert snap["anomalies"][0]["capsule"] == idx[0]["path"]
+        from karpenter_tpu.obs.__main__ import render_report
+
+        assert "replay capsules" in render_report(snap)
+
+
+class TestSchema:
+    def _roundtrip_rec(self):
+        return capsule.record_capture(
+            "solver.invoke",
+            {"a": np.arange(12, dtype=np.int32).reshape(3, 4)},
+            {"used": np.array([True, False])},
+            engine="device", max_bins=2, level_bits=7, max_minv=0,
+            family="4x4", pallas=False)
+
+    def test_round_trip(self, rec, tmp_path):
+        r = self._roundtrip_rec()
+        path = capsule.write_capsule(
+            r, path=str(tmp_path / "x.capsule.npz"), why="forced")
+        cap = capsule.load(path)
+        assert cap.meta["schema"] == capsule.SCHEMA_VERSION
+        assert cap.seam == "solver.invoke" and cap.engine == "device"
+        assert cap.static("max_bins") == 2 and cap.static("level_bits") == 7
+        np.testing.assert_array_equal(cap.inputs["a"],
+                                      np.arange(12).reshape(3, 4))
+        np.testing.assert_array_equal(cap.outputs["used"], [True, False])
+        # the env-knob snapshot rides along (conftest sets this one)
+        assert "KARPENTER_NATIVE_CUTOFF" in cap.meta["env"]
+
+    def test_forward_version_rejected(self, rec, tmp_path):
+        r = self._roundtrip_rec()
+        path = capsule.write_capsule(
+            r, path=str(tmp_path / "fwd.capsule.npz"), why="forced")
+        with np.load(path, allow_pickle=False) as z:
+            payload = {k: z[k] for k in z.files}
+        meta = json.loads(bytes(payload[capsule.META_KEY]).decode())
+        meta["schema"] = capsule.SCHEMA_VERSION + 1
+        payload[capsule.META_KEY] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)
+        with open(path, "wb") as f:
+            np.savez(f, **payload)
+        with pytest.raises(ValueError, match="newer than this build"):
+            capsule.load(path)
+        from karpenter_tpu.obs.__main__ import run_replay
+
+        assert run_replay(path) == 1
+
+    def test_not_a_capsule_rejected(self, tmp_path):
+        path = str(tmp_path / "plain.npz")
+        np.savez(path, a=np.zeros(3))
+        with pytest.raises(ValueError, match="not a replay capsule"):
+            capsule.load(path)
+
+    def test_byte_budget_skips_and_counts(self, rec, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_CAPSULE_BYTES", "16")
+        r = self._roundtrip_rec()
+        assert capsule.write_capsule(
+            r, path=str(tmp_path / "big.capsule.npz"), why="forced") is None
+        assert not os.path.exists(tmp_path / "big.capsule.npz")
+        assert capsule.STATS["skipped_bytes"] == 1
+        # the budgeted round still records the reference and stays silent
+        with obs.round_trace("budgeted") as tr:
+            solve_capturing()
+            obs.anomaly("host-routed")
+        assert tr.capsule_path is None
+        assert capsules_in(rec) == []
+
+
+class TestReplayParity:
+    def test_xla_capture_replays_bit_identically(self, rec, tmp_path):
+        solve_capturing()
+        r = capsule.last_capture()
+        assert r is not None and r["seam"] == "solver.invoke"
+        assert r["meta"]["engine"] == "device"  # conftest pins the XLA path
+        path = capsule.write_capsule(
+            r, path=str(tmp_path / "xla.capsule.npz"), why="forced")
+        rep = capsule.replay(capsule.load(path))
+        assert rep["parity"] == "exact"
+        assert rep["rung"] == "xla" and rep["rung_match"]
+        assert rep["nodes"] == rep["captured_nodes"]
+
+    def test_native_capture_replays_bit_identically(self, rec, tmp_path):
+        from karpenter_tpu import native
+
+        if not native.available():
+            pytest.skip("native engine not built")
+        from karpenter_tpu.models import NativeSolver
+
+        solve_capturing(NativeSolver())
+        r = capsule.last_capture()
+        assert r["meta"]["engine"] == "native"
+        path = capsule.write_capsule(
+            r, path=str(tmp_path / "nat.capsule.npz"), why="forced")
+        rep = capsule.replay(capsule.load(path))
+        assert rep["parity"] == "exact" and rep["rung"] == "native"
+
+    def test_mesh_partitioned_capture_replays_via_reference(
+            self, rec, tmp_path):
+        """The ICI workflow: a partitioned mesh capture replays through
+        partitioned_reference (sequential, one device) bit-identically —
+        the mesh exactness contract, now load-bearing for offline
+        debugging."""
+        import __graft_entry__ as graft
+        from karpenter_tpu.parallel import make_mesh, sharded_solve_host
+        from karpenter_tpu.parallel.mesh import LAST_RUN, estimate_bin_axis
+
+        snap = graft._wide_snapshot(n_groups=32, n_types=16)
+        args = graft._snapshot_args(snap)
+        mesh = make_mesh()
+        B = estimate_bin_axis(args)
+        with obs.round_trace("mesh") as tr:
+            sharded_solve_host(mesh, args, B)
+            obs.anomaly("rung-regression")
+        assert LAST_RUN.get("engine") == "partitioned"
+        assert tr.capsule_path is not None
+        cap = capsule.load(tr.capsule_path)
+        assert cap.seam == "mesh.solve" and cap.engine == "partitioned"
+        assert cap.static("n_shards") == int(mesh.devices.size)
+        rep = capsule.replay(cap)
+        assert rep["parity"] == "exact" and rep["rung"] == "partitioned"
+
+    def test_mesh_replicated_capture_replays_and_abs_exact(
+            self, rec, tmp_path, monkeypatch):
+        """A replicated-rung capture (partition kill-switched, as the env
+        snapshot records) replays exact, and --ab shows the replicated AND
+        xla rungs exact while the partitioned rung reports ineligible
+        under the capsule's own env — the env-snapshot fidelity check."""
+        import __graft_entry__ as graft
+        from karpenter_tpu.parallel import make_mesh, sharded_solve_host
+        from karpenter_tpu.parallel.mesh import LAST_RUN, estimate_bin_axis
+
+        monkeypatch.setenv("KARPENTER_SHARD_PARTITION", "0")
+        snap = graft._wide_snapshot(n_groups=32, n_types=16)
+        args = graft._snapshot_args(snap)
+        with obs.round_trace("mesh-repl") as tr:
+            sharded_solve_host(make_mesh(), args, estimate_bin_axis(args))
+            obs.anomaly("rung-regression")
+        assert LAST_RUN.get("engine") == "replicated"
+        cap = capsule.load(tr.capsule_path)
+        assert capsule.replay(cap)["parity"] == "exact"
+        monkeypatch.delenv("KARPENTER_SHARD_PARTITION")
+        rows = {r["rung"]: r for r in capsule.ab_compare(cap)}
+        assert rows["replicated"]["parity"] == "exact"
+        assert rows["xla"]["parity"] == "exact"
+        assert rows["partitioned"].get("eligible") is False
+
+    def test_probe_capture_replays_bit_identically(self, rec, tmp_path):
+        """The disruption probe seam: batched_single_feasible's dispatch
+        is captured with its counterfactual rows and replays through the
+        SAME chunked code path (dispatch_counterfactual_rows)."""
+        from perf import configs as C
+        from karpenter_tpu.controllers.disruption.helpers import (
+            get_candidates,
+        )
+        from karpenter_tpu.ops.consolidate import batched_single_feasible
+
+        env = C.config4_consolidation_env(4)
+        env.disruption.poll_period = float("inf")
+        d = env.disruption
+        candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                                    queue=d.queue)
+        assert candidates
+        out = batched_single_feasible(d.provisioner, d.cluster, d.store,
+                                      list(candidates))
+        assert out is not None
+        r = capsule.last_capture()
+        assert r is not None and r["seam"] == "probe.dispatch"
+        path = capsule.write_capsule(
+            r, path=str(tmp_path / "probe.capsule.npz"), why="forced")
+        cap = capsule.load(path)
+        rep = capsule.replay(cap)
+        assert rep["parity"] == "exact"
+        # probe A/B covers the device/native pair only
+        rungs = [row["rung"] for row in capsule.ab_compare(cap)]
+        assert rungs == ["device", "native"]
+
+    def test_service_capture_is_tenant_scoped(self, rec, monkeypatch):
+        grpc = pytest.importorskip("grpc")  # noqa: F841
+        from karpenter_tpu.service import RemoteSolver, serve
+
+        monkeypatch.setenv("KARPENTER_CAPSULE", "1")
+        srv, port = serve(port=0)
+        try:
+            pods, templates, its = small_workload()
+            solver = RemoteSolver(f"127.0.0.1:{port}", tenant="acme")
+            res = solver.solve([p.clone() for p in pods], templates, its)
+            assert solver.last_device_stats["engine"] == "remote"
+            assert res.scheduled_pod_count() == len(pods)
+        finally:
+            srv.stop(grace=None)
+        mine = [f for f in capsules_in(rec) if "-acme-" in f]
+        assert mine, capsules_in(rec)
+        cap = capsule.load(os.path.join(str(rec), mine[0]))
+        assert cap.seam == "service.solve"
+        assert cap.meta["tenant"] == "acme"
+        assert capsule.replay(cap)["parity"] == "exact"
+
+    def test_host_ffd_rung_reports_in_ab(self, rec, tmp_path):
+        """The A/B ladder's bottom rung: the pure-numpy FFD oracle is
+        eligible on a plain snapshot, deterministic, and lands every pod
+        the kernel landed (node count may legitimately differ — the table
+        reports it)."""
+        solve_capturing()
+        path = capsule.write_capsule(
+            capsule.last_capture(),
+            path=str(tmp_path / "h.capsule.npz"), why="forced")
+        cap = capsule.load(path)
+        rows = {r["rung"]: r for r in capsule.ab_compare(cap)}
+        host = rows["host"]
+        assert host.get("eligible") and host["nodes"] is not None
+        # deterministic: two host replays bit-agree
+        a = capsule._run_host_ffd(cap)
+        b = capsule._run_host_ffd(cap)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+        # every pod placed (the captured solve placed all of them too)
+        placed = a["assign"].sum() + a["assign_e"].sum()
+        assert placed == int(np.asarray(cap.inputs["g_count"]).sum())
+        assert host["nodes"] == rows["xla"]["nodes"]
+
+
+class TestReplayCLI:
+    def _capsule_path(self, tmp_path) -> str:
+        solve_capturing()
+        return capsule.write_capsule(
+            capsule.last_capture(),
+            path=str(tmp_path / "cli.capsule.npz"), why="forced")
+
+    def test_replay_exit_codes_and_json(self, rec, tmp_path, capsys):
+        from karpenter_tpu.obs.__main__ import main
+
+        path = self._capsule_path(tmp_path)
+        assert main(["replay", path, "--json"]) == 0
+        reply = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert reply["replay"]["parity"] == "exact"
+        assert reply["seam"] == "solver.invoke"
+
+    def test_replay_ab_renders_table(self, rec, tmp_path, capsys):
+        from karpenter_tpu.obs.__main__ import main
+
+        path = self._capsule_path(tmp_path)
+        assert main(["replay", path, "--ab"]) == 0
+        out = capsys.readouterr().out
+        for rung in ("xla", "native", "host", "partitioned"):
+            assert rung in out
+        assert "parity" in out
+
+    def test_tampered_outputs_fail_replay(self, rec, tmp_path):
+        from karpenter_tpu.obs.__main__ import run_replay
+
+        path = self._capsule_path(tmp_path)
+        cap = capsule.load(path)
+        outputs = dict(cap.outputs)
+        outputs["tmpl"] = np.asarray(outputs["tmpl"]) + 1
+        tampered = capsule.write_capsule(
+            {"seam": cap.seam, "tenant": None, "meta": cap.meta["meta"],
+             "inputs": cap.inputs, "outputs": outputs, "at": 0.0},
+            path=str(tmp_path / "bad.capsule.npz"), why="forced")
+        assert run_replay(tampered) == 1
+
+
+class TestBenchReplayVerify:
+    """The --replay-verify leg's pure evaluator (the subprocess legs ride
+    the same run_capture/run_replay bodies tested above)."""
+
+    RECORD = {"metric": "m", "detail": {
+        "engine": "cpu", "rungs": {"solver.route": {"xla": 1}}}}
+
+    def test_clean_pass(self):
+        import bench
+
+        problems = bench.replay_verify_problems(
+            self.RECORD,
+            {"capsule": "/tmp/x.capsule.npz",
+             "rungs": {"solver.route": {"xla": 1}}},
+            {"replay": {"parity": "exact", "rung": "xla",
+                        "captured_rung": "xla", "rung_match": True}})
+        assert problems == []
+
+    def test_parity_mismatch_fails(self):
+        import bench
+
+        problems = bench.replay_verify_problems(
+            self.RECORD,
+            {"capsule": "/tmp/x.capsule.npz",
+             "rungs": {"solver.route": {"xla": 1}}},
+            {"replay": {"parity": "differs", "nodes": 5,
+                        "captured_nodes": 4, "rung_match": True}})
+        assert any("bit-identically" in p for p in problems)
+
+    def test_decision_rung_mismatch_fails(self):
+        import bench
+
+        problems = bench.replay_verify_problems(
+            self.RECORD,
+            {"capsule": "/tmp/x.capsule.npz",
+             "rungs": {"solver.route": {"host": 1}}},
+            {"replay": {"parity": "exact", "rung_match": True}})
+        assert any("decision-rung mismatch" in p for p in problems)
+
+    def test_missing_capsule_fails(self):
+        import bench
+
+        problems = bench.replay_verify_problems(self.RECORD, {}, {})
+        assert any("no capsule" in p for p in problems)
+
+
+@pytest.mark.slow
+class TestCaptureOverhead:
+    def test_capture_overhead_grid_1000(self, rec, monkeypatch):
+        """Capture-on grid-1000 stays within 2% (+20ms absolute, this
+        noisy box) of capture-off — the reference-only capture's real cost
+        is one dict build per dispatch. Off/on samples INTERLEAVE and each
+        side takes its minimum, the tracer overhead test's anti-flake
+        discipline."""
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from karpenter_tpu.models import TPUSolver
+        from perf import configs as C
+        from perf.run import _solve_timed
+
+        catalog = benchmark_catalog(400)
+        pools = [NodePool(metadata=ObjectMeta(name="default"))]
+        pods = C.diverse_pods(1000)
+        solver = TPUSolver()
+        _solve_timed(solver, pods, pools, catalog)  # warm compiles
+
+        def one(capturing: bool) -> float:
+            monkeypatch.setenv("KARPENTER_CAPSULE",
+                               "" if capturing else "0")
+            with obs.round_trace("bench"):
+                _, el = _solve_timed(solver, pods, pools, catalog)
+            return el * 1000.0
+
+        off_samples, on_samples = [], []
+        for _ in range(7):
+            off_samples.append(one(False))
+            on_samples.append(one(True))
+        off, on = min(off_samples), min(on_samples)
+        assert on <= off * 1.02 + 20.0, (
+            f"capture overhead too high: on={on:.1f}ms off={off:.1f}ms "
+            f"(on {on_samples}, off {off_samples})")

@@ -792,7 +792,7 @@ class TestIntrospection:
         self._populate(reg)
         snap = decisions.introspect_snapshot()
         assert set(snap) == {"sites", "rounds", "quality", "tenants",
-                             "anomalies"}
+                             "anomalies", "capsules"}
         assert snap["sites"]["solver.route"]["last"]["rung"] == "xla"
         assert snap["quality"]["series"]
         json.dumps(snap)  # endpoint-serializable

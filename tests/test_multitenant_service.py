@@ -551,6 +551,71 @@ class TestBleedHook:
         assert reg.counter(m.SOLVER_BLEED_CHECKS).value(outcome="ok") == 2
 
 
+class TestSessionSweep:
+    """Sweep-driven session GC (ROADMAP lever closed): expiry releases an
+    idle tenant's bundle bytes WITHOUT any client access tripping the
+    reap-on-access path."""
+
+    def test_sweep_reclaims_idle_expired_bytes(self):
+        reg = Registry()
+        clock = [0.0]
+        sessions = sess_mod.SessionRegistry(ttl_s=10.0,
+                                            now=lambda: clock[0])
+        sess = sessions.register("idle", registry=reg)
+        sessions.apply(sess, {"a": np.zeros((8, 4), dtype=np.float32)},
+                       {"seq": 1, "mode": "full"}, registry=reg)
+        assert sessions.stats()["bytes"] > 0
+        clock[0] = 11.0
+        # no lookup/apply/register happens — the sweep alone reclaims
+        assert sessions.sweep(registry=reg) == 1
+        st = sessions.stats()
+        assert st["sessions"] == 0 and st["bytes"] == 0
+        assert reg.counter(m.SOLVER_SESSION_SWEEPS).value() == 1
+        assert reg.gauge(m.SOLVER_SESSIONS).value() == 0
+        assert reg.gauge(m.SOLVER_SESSION_CACHE_BYTES).value() == 0
+
+    def test_sweep_keeps_live_sessions(self):
+        clock = [0.0]
+        sessions = sess_mod.SessionRegistry(ttl_s=10.0,
+                                            now=lambda: clock[0])
+        self._seed(sessions, "fresh")
+        clock[0] = 5.0
+        assert sessions.sweep() == 0
+        assert sessions.stats()["sessions"] == 1
+
+    @staticmethod
+    def _seed(sessions, tenant):
+        sess = sessions.register(tenant)
+        sessions.apply(sess, {"a": np.zeros((8, 4), dtype=np.float32)},
+                       {"seq": 1, "mode": "full"})
+        return sess
+
+    def test_sweeper_thread_reclaims_without_client_access(self):
+        """The daemon sweeper end to end: an expired idle tenant's bytes
+        disappear while NOTHING calls into the registry."""
+        reg = Registry()
+        sessions = sess_mod.SessionRegistry(ttl_s=0.05)
+        self._seed(sessions, "idle")
+        assert sessions.stats()["bytes"] > 0
+        stop = sessions.start_sweeper(interval_s=0.02, registry=reg)
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if sessions.stats()["bytes"] == 0:
+                    break
+                time.sleep(0.02)
+            st = sessions.stats()
+            assert st["bytes"] == 0 and st["sessions"] == 0
+            assert reg.counter(m.SOLVER_SESSION_SWEEPS).value() >= 1
+        finally:
+            stop.set()
+
+    def test_sweeper_disabled_by_knob(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SESSION_SWEEP_S", "0")
+        sessions = sess_mod.SessionRegistry()
+        assert sessions.start_sweeper() is None
+
+
 class TestSessionRegistryUnits:
     @staticmethod
     def _with_bundle(sessions, tenant, rows=6):

@@ -47,6 +47,61 @@ class TestConfigs:
         ]
         assert gpu_nodes, "GPU pods must land on GPU-capable claims"
 
+    def test_pod_error_breakdown_collapses_reasons(self):
+        """The canonicalizer keeps the first attempt's two leading
+        clauses (nodepool + cause) so pod-specific detail cannot explode
+        the vocabulary."""
+        from types import SimpleNamespace
+
+        from perf.run import pod_error_breakdown
+
+        res = SimpleNamespace(pod_errors={
+            "p1": 'incompatible with nodepool "default", incompatible '
+                  'requirements, key node.kubernetes.io/instance-type; '
+                  'incompatible with nodepool "spot", incompatible '
+                  'requirements, key karpenter.sh/capacity-type',
+            "p2": 'incompatible with nodepool "default", incompatible '
+                  'requirements, label mismatch on arch',
+            "p3": "no nodepool available",
+        })
+        out = pod_error_breakdown(res)
+        assert out == {
+            'incompatible with nodepool "default", incompatible '
+            'requirements': 2,
+            "no nodepool available": 1,
+        }
+        assert pod_error_breakdown(SimpleNamespace(pod_errors={})) == {}
+
+    def test_partial_row_emits_pod_errors(self, capsys):
+        """A perf row that schedules fewer pods than it was handed must
+        carry the per-reason breakdown (VERDICT weak #4: grid-50's silent
+        47/50); fully-scheduled rows carry none."""
+        import json
+
+        from karpenter_tpu.api.nodepool import NodePool
+        from karpenter_tpu.api.objects import ObjectMeta, Pod
+        from karpenter_tpu.cloudprovider.catalog import benchmark_catalog
+        from perf.run import run_solve_config
+
+        GIB = 2**30
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        catalog = benchmark_catalog(10)
+        pods = [Pod(metadata=ObjectMeta(name=f"p{i}"),
+                    requests={"cpu": 0.5, "memory": 1 * GIB})
+                for i in range(10)]
+        pods.append(Pod(metadata=ObjectMeta(name="impossible"),
+                        requests={"cpu": 1e6, "memory": 1 * GIB}))
+        run_solve_config("pod-errors", pods, [pool], catalog)
+        row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert row["pods"] == 11 and row["scheduled"] == 10
+        assert sum(row["pod_errors"].values()) == 1
+        assert all(isinstance(k, str) and k for k in row["pod_errors"])
+
+        run_solve_config("pod-errors-clean", pods[:10], [pool], catalog)
+        row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert row["scheduled"] == 10
+        assert "pod_errors" not in row
+
     def test_diverse_pods_mix(self):
         pods = C.diverse_pods(60)
         assert len(pods) == 60

@@ -663,7 +663,7 @@ class TestObsRules:
 
     def test_rules_registered(self):
         assert "GL401" in RULES and "GL402" in RULES and "GL403" in RULES
-        assert "GL404" in RULES
+        assert "GL404" in RULES and "GL405" in RULES
 
 
 class TestDevplaneRules:
@@ -863,6 +863,104 @@ class TestDecisionLedgerRules:
         assert findings == []
 
 
+class TestCapsuleRules:
+    """GL405: the replay-capsule hooks (obs/capsule.py) must stay
+    jit-unreachable — `record_capture` takes the module lock and mutates
+    trace/thread-local state, and the serializers do disk I/O; a
+    trace-time execution would freeze one batch's tensors as every later
+    solve's "capture", corrupting the bit-parity replay contract."""
+
+    def test_positive_capture_and_write_in_jitted_function(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import capsule\n"
+            "\n"
+            "def kernel(x):\n"
+            "    capsule.record_capture('solver.invoke', {}, {})\n"
+            "    capsule.write_capsule({'seam': 's'})\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL405", "GL405"]
+        assert "record_capture" in findings[0].message
+
+    def test_positive_bare_import_and_receiver_verb_spellings(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs.capsule import record_capture\n"
+            "from karpenter_tpu.obs import capsule\n"
+            "\n"
+            "def kernel(x):\n"
+            "    record_capture('mesh.solve', {}, {})\n"
+            "    capsule.capture(x)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL405", "GL405"]
+
+    def test_positive_hook_reached_through_call_edge(self):
+        """Reachability carries GL405 across modules like GL401-404: the
+        capture hides in a helper the jitted entry calls."""
+        findings, _ = analyze_sources({
+            "pkg.a": (
+                "import jax\n"
+                "from pkg.b import helper\n"
+                "\n"
+                "def entry(x):\n"
+                "    return helper(x)\n"
+                "\n"
+                "fn = jax.jit(entry)\n"
+            ),
+            "pkg.b": (
+                "from karpenter_tpu.obs import capsule\n"
+                "\n"
+                "def helper(t):\n"
+                "    capsule.record_capture('probe.dispatch', {}, {})\n"
+                "    return t * 2\n"
+            ),
+        })
+        assert rules_of(findings) == ["GL405"]
+        assert findings[0].path.endswith("pkg/b.py")
+
+    def test_negative_host_side_capture_site_not_flagged(self):
+        """The production pattern — dispatch the kernel, capture the
+        host-side result — never flags (models/solver.py, mesh.py,
+        consolidate.py, solver_service.py all hook exactly this way)."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import capsule\n"
+            "\n"
+            "def kernel(x):\n"
+            "    return x * 2\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+            "\n"
+            "def dispatch(args):\n"
+            "    out = fn(args)\n"
+            "    capsule.record_capture('solver.invoke', args, "
+            "{'used': out})\n"
+            "    return out\n"
+        )})
+        assert findings == []
+
+    def test_negative_generic_capture_verb_not_flagged(self):
+        """`capture` on non-capsule receivers (a profiler handle) stays
+        quiet inside jitted code — only the capsule receivers make the
+        verb GL405."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "\n"
+            "def kernel(x, prof):\n"
+            "    prof.capture(x.shape[0])\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel, static_argnames=('prof',))\n"
+        )})
+        assert findings == []
+
+
 # ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
@@ -971,11 +1069,11 @@ class TestPackageGate:
         for rule in ("GL101", "GL102", "GL103", "GL104",
                      "GL201", "GL202", "GL203",
                      "GL301", "GL302", "GL303",
-                     "GL401", "GL402", "GL403", "GL404"):
+                     "GL401", "GL402", "GL403", "GL404", "GL405"):
             assert rule in out
         assert set(RULES) == {
             "GL101", "GL102", "GL103", "GL104",
             "GL201", "GL202", "GL203",
             "GL301", "GL302", "GL303",
-            "GL401", "GL402", "GL403", "GL404",
+            "GL401", "GL402", "GL403", "GL404", "GL405",
         }
