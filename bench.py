@@ -37,7 +37,13 @@ partitioned end state bit-identical to its unsharded oracle), sharded
 exempted to parity-only), zero host-routed pods on the 500k burst row,
 and its sharded_ms is regression-compared against the newest committed
 MULTICHIP_r*.json (both the legacy dryrun-tail schema and the new
-perf-row schema parse). A >15% regression on any leg prints a delta
+perf-row schema parse). `--priority` adds the admission leg: a fresh
+`python -m perf priority` run must hold the ISSUE-12 acceptance — tier
+order never violated, gangs all-or-nothing (the starved-budget case
+routed, zero partial binds), node count ≤ the tiered-FFD oracle +2%,
+every preemption confirmed by real simulation before execute — and each
+row's ms regression-compares against the newest committed PERF_r*.json
+row of the same config. A >15% regression on any leg prints a delta
 table on stderr and
 exits 3 — the record is still on stdout, so drivers always get their
 line. KARPENTER_BENCH_SENTINEL=0 disables the gate (noisy shared boxes).
@@ -555,6 +561,64 @@ def _fresh_consolidation() -> dict:
     }
 
 
+def _priority_pairs():
+    """(sentinel pairs, hard-gate problems) for the admission leg
+    (`--priority`): one fresh `python -m perf priority` run must hold the
+    ISSUE-12 acceptance — tier order never violated, gangs all-or-nothing
+    (zero partial binds, the starved-budget gang routed), node count
+    ≤ the tiered-FFD oracle +2%, and every preemption confirmed by real
+    simulation before execute. Regression pairs compare each row's ms
+    against the newest committed PERF_r*.json rows of the same config."""
+    fresh = _fresh_perf_rows(["priority"])
+    problems, pairs = [], []
+    if not fresh:
+        problems.append("priority: no rows produced")
+        return pairs, problems
+    saw_gang = saw_preempt = False
+    for cfg, row in fresh.items():
+        if row.get("tier_order_ok") is False:
+            problems.append(
+                f"priority: {cfg} violated tier order (a lower-tier pod "
+                "placed while a feasible higher-tier pod host-routed)")
+        if row.get("gang_atomic_ok") is False:
+            problems.append(
+                f"priority: {cfg} partially bound "
+                f"{row.get('gang_partial_binds')} pod-group(s) — gangs "
+                "must place all-or-nothing")
+        if cfg.startswith("gang-"):
+            saw_gang = True
+            if not row.get("gangs_routed"):
+                problems.append(
+                    f"priority: {cfg} routed no gang — the starved-budget "
+                    "all-or-nothing case was never exercised")
+        overhead = row.get("node_overhead_pct")
+        if isinstance(overhead, (int, float)) and overhead > 2.0:
+            problems.append(
+                f"priority: {cfg} node overhead {overhead}% vs the "
+                "tiered-FFD oracle (bar: 2%)")
+        if cfg.startswith("preempt-"):
+            saw_preempt = True
+            if row.get("confirm_contract_ok") is False:
+                problems.append(
+                    f"priority: {cfg} shipped evictions without a "
+                    "confirming simulation")
+            if not row.get("preemptions_confirmed"):
+                problems.append(
+                    f"priority: {cfg} confirmed no preemption — the "
+                    "ladder was never exercised")
+    if not saw_gang or not saw_preempt:
+        problems.append(
+            "priority: a grid family is missing "
+            f"(gang={saw_gang}, preempt={saw_preempt}) — "
+            "a gate that never ran must not pass by absence")
+    base = _perf_baseline_rows()
+    for cfg, row in fresh.items():
+        b = base.get(cfg)
+        if b is not None and "ms" in b and "ms" in row:
+            pairs.append((cfg, float(b["ms"]), float(row["ms"])))
+    return pairs, problems
+
+
 def _multitenant_pairs() -> list:
     """Sentinel pairs for the multi-tenant fleet row: wall clock AND the
     concurrent worst-tenant p99 (a queueing/coalescing regression shows
@@ -756,7 +820,8 @@ def _multichip_pairs():
 
 
 def sentinel(record: dict, consolidation: bool = False,
-             multitenant: bool = False, multichip: bool = False) -> int:
+             multitenant: bool = False, multichip: bool = False,
+             priority: bool = False) -> int:
     """Exit code for the regression gate: 0 clean/ungated, 3 on a >15%
     headline-solve, consolidation, or multi-tenant-fleet regression vs
     the newest committed records. Headline comparison is ENGINE-GATED (an
@@ -804,6 +869,15 @@ def sentinel(record: dict, consolidation: bool = False,
             print("bench: multichip gate failed "
                   "(KARPENTER_BENCH_SENTINEL=0 to disable):", file=sys.stderr)
             for p in m_problems:
+                print(f"bench:   {p}", file=sys.stderr)
+            return 3
+    if priority:
+        p_pairs, p_problems = _priority_pairs()
+        pairs.extend(p_pairs)
+        if p_problems:
+            print("bench: priority/gang admission gate failed "
+                  "(KARPENTER_BENCH_SENTINEL=0 to disable):", file=sys.stderr)
+            for p in p_problems:
                 print(f"bench:   {p}", file=sys.stderr)
             return 3
     if not pairs:
@@ -923,7 +997,8 @@ def main():
                 rc = sentinel(
                     rec, consolidation="--consolidation" in sys.argv,
                     multitenant="--multitenant" in sys.argv,
-                    multichip="--multichip" in sys.argv)
+                    multichip="--multichip" in sys.argv,
+                    priority="--priority" in sys.argv)
                 if rc == 0 and "--replay-verify" in sys.argv:
                     # capture the headline solve, replay it in a fresh
                     # interpreter, exit 3 on parity/rung mismatch

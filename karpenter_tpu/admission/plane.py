@@ -1,0 +1,433 @@
+"""AdmissionPlane: the tiered cascade + gang + preemption orchestrator.
+
+Sits between the provisioner's pending-pod intake and the solver
+(provisioner.schedule routes live batches here when any pod carries a
+priority marker or a gang annotation; disruption counterfactuals and
+marker-free batches keep the plain single solve). One ``solve_round``:
+
+1. resolve effective priorities (priority.py), collect gangs (gangs.py),
+   partition into descending tiers;
+2. per tier, gangs first (trial on forked state, promote atomically or
+   route whole — ``admission.gang``), then the tier's loose pods through
+   the EXISTING batched pack: the shared ExistingNode objects accumulate
+   placements across tiers, and prior tiers' claims join the
+   existing-node axis as ``ClaimResidual`` rows on the device rung (the
+   ops/tensorize.py residual machinery) or as ``initial_claims`` on the
+   host rung — so lower tiers pack into the residual capacity of the same
+   bundle, one pow-2 compile family across tiers (``admission.tier``);
+3. pods still unschedulable walk the preemption ladder in tier order
+   (preempt.py: counterfactual batch → confirm-by-real-simulation →
+   PDB-gated evictions + nomination — ``admission.preempt``).
+
+KARPENTER_ADMISSION=0 disables the whole plane (single-solve behavior);
+KARPENTER_PREEMPTION=0 disables only the preemption ladder;
+KARPENTER_PREEMPT_MAX (16) bounds preemptors examined per round and
+KARPENTER_PREEMPT_CONFIRMS (4) confirming simulations per preemptor.
+"""
+
+from __future__ import annotations
+
+import os
+
+from karpenter_tpu import obs
+from karpenter_tpu.admission import preempt as _preempt
+from karpenter_tpu.admission.fork import (
+    fork_claim,
+    fork_enode,
+    fork_limits,
+    fork_topology,
+)
+from karpenter_tpu.admission.gangs import collect_gangs, inject_colocation
+from karpenter_tpu.admission.oracle import debit_limits, placed_uids
+from karpenter_tpu.admission.priority import (
+    default_class,
+    partition_tiers,
+    preemption_policy_of,
+    resolve_priority,
+)
+from karpenter_tpu.admission.residual import ClaimResidual
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.models.scheduler import SchedulerResults
+from karpenter_tpu.models.solver import HostSolver, TPUSolver
+from karpenter_tpu.obs import decisions
+from karpenter_tpu.utils.envknobs import env_int as _env_int
+
+__all__ = ["AdmissionPlane"]
+
+
+def _enabled() -> bool:
+    return os.environ.get("KARPENTER_ADMISSION", "1").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def _preempt_enabled() -> bool:
+    return os.environ.get("KARPENTER_PREEMPTION", "1").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+class _State:
+    """The cascade's mutable round state — what a gang trial forks and a
+    successful trial promotes."""
+
+    def __init__(self, topology, enodes, claims, limits):
+        self.topology = topology
+        self.enodes = list(enodes)
+        self.claims = list(claims)
+        self.limits = limits
+
+
+class AdmissionPlane:
+    def __init__(self, store=None, registry=None, recorder=None, log=None):
+        self.store = store
+        self.registry = registry
+        self.recorder = recorder
+        self.log = log
+
+    # -- engagement -------------------------------------------------------
+    def engages(self, pods) -> bool:
+        """True when the batch carries any admission marker — a priority
+        field, a named class, a gang annotation, or (with a store) a
+        global-default PriorityClass that would tier the batch."""
+        if not _enabled() or not pods:
+            return False
+        for p in pods:
+            if p.priority is not None or p.priority_class_name:
+                return True
+            if p.metadata.annotations.get(wk.POD_GROUP_ANNOTATION):
+                return True
+        if self.store is not None:
+            for pc in self.store.list("priorityclasses"):
+                if pc.global_default and pc.value != 0:
+                    return True
+        return False
+
+    # -- the round --------------------------------------------------------
+    def solve_round(self, solver, pods, templates, its, *, topology=None,
+                    existing_nodes=(), daemon_overhead=None, limits=None,
+                    volume_topology=None) -> SchedulerResults:
+        classes = (
+            {pc.name: pc for pc in self.store.list("priorityclasses")}
+            if self.store is not None else {}
+        )
+        dflt = default_class(classes)
+        prio_of = {
+            p.uid: resolve_priority(p, classes, dflt)[0] for p in pods
+        }
+        gangs, loose = collect_gangs(pods, prio_of)
+        gangs_by_prio: dict = {}
+        for g in gangs:
+            gangs_by_prio.setdefault(g.priority, []).append(g)
+        tiers_loose = dict(partition_tiers(loose, prio_of))
+        all_prios = sorted(set(tiers_loose) | set(gangs_by_prio),
+                           reverse=True)
+        decisions.record_decision(
+            "admission.tier",
+            "cascade" if len(all_prios) > 1 else "single",
+            "ok" if len(all_prios) > 1 else "single-tier",
+            registry=self.registry)
+
+        state = _State(topology, existing_nodes, [], fork_limits(limits))
+        errors: dict = {}
+        report = {
+            "tiers": len(all_prios), "gangs_placed": 0, "gangs_routed": 0,
+            "preemptions": 0, "evictions": 0, "preempt_declined": 0,
+            "preempt_unconfirmed": 0,
+            # host-routed pods aggregated across every COMMITTED inner
+            # solve (tier solves, mop-ups, promoted gang trials): the
+            # solver's last_device_stats only reflects its final call, so
+            # the provisioner's accounting reads this instead
+            "host_routed": {},
+        }
+        unplaced: list = []  # (priority, pod) after its tier's solve
+        for prio in all_prios:
+            for gang in gangs_by_prio.get(prio, ()):
+                self._solve_gang(solver, gang, state, templates, its,
+                                 daemon_overhead, volume_topology, errors,
+                                 report)
+            tier_pods = tiers_loose.get(prio, ())
+            if not tier_pods:
+                continue
+            missed = self._solve_tier(
+                solver, list(tier_pods), state, templates, its,
+                daemon_overhead, volume_topology, errors, report)
+            unplaced.extend((prio, p) for p in missed)
+
+        if unplaced and self.store is not None and _preempt_enabled():
+            with obs.span("admission.preempt",
+                          preemptors=len(unplaced)):
+                self._preempt_round(unplaced, prio_of, classes, state,
+                                    templates, its, daemon_overhead,
+                                    errors, report)
+
+        results = SchedulerResults(
+            new_claims=state.claims,
+            existing_nodes=list(state.enodes),
+            pod_errors=errors,
+        )
+        results.admission = report
+        return results
+
+    @staticmethod
+    def _note_routed(solver, report):
+        """Fold the last inner solve's host-routed reasons into the
+        round's aggregate (one dict across the whole cascade)."""
+        routed = (getattr(solver, "last_device_stats", None)
+                  or {}).get("host_routed") or {}
+        agg = report["host_routed"]
+        for reason, n in routed.items():
+            if n:
+                agg[reason] = agg.get(reason, 0) + n
+
+    # -- one tier's loose pods -------------------------------------------
+    def _solve_tier(self, solver, tier_pods, state, templates, its,
+                    daemon_overhead, volume_topology, errors,
+                    report) -> list:
+        """Solve one tier into the shared bundle; returns the tier's
+        unplaced pods (in input order)."""
+        device_rung = isinstance(solver, TPUSolver)
+        residuals = []
+        if device_rung:
+            residuals = [ClaimResidual(c) for c in state.claims]
+            res = solver.solve(
+                tier_pods, templates, its, topology=state.topology,
+                existing_nodes=list(state.enodes) + residuals,
+                daemon_overhead=daemon_overhead,
+                limits=fork_limits(state.limits),
+                volume_topology=volume_topology,
+            )
+            self._note_routed(solver, report)
+            new = [c for c in res.new_claims
+                   if all(c is not r.claim for r in residuals)]
+            originals = {p.uid: p for p in tier_pods}
+            mopup = []
+            for r in residuals:
+                mopup.extend(r.fold(originals))
+            if mopup:
+                # the exact re-admission refused a device residual commit
+                # (merged-requirement narrowing the decode approximates):
+                # one host mop-up seeded with every claim settles them.
+                # The tier's OWN new claims must debit the limit fork
+                # first — Scheduler never charges initial_claims, so an
+                # undebited fork would let the mop-up overshoot the pool
+                res2 = HostSolver().solve(
+                    mopup, templates, its, topology=state.topology,
+                    existing_nodes=list(state.enodes),
+                    daemon_overhead=daemon_overhead,
+                    limits=debit_limits(fork_limits(state.limits), new),
+                    initial_claims=state.claims + new,
+                    volume_topology=volume_topology,
+                )
+                new.extend(c for c in res2.new_claims
+                           if all(c is not pc
+                                  for pc in state.claims + new))
+                errors.update(res2.pod_errors)
+        else:
+            res = solver.solve(
+                tier_pods, templates, its, topology=state.topology,
+                existing_nodes=list(state.enodes),
+                daemon_overhead=daemon_overhead,
+                limits=fork_limits(state.limits),
+                initial_claims=state.claims,
+                volume_topology=volume_topology,
+            )
+            new = [c for c in res.new_claims
+                   if all(c is not pc for pc in state.claims)]
+        state.claims.extend(new)
+        state.limits = debit_limits(state.limits, new)
+        errors.update(res.pod_errors)
+        placed = placed_uids(state.claims, state.enodes)
+        return [p for p in tier_pods if p.uid not in placed]
+
+    # -- one gang ---------------------------------------------------------
+    def _solve_gang(self, solver, gang, state, templates, its,
+                    daemon_overhead, volume_topology, errors, report):
+        if len(gang.pods) < gang.min_member:
+            self._route_gang(gang, "oversize", errors, report,
+                             f"below min-member ({len(gang.pods)} < "
+                             f"{gang.min_member})")
+            return
+        topo = fork_topology(state.topology)
+        f_enodes = [fork_enode(en, topo) for en in state.enodes]
+        f_claims = [fork_claim(c, topo) for c in state.claims]
+        clones = inject_colocation(gang, [p.clone() for p in gang.pods])
+        if gang.topology_key and topo is not None:
+            # the injected co-location affinity exists only on the clones;
+            # the round's topology was built over the originals, so the
+            # gang's groups must register on the FORK or the constraint is
+            # silently inert (promotion carries the registration forward)
+            for c in clones:
+                topo.update(c)
+        device_rung = isinstance(solver, TPUSolver)
+        try:
+            if device_rung:
+                residuals = [ClaimResidual(c) for c in f_claims]
+                res = solver.solve(
+                    clones, templates, its, topology=topo,
+                    existing_nodes=f_enodes + residuals,
+                    daemon_overhead=daemon_overhead,
+                    limits=fork_limits(state.limits),
+                    volume_topology=volume_topology,
+                )
+                new = [c for c in res.new_claims
+                       if all(c is not r.claim for r in residuals)]
+                for r in residuals:
+                    if r.fold():
+                        # a refused fold means the trial was NOT fully
+                        # placed — the residual's optimistic capacity
+                        # over-promised, a capacity event (benign reason),
+                        # not a trial malfunction
+                        self._route_gang(gang, "infeasible", errors,
+                                         report, "residual fold refused")
+                        return
+            else:
+                res = solver.solve(
+                    clones, templates, its, topology=topo,
+                    existing_nodes=f_enodes,
+                    daemon_overhead=daemon_overhead,
+                    limits=fork_limits(state.limits),
+                    initial_claims=f_claims,
+                    volume_topology=volume_topology,
+                )
+                new = [c for c in res.new_claims
+                       if all(c is not fc for fc in f_claims)]
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "gang trial solve failed; routing group %s", gang.name,
+                exc_info=True)
+            self._route_gang(gang, "trial-error", errors, report,
+                             "trial solve raised")
+            return
+        placed = placed_uids(f_claims + new, f_enodes)
+        if all(p.uid in placed for p in clones):
+            # promote the trial wholesale: the fork becomes the live state
+            originals = {p.uid: p for p in gang.pods}
+            for c in f_claims + new:
+                c.pods = [originals.get(p.uid, p) for p in c.pods]
+            for node in f_enodes:
+                node.pods = [originals.get(p.uid, p) for p in node.pods]
+            state.topology = topo
+            state.enodes = f_enodes
+            state.claims = f_claims + new
+            state.limits = debit_limits(fork_limits(state.limits), new)
+            report["gangs_placed"] += 1
+            self._note_routed(solver, report)  # the trial IS the commit
+            decisions.record_decision("admission.gang", "atomic", "ok",
+                                      registry=self.registry)
+        else:
+            starved = any("exceed limits" in str(e)
+                          for e in res.pod_errors.values())
+            self._route_gang(
+                gang, "budget-starved" if starved else "infeasible",
+                errors, report, "could not place atomically")
+
+    def _route_gang(self, gang, reason, errors, report, why):
+        for p in gang.pods:
+            errors[p.key()] = f'pod group "{gang.name}" host-routed: {why}'
+        report["gangs_routed"] += 1
+        decisions.record_decision("admission.gang", "routed", reason,
+                                  registry=self.registry)
+
+    # -- preemption -------------------------------------------------------
+    def _preempt_round(self, unplaced, prio_of, classes, state, templates,
+                       its, daemon_overhead, errors, report):
+        from karpenter_tpu.operator import metrics as m
+        from karpenter_tpu.utils.pdb import PdbLimits
+
+        pdb_limits = PdbLimits(self.store)
+        taken: set = set()
+        max_preempts = _env_int("KARPENTER_PREEMPT_MAX", 16, minimum=0)
+        max_confirms = _env_int("KARPENTER_PREEMPT_CONFIRMS", 4, minimum=1)
+        examined = 0
+        for prio, pod in sorted(unplaced, key=lambda t: -t[0]):
+            if examined >= max_preempts:
+                break
+            examined += 1
+            outcome = self._preempt_one(
+                pod, prio_of, classes, state, templates, its,
+                daemon_overhead, pdb_limits, taken, max_confirms, errors,
+                report)
+            if self.registry is not None:
+                self.registry.counter(
+                    m.ADMISSION_PREEMPTIONS,
+                    "admission preemption ladder outcomes",
+                ).inc(outcome=outcome)
+
+    def _preempt_one(self, pod, prio_of, classes, state, templates,
+                     its, daemon_overhead, pdb_limits, taken, max_confirms,
+                     errors, report) -> str:
+        if preemption_policy_of(pod, classes) == "Never":
+            decisions.record_decision("admission.preempt", "skipped",
+                                      "policy-never",
+                                      registry=self.registry)
+            return "skipped"
+        cands = _preempt.victim_sets(pod, state.enodes, prio_of, classes,
+                                     pdb_limits, taken)
+        if not cands:
+            decisions.record_decision("admission.preempt", "skipped",
+                                      "no-victims", registry=self.registry)
+            return "skipped"
+        probe_error = False
+        try:
+            feas = _preempt.probe_feasible(pod, cands, templates, its,
+                                           daemon_overhead=daemon_overhead)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "preemption probe failed; confirming sequentially",
+                exc_info=True)
+            # no verdict yet: the ladder records exactly ONE per examined
+            # preemptor — the probe-error cause rides a declining verdict
+            # below; a confirm that still lands records confirmed/ok
+            probe_error = True
+            feas = None
+        # probe misses stay misses (seeds are trusted negative only up to
+        # the bounded confirm budget below); inexpressible probes confirm
+        # the cheapest candidates directly — the reference-cost path
+        ordered = (
+            [c for c, ok in zip(cands, feas) if ok]
+            if feas is not None else list(cands)
+        )
+        if not ordered:
+            decisions.record_decision(
+                "admission.preempt", "declined",
+                "probe-error" if probe_error else "no-feasible-node",
+                registry=self.registry)
+            report["preempt_declined"] += 1
+            return "declined"
+        confirmed = None
+        for cand in ordered[:max_confirms]:
+            trimmed = _preempt.trim_and_confirm(pod, cand, state.topology)
+            if trimmed is not None:
+                confirmed = trimmed
+                break
+            report["preempt_unconfirmed"] += 1
+        if confirmed is None:
+            decisions.record_decision(
+                "admission.preempt", "declined",
+                "probe-error" if probe_error else "confirm-failed",
+                registry=self.registry)
+            report["preempt_declined"] += 1
+            return "declined"
+        evicted, complete = _preempt.execute_evictions(
+            self.store, confirmed, pod, recorder=self.recorder,
+            registry=self.registry)
+        report["evictions"] += evicted
+        if not complete:
+            # a PDB that closed mid-set: whatever shipped stays shipped
+            # (its capacity returns to the pool) but the preemptor is NOT
+            # nominated and keeps its scheduling error for the next round
+            decisions.record_decision("admission.preempt", "declined",
+                                      "pdb-blocked", registry=self.registry)
+            report["preempt_declined"] += 1
+            return "declined"
+        taken.add(confirmed.node_name)
+        report["preemptions"] += 1
+        # the preemptor is nominated, not failed: drop its error so the
+        # round doesn't publish FailedScheduling for a pod mid-preemption
+        errors.pop(pod.key(), None)
+        decisions.record_decision("admission.preempt", "confirmed", "ok",
+                                  registry=self.registry)
+        return "confirmed"

@@ -102,9 +102,50 @@ def validate_nodepool_admission(np) -> list[str]:
     return errs
 
 
+# PriorityClass admission (the scheduling.k8s.io validation rules): user
+# classes live in [-HIGHEST_USER_DEFINABLE, HIGHEST_USER_DEFINABLE]; only
+# system- prefixed classes may sit in the positive system-reserved band,
+# and the NEGATIVE mirror of that band is reserved-and-unusable for
+# everyone (admission/priority.py resolves through the same constants).
+HIGHEST_USER_DEFINABLE_PRIORITY = 1_000_000_000
+SYSTEM_CLASS_PREFIX = "system-"
+VALID_PREEMPTION_POLICIES = {"", "PreemptLowerPriority", "Never"}
+
+
+def validate_priority_class_admission(pc) -> list[str]:
+    errs = []
+    value = getattr(pc, "value", 0)
+    if not isinstance(value, int) or isinstance(value, bool):
+        errs.append(f"value: {value!r} is not an integer")
+        return errs
+    name = pc.metadata.name or ""
+    if value < -HIGHEST_USER_DEFINABLE_PRIORITY:
+        # the negative system-reserved range: no class — system or user —
+        # may claim it (there is nothing below user priorities to reserve)
+        errs.append(
+            f"value: {value} is below -{HIGHEST_USER_DEFINABLE_PRIORITY} "
+            "(negative system-reserved range)"
+        )
+    elif value > HIGHEST_USER_DEFINABLE_PRIORITY and not name.startswith(
+        SYSTEM_CLASS_PREFIX
+    ):
+        errs.append(
+            f"value: {value} exceeds {HIGHEST_USER_DEFINABLE_PRIORITY} "
+            f"(system-reserved; only {SYSTEM_CLASS_PREFIX}* classes may use it)"
+        )
+    policy = getattr(pc, "preemption_policy", "")
+    if policy not in VALID_PREEMPTION_POLICIES:
+        errs.append(f"preemptionPolicy: invalid {policy!r}")
+    return errs
+
+
 def admit(kind: str, obj):
     """Store admission hook: raise AdmissionError on an illegal spec."""
     if kind == "nodepools":
         errs = validate_nodepool_admission(obj)
+        if errs:
+            raise AdmissionError("; ".join(errs))
+    elif kind == "priorityclasses":
+        errs = validate_priority_class_admission(obj)
         if errs:
             raise AdmissionError("; ".join(errs))

@@ -38,6 +38,14 @@ NODEPOOL_HASH_VERSION_ANNOTATION = f"{GROUP}/nodepool-hash-version"
 NODEPOOL_HASH_VERSION = "v2"  # current static-hash protocol version
 MANAGED_BY_ANNOTATION = f"{GROUP}/managed-by"
 
+# gang (all-or-nothing pod-group) admission — karpenter_tpu/admission/gangs.py
+POD_GROUP_ANNOTATION = f"{GROUP}/pod-group"
+POD_GROUP_MIN_ANNOTATION = f"{GROUP}/pod-group-min-member"
+POD_GROUP_TOPOLOGY_ANNOTATION = f"{GROUP}/pod-group-topology"
+# solve-internal label stamped on gang CLONES so the injected co-location
+# pod-affinity term has a selector to match (never written to the store)
+POD_GROUP_LABEL = f"{GROUP}/pod-group"
+
 # finalizers
 TERMINATION_FINALIZER = f"{GROUP}/termination"
 

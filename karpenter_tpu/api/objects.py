@@ -354,6 +354,25 @@ class NodeClass:
 
 
 @dataclass
+class PriorityClass:
+    """scheduling.k8s.io PriorityClass: the value a pod's
+    ``priority_class_name`` resolves to (admission/priority.py owns the
+    resolution matrix). ``global_default`` marks the class applied to pods
+    that name no class; ``preemption_policy`` ("" = PreemptLowerPriority)
+    rides onto pods resolved through the class."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = ""  # "" (PreemptLowerPriority) | "Never"
+    description: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: LabelSelector = field(default_factory=LabelSelector)

@@ -141,6 +141,7 @@ class Provisioner:
         self.recorder = recorder
         self._change_monitor = ChangeMonitor(clock=self.clock)
         self.cluster = cluster  # state plane (M4); optional
+        self._admission = None  # admission plane (priority/gang), lazy
 
     # -- triggering (provisioning/controller.go:52-107) ------------------
     def on_event(self, event):
@@ -288,26 +289,53 @@ class Provisioner:
                 existing_nodes = [en.fork(topology) for en in enodes_base]
             else:
                 existing_nodes = self._existing_nodes(state_nodes, topology)
-        results = self.solver.solve(
-            pods,
-            templates,
-            its_by_pool,
-            topology=topology,
-            existing_nodes=existing_nodes,
-            daemon_overhead=overhead,
-            limits=limits or None,
-            volume_topology=vt,
-            existing_base=existing_base,
-        )
+        # live batches with admission markers (pod priorities, gang
+        # annotations, a tiering default class) route through the
+        # admission plane — the tiered cascade, gang atomicity, and the
+        # preemption ladder (karpenter_tpu/admission). Disruption
+        # counterfactuals and marker-free batches keep the single solve.
+        plane = self.admission_plane() if live_batch else None
+        if plane is not None and plane.engages(pods):
+            with obs.span("provision.admission", pods=len(pods)):
+                results = plane.solve_round(
+                    self.solver,
+                    pods,
+                    templates,
+                    its_by_pool,
+                    topology=topology,
+                    existing_nodes=existing_nodes,
+                    daemon_overhead=overhead,
+                    limits=limits or None,
+                    volume_topology=vt,
+                )
+        else:
+            results = self.solver.solve(
+                pods,
+                templates,
+                its_by_pool,
+                topology=topology,
+                existing_nodes=existing_nodes,
+                daemon_overhead=overhead,
+                limits=limits or None,
+                volume_topology=vt,
+                existing_base=existing_base,
+            )
         # host-routed accounting (live batches only — disruption
         # counterfactuals must not inflate the counter, helpers.go:84
         # stance): pods the device compiler handed to the host engine,
         # by reason, so a grid regression is attributable from the scrape
         if live_batch:
-            routed = getattr(
-                self.solver, "last_device_stats", None
-            ) or {}
-            routed = routed.get("host_routed") or {}
+            # admission rounds aggregate host-routed reasons across the
+            # whole cascade (the solver's last_device_stats only reflects
+            # its final inner call); plain rounds read the solver directly
+            adm = getattr(results, "admission", None)
+            if adm is not None:
+                routed = adm.get("host_routed") or {}
+            else:
+                routed = getattr(
+                    self.solver, "last_device_stats", None
+                ) or {}
+                routed = routed.get("host_routed") or {}
             if routed:
                 ctr = self.registry.counter(
                     m.PROVISIONING_HOST_ROUTED,
@@ -337,6 +365,18 @@ class Provisioner:
                     )
         results.truncate_instance_types()
         return results
+
+    def admission_plane(self):
+        """The admission plane (priority tiers / gangs / preemption),
+        built lazily — marker-free fleets never pay the import."""
+        if self._admission is None:
+            from karpenter_tpu.admission import AdmissionPlane
+
+            self._admission = AdmissionPlane(
+                self.store, registry=self.registry, recorder=self.recorder,
+                log=self.log,
+            )
+        return self._admission
 
     def solver_inputs(self):
         """Per-nodepool solver inputs: (templates, instance types by pool,

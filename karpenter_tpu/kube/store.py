@@ -73,6 +73,7 @@ KINDS = (
     "leases",
     "events",
     "nodeclasses",
+    "priorityclasses",
 )
 
 _NAMESPACED = {"pods", "daemonsets", "deployments", "pdbs", "pvcs", "leases", "events"}

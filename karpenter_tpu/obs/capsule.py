@@ -98,7 +98,8 @@ OUT_PREFIX = "out//"
 # kernel args; the prefix keeps them from colliding with snapshot names
 CF_PREFIX = "cf//"
 
-SEAMS = ("solver.invoke", "mesh.solve", "probe.dispatch", "service.solve")
+SEAMS = ("solver.invoke", "mesh.solve", "probe.dispatch", "service.solve",
+         "preempt.dispatch")
 
 # knobs from the captured env snapshot that replay re-applies around the
 # mesh rungs: they decide whether/how the snapshot partitions, so a dev
@@ -451,7 +452,7 @@ class _applied_env:
 def _captured_rung(cap: Capsule) -> str:
     """The replayable rung the capture actually ran."""
     engine = cap.engine
-    if cap.seam == "probe.dispatch":
+    if cap.seam in ("probe.dispatch", "preempt.dispatch"):
         return "native" if engine == "native" else "device"
     if cap.seam == "mesh.solve":
         return {"partitioned": "partitioned",
@@ -567,16 +568,28 @@ def _run_probe(cap: Capsule, engine: str) -> dict:
     Gp = int(cap.static("Gp"))
     Ep = int(cap.static("Ep"))
     max_minv = int(cap.static("max_minv", 0))
+    e_free = None
+    if cap.seam == "preempt.dispatch":
+        # the preemption counterfactual's per-row capacity releases:
+        # (col, delta[R]) pairs flattened into two sidecars, -1 = None
+        cols = np.asarray(cap.sidecar("e_free_col"))
+        deltas = np.asarray(cap.sidecar("e_free_delta"))
+        e_free = [
+            None if int(c) < 0 else (int(c), deltas[i])
+            for i, c in enumerate(cols.tolist())
+        ]
     if engine == "native":
         from karpenter_tpu import native
 
         if not native.available():
             raise ReplayError("native engine unavailable on this host")
         placed_g, used = _cons.dispatch_counterfactual_rows_native(
-            shared, Gp, Ep, e_avail, max_minv, g_count_k, e_zero_cols)
+            shared, Gp, Ep, e_avail, max_minv, g_count_k, e_zero_cols,
+            e_free=e_free)
     else:
         placed_g, used = _cons.dispatch_counterfactual_rows(
-            shared, Gp, Ep, e_avail, max_minv, g_count_k, e_zero_cols)
+            shared, Gp, Ep, e_avail, max_minv, g_count_k, e_zero_cols,
+            e_free=e_free)
     return {"placed_g": placed_g, "used": used}
 
 
@@ -738,7 +751,7 @@ _PROBE_RUNGS = ("device", "native")
 
 
 def _execute(cap: Capsule, rung: str) -> dict:
-    if cap.seam == "probe.dispatch":
+    if cap.seam in ("probe.dispatch", "preempt.dispatch"):
         return _run_probe(cap, rung)
     return {
         "partitioned": _run_partitioned,
@@ -812,7 +825,9 @@ def ab_compare(cap: Capsule) -> list:
     parity vs the captured outputs, node count, wall clock, and the
     decision diff vs the captured rung. Ineligible/failed rungs report
     why instead of silently vanishing (the no-silent-caps stance)."""
-    rungs = _PROBE_RUNGS if cap.seam == "probe.dispatch" else _SOLVE_RUNGS
+    rungs = (_PROBE_RUNGS
+             if cap.seam in ("probe.dispatch", "preempt.dispatch")
+             else _SOLVE_RUNGS)
     rows = []
     for rung in rungs:
         try:

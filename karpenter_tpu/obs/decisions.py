@@ -20,6 +20,9 @@ site                    rungs (best first)                 recorded by
 ``solver.route``        mesh, native, xla, service, host   ``models/solver.py TPUSolver.solve``
 ``session.sync``        delta, resync                      ``service/solver_service.py`` (both ends)
 ``decode.recheck``      skip, full                         ``models/solver.py _compat_entry``
+``admission.tier``      cascade, single                    ``admission/plane.py solve_round``
+``admission.preempt``   confirmed, declined, skipped       ``admission/preempt.py``
+``admission.gang``      atomic, routed                     ``admission/plane.py _solve_gang``
 ======================  =================================  =========================================
 
 Reasons are drawn from a CLOSED enum per site (``SITES[site]["reasons"]``)
@@ -178,6 +181,48 @@ SITES = {
             "ok", "no-candidates", "disabled", "offering-keys",
             "group-key-overlap", "non-decomposable", OTHER_REASON,
         }),
+    },
+    "admission.tier": {
+        # admission/plane.py: a live batch with priority markers ran the
+        # tiered cascade, or collapsed to the plain single solve. The tier
+        # count is workload-driven, so every reason is benign — the site
+        # exists for the mix, not the regression detector.
+        "rungs": ("cascade", "single"),
+        "reasons": frozenset({
+            "ok", "single-tier", "disabled", OTHER_REASON,
+        }),
+        "benign": frozenset({"ok", "single-tier", "disabled", OTHER_REASON}),
+    },
+    "admission.preempt": {
+        # admission/preempt.py: one verdict per unschedulable high-tier pod
+        # the preemption ladder examined — evictions shipped after a
+        # confirming simulation, declined (probe/confirm said no), or
+        # skipped before any counterfactual ran. Workload-driven declines
+        # are benign; confirm-failed (probe-vs-host disagreement) and
+        # probe-error stay armed.
+        "rungs": ("confirmed", "declined", "skipped"),
+        "reasons": frozenset({
+            "ok", "no-victims", "policy-never", "no-feasible-node",
+            "confirm-failed", "pdb-blocked", "ineligible-spec", "disabled",
+            "probe-error", OTHER_REASON,
+        }),
+        "benign": frozenset({
+            "no-victims", "policy-never", "no-feasible-node",
+            "ineligible-spec", "disabled",
+        }),
+    },
+    "admission.gang": {
+        # admission/gangs.py via plane._solve_gang: one verdict per gang —
+        # the whole group landed atomically, or the whole group
+        # host-routed with a cause (never a partial bind). Capacity-driven
+        # routes are benign; trial-error (the commit diverged from its
+        # trial) stays armed.
+        "rungs": ("atomic", "routed"),
+        "reasons": frozenset({
+            "ok", "infeasible", "budget-starved", "oversize", "trial-error",
+            OTHER_REASON,
+        }),
+        "benign": frozenset({"infeasible", "budget-starved", "oversize"}),
     },
 }
 

@@ -238,6 +238,11 @@ TENSORIZE_NEGATIVE_AVAIL = f"{NAMESPACE}_tensorize_negative_avail_total"
 # path, by reason label (waves compiler inexpressibles, spec ineligibility,
 # small-batch cutoff) — a grid regression shows up here as a reason spike
 PROVISIONING_HOST_ROUTED = f"{NAMESPACE}_provisioning_host_routed_pods_total"
+# admission plane (karpenter_tpu/admission): victim pods evicted by a
+# confirmed preemption, and preemption ladder outcomes by outcome label
+# (the per-rung mix also rides karpenter_decision_total{site="admission.*"})
+ADMISSION_EVICTIONS = f"{NAMESPACE}_admission_preemption_evictions_total"
+ADMISSION_PREEMPTIONS = f"{NAMESPACE}_admission_preemptions_total"
 # counterfactual-rows-per-dispatch buckets (powers of two up to the probe's
 # chunk cap) — durations make no sense for a size histogram
 PROBE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)

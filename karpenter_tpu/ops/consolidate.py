@@ -662,13 +662,20 @@ class DisruptionSnapshot:
 
 
 def dispatch_counterfactual_rows(shared, Gp, Ep, e_avail, max_minv,
-                                 g_count_k, e_zero_cols):
+                                 g_count_k, e_zero_cols, e_free=None):
     """The XLA probe dispatch over EXPLICIT tensors: chunked at
     PROBE_CHUNK_ROWS, the chunk axis padded on the pow-2 ladder, each
     chunk one vmapped device call. ONE body shared by
-    ``DisruptionSnapshot.dispatch`` and the replay capsule's offline probe
-    replay (obs/capsule.py) — sharing the code is what makes the replay
-    bit-exact by construction instead of by re-implementation."""
+    ``DisruptionSnapshot.dispatch``, the preemption counterfactual
+    (admission/preempt.py), and the replay capsule's offline probe replay
+    (obs/capsule.py) — sharing the code is what makes the replay bit-exact
+    by construction instead of by re-implementation.
+
+    ``e_free`` (optional, len == rows) carries per-row capacity RELEASES:
+    ``None`` or ``(col, delta[R])`` meaning row i sees ``e_avail[col]``
+    grown by ``delta`` — the preemption counterfactual's "these victims
+    are evicted" row shape, applied after the zeroed columns so the two
+    edits compose the same way on every engine."""
     R = e_avail.shape[1]
     rows = g_count_k.shape[0]
     placed_g = np.empty((rows, Gp), dtype=np.int64)
@@ -682,6 +689,10 @@ def dispatch_counterfactual_rows(shared, Gp, Ep, e_avail, max_minv,
             cols = e_zero_cols[lo + i]
             if cols is not None and len(cols):
                 e_chunk[i, cols, :] = 0.0
+            fr = e_free[lo + i] if e_free is not None else None
+            if fr is not None:
+                e_chunk[i, int(fr[0]), :] += np.asarray(
+                    fr[1], dtype=e_chunk.dtype)
         varying = dict(
             g_count=pad(g_count_k[lo:hi], (Np, Gp)),
             e_avail=pad(e_chunk, (Np, Ep, R)),
@@ -711,11 +722,12 @@ def dispatch_counterfactual_rows(shared, Gp, Ep, e_avail, max_minv,
 
 
 def dispatch_counterfactual_rows_native(shared, Gp, Ep, e_avail, max_minv,
-                                        g_count_k, e_zero_cols):
+                                        g_count_k, e_zero_cols, e_free=None):
     """The native-engine half of :func:`dispatch_counterfactual_rows` —
-    same chunking, same counterfactual materialization, the C++ batched
-    probe entry per chunk. ``max_minv`` rides only for capture symmetry
-    (the native entry reads m_minv from the arg dict itself)."""
+    same chunking, same counterfactual materialization (zeroed columns,
+    then per-row ``e_free`` releases), the C++ batched probe entry per
+    chunk. ``max_minv`` rides only for capture symmetry (the native entry
+    reads m_minv from the arg dict itself)."""
     from karpenter_tpu import native
 
     R = e_avail.shape[1]
@@ -730,6 +742,10 @@ def dispatch_counterfactual_rows_native(shared, Gp, Ep, e_avail, max_minv,
             cols = e_zero_cols[lo + i]
             if cols is not None and len(cols):
                 e_chunk[i, cols, :] = 0.0
+            fr = e_free[lo + i] if e_free is not None else None
+            if fr is not None:
+                e_chunk[i, int(fr[0]), :] += np.asarray(
+                    fr[1], dtype=e_chunk.dtype)
         with obs.span("probe.native", kind="device", rows=n):
             pg, u = native.solve_probe_batch(
                 shared,

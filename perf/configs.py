@@ -223,6 +223,100 @@ def diverse_pods(count: int, seed: int = 42):
     return pods
 
 
+def priority_mix(n_pods=5000, n_types=100, seed=7):
+    """The ``priority-mix`` admission grid family (ISSUE 12): a seeded
+    burst spread over three priority tiers (system-ish high, batch mid,
+    best-effort zero) with the reference benchmark's size menus, plus a
+    light selector mix so the tiers don't collapse into one signature.
+    Returns (pods, pools, catalog)."""
+    import random
+
+    r = random.Random(seed)
+    catalog = benchmark_catalog(n_types)
+    pools = [_pool()]
+    CPUS = (0.25, 0.5, 1.0, 2.0)
+    MEMS = (0.5, 1.0, 2.0, 4.0)
+    TIERS = (8000, 1000, 0)
+    pods = []
+    for i in range(n_pods):
+        p = _pod(f"pr{i}", r.choice(CPUS), r.choice(MEMS))
+        p.priority = r.choice(TIERS)
+        if r.random() < 0.25:
+            p.node_selector = {wk.ARCH_LABEL: r.choice(("amd64", "arm64"))}
+        pods.append(p)
+    return pods, pools, catalog
+
+
+def gang_mix(n_pods=3000, n_types=100, seed=11, n_gangs=20):
+    """The ``gang-mix`` admission grid family: loose pods plus
+    annotation-keyed pod-groups of 4-16 members (half zone-co-located
+    through the topology overlay), one deliberately starved group
+    (min-member above the members present) to exercise the all-or-nothing
+    route path. Returns (pods, pools, catalog)."""
+    import random
+
+    r = random.Random(seed)
+    catalog = benchmark_catalog(n_types, zones=("zone-1", "zone-2", "zone-3"))
+    pools = [_pool()]
+    pods = []
+    for i in range(n_pods - n_gangs * 8):
+        p = _pod(f"l{i}", r.choice((0.25, 0.5, 1.0)), r.choice((1.0, 2.0)))
+        p.priority = r.choice((0, 1000))
+        pods.append(p)
+    for g in range(n_gangs):
+        size = r.choice((4, 8, 12, 16))
+        annotations = {wk.POD_GROUP_ANNOTATION: f"gang-{g}"}
+        if g % 2 == 0:
+            annotations[wk.POD_GROUP_TOPOLOGY_ANNOTATION] = (
+                wk.TOPOLOGY_ZONE_LABEL)
+        if g == n_gangs - 1:
+            # starved: demands more members than the batch carries — must
+            # route whole (the all-or-nothing acceptance case)
+            annotations[wk.POD_GROUP_MIN_ANNOTATION] = str(size + 8)
+        for i in range(size):
+            p = Pod(
+                metadata=ObjectMeta(name=f"g{g}-{i}",
+                                    annotations=dict(annotations)),
+                requests={"cpu": 2.0, "memory": 4.0 * GIB},
+            )
+            p.priority = 1000
+            pods.append(p)
+    return pods, pools, catalog
+
+
+def preempt_env(n_nodes=8):
+    """The ``preempt-mix`` admission scenario: a limit-capped fleet filled
+    by low-priority replicas, then a high-priority burst that can ONLY
+    land by evicting — the preemption ladder's end-to-end surface.
+    Returns the Environment with the low tier already bound (the caller
+    injects the high tier and drives to idle)."""
+    from karpenter_tpu.api.objects import Deployment, PriorityClass
+    from karpenter_tpu.operator import Environment
+
+    catalog = [make_instance_type("xl", 16, 64)]
+    env = Environment(instance_types=catalog)
+    pool = _pool()
+    pool.spec.limits = {"cpu": str(16 * n_nodes)}
+    env.create("nodepools", pool)
+    env.create(
+        "priorityclasses",
+        PriorityClass(metadata=ObjectMeta(name="high"), value=10000),
+        PriorityClass(metadata=ObjectMeta(name="low"), value=0),
+    )
+    deploys = [
+        Deployment(
+            metadata=ObjectMeta(name=f"low{i}"), replicas=3,
+            template=_pod(f"low{i}-tpl", 5.0, 8.0,
+                          priority_class_name="low"),
+        )
+        for i in range(n_nodes)
+    ]
+    for d in deploys:
+        env.store.create("deployments", d)
+    env.run_until_idle(max_rounds=300)
+    return env
+
+
 def config5_burst_gpu(n_pods=50_000, n_types=500):
     """50k burst with GPU extended resources, mixed on-demand/spot pools."""
     base = benchmark_catalog(n_types - 20)

@@ -25,6 +25,15 @@ Usage:
                                    # — run it in a FRESH interpreter
                                    # (virtual devices must be set before
                                    # jax initializes)
+    python -m perf priority        # the admission grid families (ISSUE
+                                   # 12): priority-mix (tiered cascade vs
+                                   # the tiered-FFD oracle, tier-order
+                                   # check), gang-mix (all-or-nothing
+                                   # pod-groups incl. a starved-budget
+                                   # route), preempt-mix (end-to-end
+                                   # preemption: counterfactual probe →
+                                   # confirm-by-simulation → PDB-gated
+                                   # evictions)
     python -m perf multitenant     # N concurrent synthetic clusters
                                    # (PERF_TENANTS=8) round-robin through
                                    # one solver service: per-tenant
@@ -876,6 +885,158 @@ def run_multitenant(n_tenants: int | None = None, rounds: int | None = None,
             server_proc.kill()
 
 
+def _admission_inputs(pods, pools, catalog):
+    """The same scheduler inputs _solve_timed assembles, for the plane."""
+    from karpenter_tpu.controllers.provisioning.provisioner import (
+        collect_domains,
+    )
+    from karpenter_tpu.models import ClaimTemplate
+    from karpenter_tpu.models.topology import Topology
+
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    fresh = [p.clone() for p in pods]
+    domains: dict = {}
+    for t in templates:
+        collect_domains(domains, t, catalog)
+    return fresh, templates, its, Topology(domains=domains, pods=fresh)
+
+
+def _placed_uids(res) -> set:
+    from karpenter_tpu.admission.oracle import placed_uids
+
+    return placed_uids(res.new_claims, res.existing_nodes)
+
+
+def _tier_order_ok(pods, prio_of, cascade_placed, oracle_placed) -> bool:
+    """The acceptance invariant: no lower-tier pod placed while a FEASIBLE
+    (oracle-placed) higher-tier pod host-routes."""
+    missed_prios = sorted(
+        {prio_of[p.uid] for p in pods
+         if p.uid not in cascade_placed and p.uid in oracle_placed},
+        reverse=True)
+    if not missed_prios:
+        return True
+    worst = missed_prios[0]
+    return not any(
+        prio_of[p.uid] < worst for p in pods if p.uid in cascade_placed)
+
+
+def run_priority(trace: bool = False):
+    """The admission grid families: the tiered cascade (device routing as
+    deployed) against the tiered-FFD host oracle, plus the end-to-end
+    preemption scenario. One JSON row per family; bench.py's --priority
+    sentinel gates tier order, gang atomicity, the ≤2% node-overhead bar,
+    and the confirm-before-execute preemption contract on these rows."""
+    from karpenter_tpu.admission import AdmissionPlane, tiered_ffd_oracle
+    from karpenter_tpu.admission.priority import effective_priorities
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.models.solver import TPUSolver
+    from karpenter_tpu.obs import decisions
+
+    for name, build in (("priority-mix", C.priority_mix),
+                        ("gang-mix", C.gang_mix)):
+        pods, pools, catalog = build()
+        config = f"{name}-{len(pods)}x{len(catalog)}"
+        plane = AdmissionPlane()
+        solver = TPUSolver()
+        # warm the compile families (the oracle needs none)
+        w_pods, w_tpl, w_its, w_topo = _admission_inputs(pods, pools, catalog)
+        plane.solve_round(solver, w_pods, w_tpl, w_its, topology=w_topo)
+        c_pods, c_tpl, c_its, c_topo = _admission_inputs(pods, pools, catalog)
+        dec0 = decisions.counts()
+        t0 = time.perf_counter()
+        res = plane.solve_round(solver, c_pods, c_tpl, c_its,
+                                topology=c_topo)
+        elapsed = time.perf_counter() - t0
+        rungs = decisions.rung_delta(dec0, decisions.counts())
+        o_pods, o_tpl, o_its, o_topo = _admission_inputs(pods, pools, catalog)
+        t1 = time.perf_counter()
+        o_res, o_rep = tiered_ffd_oracle(o_pods, o_tpl, o_its,
+                                         topology=o_topo)
+        oracle_ms = (time.perf_counter() - t1) * 1000.0
+        prio_of = effective_priorities(c_pods)
+        placed = _placed_uids(res)
+        # both runs solve clones of the same pods and Pod.clone preserves
+        # metadata.uid, so the oracle's placed set compares directly
+        o_placed = _placed_uids(o_res)
+        nodes, o_nodes = len(res.new_claims), len(o_res.new_claims)
+        # gang atomicity over the CASCADE result: every group fully
+        # placed or fully routed — a partial bind fails the row
+        partial = 0
+        by_gang: dict = {}
+        for p in c_pods:
+            g = p.metadata.annotations.get(wk.POD_GROUP_ANNOTATION)
+            if g:
+                by_gang.setdefault(g, []).append(p)
+        for members in by_gang.values():
+            n_in = sum(1 for p in members if p.uid in placed)
+            if 0 < n_in < len(members):
+                partial += 1
+        adm = getattr(res, "admission", {}) or {}
+        row = {
+            "config": config,
+            "pods": len(pods),
+            "types": len(catalog),
+            "ms": round(elapsed * 1000, 2),
+            "oracle_ms": round(oracle_ms, 2),
+            "tiers": adm.get("tiers", 0),
+            "nodes": nodes,
+            "oracle_nodes": o_nodes,
+            "node_overhead_pct": round(
+                100.0 * (nodes - o_nodes) / max(o_nodes, 1), 2),
+            "scheduled": len(placed),
+            "oracle_scheduled": len(o_placed),
+            "tier_order_ok": _tier_order_ok(c_pods, prio_of, placed,
+                                            o_placed),
+            "gangs_placed": adm.get("gangs_placed", 0),
+            "gangs_routed": adm.get("gangs_routed", 0),
+            "oracle_gangs_placed": o_rep.get("gangs_placed", 0),
+            "gang_partial_binds": partial,
+            "gang_atomic_ok": partial == 0,
+            "rungs": rungs,
+        }
+        print(json.dumps(row))
+
+    # preempt-mix: the end-to-end eviction surface (Environment-driven)
+    from karpenter_tpu.operator import metrics as m
+
+    n_nodes = int(os.environ.get("PERF_PREEMPT_NODES", "8"))
+    env = C.preempt_env(n_nodes)
+    start_bound = len([p for p in env.store.list("pods") if p.node_name])
+    dec0 = decisions.counts()
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        env.store.create("pods", C._pod(f"hi{i}", 6.0, 4.0,
+                                        priority_class_name="high"))
+    env.run_until_idle(max_rounds=500)
+    elapsed = time.perf_counter() - t0
+    dec = decisions.rung_delta(dec0, decisions.counts())
+    confirmed = int(env.registry.counter(
+        m.ADMISSION_PREEMPTIONS).value(outcome="confirmed"))
+    declined = int(env.registry.counter(
+        m.ADMISSION_PREEMPTIONS).value(outcome="declined"))
+    evictions = int(env.registry.counter(m.ADMISSION_EVICTIONS).total())
+    hi_bound = len([
+        p for p in env.store.list("pods")
+        if p.node_name and p.metadata.name.startswith("hi")])
+    print(json.dumps({
+        "config": f"preempt-mix-{n_nodes}n",
+        "ms": round(elapsed * 1000, 2),
+        "start_bound": start_bound,
+        "hi_pods": n_nodes,
+        "hi_bound": hi_bound,
+        "preemptions_confirmed": confirmed,
+        "preemptions_declined": declined,
+        "evictions": evictions,
+        # the confirm-before-execute contract: evictions ship only from
+        # the confirmed branch, so any eviction without a confirmed
+        # verdict is a contract break — bench gates on this field
+        "confirm_contract_ok": evictions == 0 or confirmed > 0,
+        "rungs": dec,
+    }))
+
+
 def run_grid(min_values: int | None = None, trace: bool = False):
     """The reference benchmark grid: pods x 400 types, diverse 1/6 mix
     (scheduling_benchmark_test.go:77-97, :234-248); its enforced floor is
@@ -918,6 +1079,9 @@ def main():
         return
     if args == ["multichip"]:
         run_multichip(trace=breakdown)
+        return
+    if args == ["priority"]:
+        run_priority(trace=breakdown)
         return
     if args == ["multitenant"]:
         # (no --json trace embedding here: the service runs as its own

@@ -467,3 +467,80 @@ class TestMultichipSentinelLeg:
             ("multichip-512x512", 400.0),
             ("multichip-500000x1000", 58000.0),
         ]
+
+
+class TestPrioritySentinelLeg:
+    """bench.py's --priority leg: the four ISSUE-12 hard gates (tier
+    order, gang atomicity incl. the starved-budget route, the 2% oracle
+    bar, confirm-before-execute) plus the standard ms regression pairs
+    against committed baselines."""
+
+    def _rows(self, **overrides):
+        rows = {
+            "priority-mix-5000x100": {
+                "config": "priority-mix-5000x100", "ms": 100.0,
+                "tier_order_ok": True, "gang_atomic_ok": True,
+                "node_overhead_pct": 0.0},
+            "gang-mix-3024x100": {
+                "config": "gang-mix-3024x100", "ms": 90.0,
+                "tier_order_ok": True, "gang_atomic_ok": True,
+                "gangs_routed": 1, "node_overhead_pct": 1.0},
+            "preempt-mix-8n": {
+                "config": "preempt-mix-8n", "ms": 500.0,
+                "confirm_contract_ok": True, "preemptions_confirmed": 8},
+        }
+        for cfg, kv in overrides.items():
+            rows[cfg].update(kv)
+        return rows
+
+    def _run(self, monkeypatch, rows, baseline=None):
+        import bench
+
+        monkeypatch.setattr(bench, "_fresh_perf_rows",
+                            lambda args, env=None: rows)
+        monkeypatch.setattr(bench, "_perf_baseline_rows",
+                            lambda: baseline or {})
+        return bench._priority_pairs()
+
+    def test_clean_run_pairs_against_baseline(self, monkeypatch):
+        pairs, problems = self._run(
+            monkeypatch, self._rows(),
+            baseline={"priority-mix-5000x100": {"ms": 95.0}})
+        assert problems == []
+        assert pairs == [("priority-mix-5000x100", 95.0, 100.0)]
+
+    def test_tier_order_violation_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(monkeypatch, self._rows(**{
+            "priority-mix-5000x100": {"tier_order_ok": False}}))
+        assert any("tier order" in p for p in problems)
+
+    def test_partial_gang_bind_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(monkeypatch, self._rows(**{
+            "gang-mix-3024x100": {"gang_atomic_ok": False,
+                                  "gang_partial_binds": 2}}))
+        assert any("all-or-nothing" in p for p in problems)
+
+    def test_unexercised_starved_route_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(monkeypatch, self._rows(**{
+            "gang-mix-3024x100": {"gangs_routed": 0}}))
+        assert any("starved-budget" in p for p in problems)
+
+    def test_node_overhead_over_2pct_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(monkeypatch, self._rows(**{
+            "priority-mix-5000x100": {"node_overhead_pct": 3.5}}))
+        assert any("node overhead" in p for p in problems)
+
+    def test_unconfirmed_eviction_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(monkeypatch, self._rows(**{
+            "preempt-mix-8n": {"confirm_contract_ok": False}}))
+        assert any("confirming simulation" in p for p in problems)
+
+    def test_missing_family_fails_loudly(self, monkeypatch):
+        rows = self._rows()
+        del rows["preempt-mix-8n"]
+        _, problems = self._run(monkeypatch, rows)
+        assert any("missing" in p for p in problems)
+
+    def test_empty_run_fails_loudly(self, monkeypatch):
+        _, problems = self._run(monkeypatch, {})
+        assert any("no rows" in p for p in problems)

@@ -961,6 +961,70 @@ class TestCapsuleRules:
         assert findings == []
 
 
+class TestAdmissionHookSpecs:
+    """ISSUE-12 spec extension: the ADMISSION plane's ledger and capsule
+    hooks ride the same GL404/GL405 reachability pass — an
+    `admission.*`-site verdict or a `preempt.dispatch` capture that
+    becomes jit-reachable must flag, and the production pattern (decide
+    host-side around the dispatch) must stay quiet."""
+
+    def test_positive_admission_site_verdict_in_jitted_function(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import decisions\n"
+            "\n"
+            "def kernel(x):\n"
+            "    decisions.record_decision('admission.tier', 'cascade')\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL404"]
+
+    def test_positive_preempt_capture_reached_through_call_edge(self):
+        findings, _ = analyze_sources({
+            "pkg.a": (
+                "import jax\n"
+                "from pkg.b import probe_row\n"
+                "\n"
+                "def entry(x):\n"
+                "    return probe_row(x)\n"
+                "\n"
+                "fn = jax.jit(entry)\n"
+            ),
+            "pkg.b": (
+                "from karpenter_tpu.obs import capsule\n"
+                "\n"
+                "def probe_row(t):\n"
+                "    capsule.record_capture('preempt.dispatch', {}, "
+                "{'used': t})\n"
+                "    return t\n"
+            ),
+        })
+        assert rules_of(findings) == ["GL405"]
+
+    def test_negative_host_side_preempt_ladder_not_flagged(self):
+        """The production shape (admission/preempt.py): the jitted probe
+        dispatches inside, verdict and capture recorded host-side after
+        the pull."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "import numpy as np\n"
+            "from karpenter_tpu.obs import capsule, decisions\n"
+            "\n"
+            "fn = jax.jit(lambda a: a)\n"
+            "\n"
+            "def probe(args):\n"
+            "    out = np.asarray(fn(args))\n"
+            "    capsule.record_capture('preempt.dispatch', args, "
+            "{'placed_g': out})\n"
+            "    decisions.record_decision('admission.preempt', "
+            "'confirmed')\n"
+            "    return out\n"
+        )})
+        assert findings == []
+
+
 # ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
