@@ -26,7 +26,12 @@ benchmark line prints, the fresh headline is compared against the newest
 committed BENCH_r*.json (same-engine records only — a CPU-ladder rescue
 is an environment event, not a regression) and, under `--consolidation`,
 a fresh `python -m perf --json 4` run is compared against the newest
-PERF_r*.json consolidation row. `--multitenant` adds the multi-tenant
+PERF_r*.json consolidation row, and a fresh `python -m perf global` run
+must hold the ISSUE-13 global-consolidation acceptance as a HARD gate:
+the joint 2000-node convergence inside its wall-clock budget
+(PERF_GLOBAL_BUDGET_MS, default 10 s), end cost ≤ the per-candidate
+ladder oracle's on an identical fleet, and exactly one confirming
+simulation per executed joint command — exit 3 on any violation. `--multitenant` adds the multi-tenant
 fleet leg the same way: a fresh `python -m perf multitenant` run vs the
 newest committed multitenant row, on BOTH total wall clock and the
 concurrent worst-tenant p99 (baseline-gated — no committed row, no fresh
@@ -619,6 +624,45 @@ def _priority_pairs():
     return pairs, problems
 
 
+def _global_pairs():
+    """(sentinel pairs, hard-gate problems) for the global-consolidation
+    leg (rides `--consolidation`): one fresh `python -m perf global` run
+    must hold the ISSUE-13 acceptance — the joint 2000-node convergence
+    inside its wall-clock budget (PERF_GLOBAL_BUDGET_MS, default 10 s),
+    end-state cost ≤ the per-candidate ladder oracle's on the identical
+    fleet, and exactly one confirming simulation per executed joint
+    command. Regression pairs compare the joint total_ms against the
+    newest committed PERF_r*.json row of the same config."""
+    fresh = _fresh_perf_rows(["global"])
+    problems, pairs = [], []
+    row = next((r for r in fresh.values()
+                if r.get("config", "").endswith("-global")), None)
+    if row is None:
+        problems.append(
+            "global: no row produced — the joint-consolidation gate was "
+            "never evaluated")
+        return pairs, problems
+    cfg = row["config"]
+    if row.get("within_budget_ms") is False:
+        problems.append(
+            f"global: {cfg} joint convergence {row.get('total_ms')}ms "
+            "exceeded the wall-clock budget (PERF_GLOBAL_BUDGET_MS)")
+    if row.get("cost_le_ladder") is False:
+        problems.append(
+            f"global: {cfg} joint end cost {row.get('end_cost')} exceeds "
+            f"the ladder oracle's {(row.get('ladder') or {}).get('end_cost')}"
+            " — the joint selection shipped a worse end state")
+    if row.get("confirm_contract_ok") is False:
+        problems.append(
+            f"global: {cfg} ran {row.get('confirm_count')} confirming "
+            f"simulations for {row.get('joint_commands')} joint "
+            "command(s) — the one-confirm-per-command contract broke")
+    base = _perf_baseline_rows().get(cfg)
+    if base is not None and "total_ms" in base and "total_ms" in row:
+        pairs.append((cfg, float(base["total_ms"]), float(row["total_ms"])))
+    return pairs, problems
+
+
 def _multitenant_pairs() -> list:
     """Sentinel pairs for the multi-tenant fleet row: wall clock AND the
     concurrent worst-tenant p99 (a queueing/coalescing regression shows
@@ -860,6 +904,18 @@ def sentinel(record: dict, consolidation: bool = False,
             for cfg, ms in _fresh_consolidation().items():
                 if cfg in base_c:
                     pairs.append((cfg, base_c[cfg], ms))
+        # the global-consolidation leg is a HARD gate (like --priority):
+        # the joint 2k-node acceptance must hold on every gated run, not
+        # only when a committed baseline row exists
+        g_pairs, g_problems = _global_pairs()
+        pairs.extend(g_pairs)
+        if g_problems:
+            print("bench: global consolidation gate failed "
+                  "(KARPENTER_BENCH_SENTINEL=0 to disable):",
+                  file=sys.stderr)
+            for p in g_problems:
+                print(f"bench:   {p}", file=sys.stderr)
+            return 3
     if multitenant:
         pairs.extend(_multitenant_pairs())
     if multichip:

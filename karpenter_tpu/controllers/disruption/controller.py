@@ -23,6 +23,7 @@ from karpenter_tpu.controllers.disruption.methods import (
     Drift,
     Emptiness,
     EmptyNodeConsolidation,
+    GlobalConsolidation,
     MultiNodeConsolidation,
     SingleNodeConsolidation,
 )
@@ -90,6 +91,12 @@ class DisruptionController:
             Drift(self.ctx),
             Emptiness(self.ctx),
             EmptyNodeConsolidation(self.ctx),
+            # the joint device-solved retirement runs FIRST among the
+            # underutilized methods: when it ships, the per-candidate
+            # ladder below never runs (first success wins); every fallback
+            # cause hands the round to the ladder, its oracle duty
+            # (deploy/README.md "Global consolidation")
+            GlobalConsolidation(self.ctx),
             MultiNodeConsolidation(self.ctx),
             SingleNodeConsolidation(self.ctx),
         ]

@@ -239,6 +239,34 @@ def compute_consolidation(ctx, candidates) -> Command | None:
     return Command(candidates, replacements=[replacement], reason=REASON_UNDERUTILIZED)
 
 
+def confirm_consolidation(ctx, cands, method_label, **span_attrs):
+    """ONE real simulation of a candidate set, with the same-type price
+    filter applied to any replacement — the confirm contract shared
+    verbatim by the MultiNode prefix and the global joint set (ONE copy,
+    so the "identical confirm contract" guarantee cannot drift; the
+    unknown-price stance rides inside: candidate_prices aborting the
+    replace path in compute_consolidation keeps commands delete-only
+    whenever a candidate cannot be priced). None = the set fails."""
+    from karpenter_tpu.operator import metrics as m
+
+    ctx.registry.counter(
+        m.DISRUPTION_HOST_CONFIRMS,
+        "confirming host simulations run by consolidation methods",
+    ).inc(method=method_label)
+    with obs.span("confirm.simulate", method=method_label, **span_attrs), \
+            ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
+                                 method=method_label):
+        cmd = compute_consolidation(ctx, cands)
+    if cmd is None or cmd.action == "no-op":
+        return None
+    if cmd.action == "replace":
+        kept = filter_out_same_type(cmd.replacements[0], cands)
+        if not kept:
+            return None
+        cmd.replacements[0].instance_types = kept
+    return cmd
+
+
 def filter_out_same_type(replacement, candidates) -> list:
     """Price-sanity filter for m→1 replacements
     (multinodeconsolidation.go:181-215): when the replacement's instance-type
@@ -286,16 +314,39 @@ def filter_out_same_type(replacement, candidates) -> list:
     return kept
 
 
-def _device_probe(ctx, probe_fn, method_label, cands, pool):
-    """Shared probe runner for both consolidation methods: the TPUSolver
-    gate, the exception fallback, and the batch-size histogram. Falling
-    back to the sequential search is by design (the probes are
+def _probe_failure(ctx, method_label, site):
+    """ONE copy of the probe-failure diagnosis (counter + anomaly +
+    sequential verdict), shared by the per-candidate probes and the
+    global joint solve so the two rungs cannot drift on how a dying
+    probe is diagnosed. Falling back is by design (the probes are
     prefilters), but the reason must stay diagnosable — a permanently-
     failing probe silently costs every consolidation round its batched
-    dispatch. The counter makes it visible on the scrape; the WARNING
-    carries the traceback (stdlib logging is never configured here, and
-    only WARNING+ reaches the lastResort stderr handler — the
-    models/solver.py precedent)."""
+    dispatch; the counter makes it visible on the scrape. Callers keep
+    their WARNING (with the traceback) inline in the except handler —
+    stdlib logging is never configured here, only WARNING+ reaches the
+    lastResort stderr handler (the models/solver.py precedent), and
+    GL303 wants the log visibly in the handler."""
+    from karpenter_tpu.obs import decisions
+    from karpenter_tpu.operator import metrics as m
+
+    ctx.registry.counter(
+        m.DISRUPTION_PROBE_FAILURES,
+        "device consolidation probes that fell back to the "
+        "sequential search",
+    ).inc(method=method_label)
+    # anomaly trigger: a fallback costs the round its batched dispatch
+    # — the flight recorder keeps this round's span tree so the
+    # failing stage is attributable from the dump, not just counted
+    obs.anomaly("probe-fallback", registry=ctx.registry,
+                method=method_label)
+    decisions.record_decision(site, "sequential", "probe-error",
+                              registry=ctx.registry)
+
+
+def _device_probe(ctx, probe_fn, method_label, cands, pool):
+    """Shared probe runner for both per-candidate consolidation methods:
+    the TPUSolver gate, the exception fallback (`_probe_failure`), and
+    the batch-size histogram."""
     from karpenter_tpu.models.solver import TPUSolver
     from karpenter_tpu.obs import decisions
 
@@ -316,20 +367,7 @@ def _device_probe(ctx, probe_fn, method_label, cands, pool):
     except Exception:
         import logging
 
-        from karpenter_tpu.operator import metrics as m
-
-        ctx.registry.counter(
-            m.DISRUPTION_PROBE_FAILURES,
-            "device consolidation probes that fell back to the "
-            "sequential search",
-        ).inc(method=method_label)
-        # anomaly trigger: a fallback costs the round its batched dispatch
-        # — the flight recorder keeps this round's span tree so the
-        # failing stage is attributable from the dump, not just counted
-        obs.anomaly("probe-fallback", registry=ctx.registry,
-                    method=method_label)
-        decisions.record_decision("probe.confirm", "sequential",
-                                  "probe-error", registry=ctx.registry)
+        _probe_failure(ctx, method_label, "probe.confirm")
         logging.getLogger(__name__).warning(
             "device consolidation probe (%s) failed; using the sequential "
             "search", method_label, exc_info=True)
@@ -367,6 +405,140 @@ def _search_timed_out(ctx, deadline, search_type) -> bool:
         m.CONSOLIDATION_TIMEOUTS, "consolidation searches cut off by wall clock"
     ).inc(type=search_type)
     return True
+
+
+def _global_enabled() -> bool:
+    from karpenter_tpu.utils.envknobs import env_bool
+
+    return env_bool("KARPENTER_GLOBAL_CONSOLIDATION", True)
+
+
+def _global_cap() -> int:
+    from karpenter_tpu.utils.envknobs import env_int
+
+    return env_int("KARPENTER_GLOBAL_CAP", GLOBAL_CANDIDATE_CAP, minimum=2)
+
+
+# joint-ladder row ceiling: far above any real fleet (the 2k config is the
+# headline), it only bounds the counterfactual row count a pathological
+# candidate list could enqueue in one dispatch
+GLOBAL_CANDIDATE_CAP = 4096
+
+
+class GlobalConsolidation(Method):
+    """Global consolidation: ONE joint device solve over ALL candidates
+    proposes the whole retirement set plus its displacement plan, and
+    exactly one confirming simulation validates the winning set before
+    the command ships (deploy/README.md "Global consolidation").
+
+    The per-candidate ladder below (MultiNode prefix search + SingleNode
+    scan) is greedy by construction — each round retires one command's
+    worth of nodes and waits for the next generation. Here every prefix
+    of the SAME disruption-cost order is a counterfactual row of one
+    batched dispatch (ops/consolidate.py ``joint_retirement_plan``), a
+    host rounding/repair pass makes the winning row integral, and the
+    whole 2k-node underutilized fleet collapses in one command instead of
+    a generation-paced descent. The ladder is retired to ORACLE duty:
+    topology-compiled bundles, inexpressible shapes, non-definitive
+    ladders (the seed under-estimates and needs MultiNode's gallop),
+    repair overflows,
+    and probe-vs-host confirm disagreements all fall through to it (this
+    method returns None and the method order does the rest), so the
+    shipped end state is never worse than the reference's. Every
+    resolution records one ``consolidate.global`` ledger verdict
+    (obs/decisions.py): joint/ok when the set ships, the ladder rung with
+    its fallback cause otherwise, sequential when no device solve ran at
+    all. ``KARPENTER_GLOBAL_CONSOLIDATION=0`` disables the mode (the
+    ladder then owns every round, exactly the pre-ISSUE-13 behavior)."""
+
+    reason = REASON_UNDERUTILIZED
+    needs_validation = True
+    is_consolidation = True
+    last_rung: str = ""  # "joint" | "ladder" | "sequential" (tests + perf)
+    last_plan = None  # the round's JointPlan (tests + observability)
+
+    def _verdict(self, rung, reason="ok"):
+        from karpenter_tpu.obs import decisions
+
+        self.last_rung = rung
+        decisions.record_decision("consolidate.global", rung, reason,
+                                  registry=self.ctx.registry)
+
+    def compute_command(self, candidates, budgets):
+        self.last_plan = None
+        if not _global_enabled():
+            self._verdict("sequential", "disabled")
+            return None
+        pool = _consolidatable(candidates)
+        pool.sort(key=lambda c: c.disruption_cost)
+        cands = within_budget(budgets, self.reason, pool)[:_global_cap()]
+        if len(cands) < 2:
+            self._verdict("sequential", "too-few-candidates")
+            return None
+        from karpenter_tpu.models.solver import TPUSolver
+
+        if not isinstance(getattr(self.ctx.provisioner, "solver", None),
+                          TPUSolver):
+            self._verdict("sequential", "no-device")
+            return None
+        try:
+            from karpenter_tpu.ops.consolidate import joint_retirement_plan
+
+            with obs.span("global.probe", candidates=len(cands)):
+                plan = joint_retirement_plan(
+                    self.ctx.provisioner, self.ctx.cluster, self.ctx.store,
+                    cands,
+                    cache=getattr(self.ctx, "snapshot_cache", None),
+                    registry=self.ctx.registry,
+                    build_candidates=pool,
+                )
+        except Exception:
+            import logging
+
+            # _probe_failure records the sequential verdict itself (the
+            # shared diagnosis path — counter, anomaly, verdict)
+            self.last_rung = "sequential"
+            _probe_failure(self.ctx, "global", "consolidate.global")
+            logging.getLogger(__name__).warning(
+                "device consolidation probe (%s) failed; using the "
+                "sequential search", "global", exc_info=True)
+            return None
+        self.last_plan = plan
+        if plan is None:
+            self._verdict("sequential", "inexpressible")
+            return None
+        if plan.timings.get("solve_ms") is not None:
+            # rows were actually ranked (the dispatch ran — viable or
+            # not), mirroring _device_probe's any-non-None stance
+            from karpenter_tpu.operator import metrics as m
+
+            self.ctx.registry.histogram(
+                m.DISRUPTION_PROBE_BATCH_SIZE,
+                "counterfactual rows ranked per batched probe dispatch",
+                buckets=m.PROBE_BATCH_BUCKETS,
+            ).observe(len(cands), method="global")
+        if not plan.viable:
+            self._verdict("ladder", plan.reason)
+            return None
+        cmd = self._confirm(plan.selected)
+        if cmd is None or len(cmd.candidates) < 2:
+            # probe-vs-host disagreement: the one confirm failed, so the
+            # per-candidate ladder (the oracle) decides this round — the
+            # shipped command can never differ from the reference's answer
+            obs.anomaly("global-confirm-mismatch",
+                        registry=self.ctx.registry,
+                        selected=len(plan.selected), dropped=plan.dropped)
+            self._verdict("ladder", "confirm-mismatch")
+            return None
+        self._verdict("joint")
+        return cmd
+
+    def _confirm(self, selected):
+        """The round's ONE real simulation of the joint set — the shared
+        :func:`confirm_consolidation` contract, identical to the one the
+        MultiNode prefix pays."""
+        return confirm_consolidation(self.ctx, selected, "global",
+                                     selected=len(selected))
 
 
 class MultiNodeConsolidation(Method):
@@ -473,28 +645,12 @@ class MultiNodeConsolidation(Method):
                              cands, pool)
 
     def _confirm(self, prefix):
-        """One real simulation of a candidate prefix, with the same-type
-        price filter applied to any replacement. None = prefix fails."""
-        from karpenter_tpu.operator import metrics as m
-
+        """One real simulation of a candidate prefix (the shared
+        :func:`confirm_consolidation` contract) with this method's
+        host-confirm streak accounting on top."""
         self.last_host_confirms += 1
-        self.ctx.registry.counter(
-            m.DISRUPTION_HOST_CONFIRMS,
-            "confirming host simulations run by consolidation methods",
-        ).inc(method="multi")
-        with obs.span("confirm.simulate", method="multi",
-                      prefix=len(prefix)), \
-                self.ctx.registry.measure(m.DISRUPTION_CONFIRM_DURATION,
-                                          method="multi"):
-            cmd = compute_consolidation(self.ctx, prefix)
-        if cmd is None or cmd.action == "no-op":
-            return None
-        if cmd.action == "replace":
-            kept = filter_out_same_type(cmd.replacements[0], prefix)
-            if not kept:
-                return None
-            cmd.replacements[0].instance_types = kept
-        return cmd
+        return confirm_consolidation(self.ctx, prefix, "multi",
+                                     prefix=len(prefix))
 
     def _timed_out(self) -> bool:
         return _search_timed_out(self.ctx, self._deadline, "multi")

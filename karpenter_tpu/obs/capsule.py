@@ -29,6 +29,11 @@ rule proves them jit-unreachable):
 - ``probe.dispatch`` — ops/consolidate.py ``DisruptionSnapshot.dispatch`` (the batched
   counterfactual rows, their zeroed-column sets, and the master
   existing-node tensor).
+- ``global.dispatch`` — the SAME dispatch when the global consolidation
+  mode runs it as one joint ladder over every candidate
+  (ops/consolidate.py ``joint_retirement_plan``): identical tensor
+  layout, so an anomalous joint round replays through the identical
+  chunked program and the A/B table races its device/native pair.
 - ``service.solve`` — service/solver_service.py (tenant-scoped: the
   capsule carries and is filed under the tenant).
 
@@ -99,7 +104,7 @@ OUT_PREFIX = "out//"
 CF_PREFIX = "cf//"
 
 SEAMS = ("solver.invoke", "mesh.solve", "probe.dispatch", "service.solve",
-         "preempt.dispatch")
+         "preempt.dispatch", "global.dispatch")
 
 # knobs from the captured env snapshot that replay re-applies around the
 # mesh rungs: they decide whether/how the snapshot partitions, so a dev
@@ -449,10 +454,16 @@ class _applied_env:
         return False
 
 
+# seams whose capture is the chunked counterfactual-row dispatch (shared
+# replay body `_run_probe`): the per-candidate probe, the preemption
+# counterfactual, and the global joint consolidation ladder
+_ROW_SEAMS = ("probe.dispatch", "preempt.dispatch", "global.dispatch")
+
+
 def _captured_rung(cap: Capsule) -> str:
     """The replayable rung the capture actually ran."""
     engine = cap.engine
-    if cap.seam in ("probe.dispatch", "preempt.dispatch"):
+    if cap.seam in _ROW_SEAMS:
         return "native" if engine == "native" else "device"
     if cap.seam == "mesh.solve":
         return {"partitioned": "partitioned",
@@ -751,7 +762,7 @@ _PROBE_RUNGS = ("device", "native")
 
 
 def _execute(cap: Capsule, rung: str) -> dict:
-    if cap.seam in ("probe.dispatch", "preempt.dispatch"):
+    if cap.seam in _ROW_SEAMS:
         return _run_probe(cap, rung)
     return {
         "partitioned": _run_partitioned,
@@ -825,9 +836,7 @@ def ab_compare(cap: Capsule) -> list:
     parity vs the captured outputs, node count, wall clock, and the
     decision diff vs the captured rung. Ineligible/failed rungs report
     why instead of silently vanishing (the no-silent-caps stance)."""
-    rungs = (_PROBE_RUNGS
-             if cap.seam in ("probe.dispatch", "preempt.dispatch")
-             else _SOLVE_RUNGS)
+    rungs = _PROBE_RUNGS if cap.seam in _ROW_SEAMS else _SOLVE_RUNGS
     rows = []
     for rung in rungs:
         try:

@@ -17,6 +17,7 @@ site                    rungs (best first)                 recorded by
                         unsharded
 ``snapshot.advance``    delta, rebuild                     ``ops/consolidate.py SnapshotCache``
 ``probe.confirm``       definitive, gallop, sequential     ``controllers/disruption/methods.py``
+``consolidate.global``  joint, ladder, sequential          ``controllers/disruption/methods.py``
 ``solver.route``        mesh, native, xla, service, host   ``models/solver.py TPUSolver.solve``
 ``session.sync``        delta, resync                      ``service/solver_service.py`` (both ends)
 ``decode.recheck``      skip, full                         ``models/solver.py _compat_entry``
@@ -137,6 +138,28 @@ SITES = {
         "reasons": frozenset({
             "ok", "non-definitive", "inexpressible", "probe-error",
             "no-device", OTHER_REASON,
+        }),
+    },
+    "consolidate.global": {
+        # controllers/disruption/methods.py GlobalConsolidation: the joint
+        # device-solved retirement shipped (joint), handed the round to
+        # the per-candidate ladder with a cause (ladder — confirm
+        # disagreement, repair overflow, topology plan, or simply nothing
+        # to retire), or never ran a device solve at all (sequential).
+        # Workload-driven hand-offs are benign; confirm-mismatch,
+        # repair-bound, probe-error, and inexpressible stay armed — a
+        # steady 2k fleet quietly descending to the ladder every round is
+        # exactly the regression this site exists to catch.
+        "rungs": ("joint", "ladder", "sequential"),
+        "reasons": frozenset({
+            "ok", "no-retirement", "non-definitive", "confirm-mismatch",
+            "repair-bound", "topology-plan", "inexpressible",
+            "probe-error", "no-device", "disabled", "too-few-candidates",
+            OTHER_REASON,
+        }),
+        "benign": frozenset({
+            "no-retirement", "non-definitive", "topology-plan", "disabled",
+            "too-few-candidates", "no-device",
         }),
     },
     "solver.route": {

@@ -39,6 +39,19 @@ limits) returns None and the caller falls back to the sequential search; a
 probe hit is always re-validated by the full simulation before a command
 ships.
 
+Global consolidation (ISSUE 13) lifts the same counterfactual machinery
+into ONE joint solve: :func:`joint_retirement_plan` runs the prefix
+ladder over EVERY candidate simultaneously (no per-method cap), scores
+it with the identical shared criterion (:func:`_prefix_criterion`),
+rounds the winning row integrally on the host
+(``KARPENTER_GLOBAL_REPAIR_MAX`` bounded repair, the parallel/mesh.py
+stance), and hands the caller a whole retirement set plus its
+displacement plan for exactly one confirming simulation — the
+per-candidate ladder is retired to oracle/fallback duty. Mode knob,
+fallback ladder, confirm contract, and the ``consolidate.global`` ledger
+site are documented in deploy/README.md ("Global consolidation"); the
+joint dispatch records the ``global.dispatch`` replay-capsule seam.
+
 Snapshot-cache invalidation contract
 ------------------------------------
 
@@ -288,26 +301,12 @@ class DisruptionSnapshot:
             elif G * T > (1 << 18):
                 return None  # too big to prove; callers hedge instead
             else:
-                tmpl_ok = s.g_tmpl_ok[:, s.t_tmpl]  # [G,T]
-                shared = s.g_has[:, None, :] & s.t_has[None, :, :]
-                ov = (
-                    (s.g_mask[:, None, :, :] & s.t_mask[None, :, :, :]) != 0
-                ).any(-1)
-                both_tol = s.g_tol[:, None, :] & s.t_tol[None, :, :]
-                req_ok = (~shared | ov | both_tol).all(-1)  # [G,T]
+                compat = _group_type_compat(s)  # [G,T]
                 alloc_eff = s.t_alloc - s.m_overhead[s.t_tmpl]
                 fit = (
                     s.g_demand[:, None, :] <= alloc_eff[None, :, :] + 1e-6
                 ).all(-1)
-                zo, co = s.off_zone, s.off_ct
-                zok = np.where(
-                    zo[None, :, :] >= 0,
-                    s.g_zone_allowed[:, np.maximum(zo, 0)], True)
-                cok = np.where(
-                    co[None, :, :] >= 0,
-                    s.g_ct_allowed[:, np.maximum(co, 0)], True)
-                off_ok = (s.off_avail[None] & zok & cok).any(-1)  # [G,T]
-                self._claimable = (tmpl_ok & req_ok & fit & off_ok).any(1)
+                self._claimable = (compat & fit).any(1)
         return self._claimable
 
     def _with_deleting(self, base):
@@ -556,10 +555,14 @@ class DisruptionSnapshot:
             self._dims = (Gp, Ep)
         return self._shared, self._dims
 
-    def dispatch(self, g_count_k, e_zero_cols):
+    def dispatch(self, g_count_k, e_zero_cols, seam="probe.dispatch"):
         """Run the batched pack kernel over the counterfactual rows; returns
         (placed_g, used) — per-row PER-GROUP placed-pod counts (shape
-        [rows, Gp]) and per-row fresh-claim counts.
+        [rows, Gp]) and per-row fresh-claim counts. ``seam`` names the
+        replay-capture seam the dispatch records under (the per-candidate
+        probes use ``probe.dispatch``; the global joint ladder records the
+        same tensor layout under ``global.dispatch`` so an anomalous joint
+        round replays through the identical chunked program).
 
         ``e_zero_cols[i]`` holds the existing-node columns row i removes
         from the cluster; counterfactual ``e_avail`` rows materialize
@@ -575,7 +578,7 @@ class DisruptionSnapshot:
         fleet-size family."""
         if self._native_routable():
             try:
-                return self._dispatch_native(g_count_k, e_zero_cols)
+                return self._dispatch_native(g_count_k, e_zero_cols, seam)
             except Exception:
                 import logging
 
@@ -589,11 +592,11 @@ class DisruptionSnapshot:
                 shared, Gp, Ep, self.esnap.e_avail, self.max_minv,
                 g_count_k, e_zero_cols)
         self._capture(shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
-                      used, "device")
+                      used, "device", seam)
         return placed_g, used
 
     def _capture(self, shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
-                 used, engine):
+                 used, engine, seam="probe.dispatch"):
         """Replay capture of this probe dispatch (obs/capsule.py): the
         shared snapshot by reference plus the counterfactual rows and
         their zeroed-column sets (flattened idx+len, None rows as -1) —
@@ -617,7 +620,7 @@ class DisruptionSnapshot:
         inputs[_capsule.CF_PREFIX + "e_zero_idx"] = idx
         inputs[_capsule.CF_PREFIX + "e_zero_len"] = lens
         _capsule.record_capture(
-            "probe.dispatch", inputs,
+            seam, inputs,
             {"placed_g": placed_g, "used": used},
             engine=engine, max_minv=self.max_minv, Gp=Gp, Ep=Ep)
 
@@ -644,7 +647,8 @@ class DisruptionSnapshot:
         except Exception:
             return False
 
-    def _dispatch_native(self, g_count_k, e_zero_cols):
+    def _dispatch_native(self, g_count_k, e_zero_cols,
+                         seam="probe.dispatch"):
         """One native call per chunk (ROADMAP's open lever closed): the C++
         engine builds feasibility once per chunk and packs every
         counterfactual row in-process, returning only the per-row
@@ -657,7 +661,7 @@ class DisruptionSnapshot:
                 shared, Gp, Ep, self.esnap.e_avail, self.max_minv,
                 g_count_k, e_zero_cols)
         self._capture(shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
-                      used, "native")
+                      used, "native", seam)
         return placed_g, used
 
 
@@ -1084,84 +1088,8 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates,
 
     placed_g, used = bundle.dispatch(g_count_k, e_zero_cols)
     if bundle.plan is None:
-        # plan-free ladders aim to be DEFINITIVE, so the criterion mirrors
-        # the host's whole decision, not just "the candidates' pods land":
-        # (1) every pod the simulation would open a claim for — pending
-        # and drain pods of CLAIMABLE groups included — must place within
-        # the surviving nodes plus the one fresh bin, because the
-        # reference's m→1 rule counts the claims those pods consume too
-        # (consolidation.go:164): a mid-flight batch whose pending pods
-        # need their own claim can never confirm, and rows that ignore
-        # them burn a binary search per disagreement. Pods of UNclaimable
-        # groups are exempt exactly like the sim exempts them (a pod that
-        # can land nowhere takes no claim and all_pods_scheduled ignores
-        # it) — and when claimability is too large to prove, the ladder
-        # simply stops being definitive instead of guessing.
-        claimable = bundle.claimable_groups()
-        if claimable is None:
-            required = g_count_k
-            base_exempt_ok = int(base.sum()) == 0
-        else:
-            required = cum + np.where(claimable[:G], base, 0)[None, :]
-            base_exempt_ok = True
-        feasible = (placed_g[:, :G] >= required).all(axis=1)
-        # (2) the price ladder, modeling filterByPrice AND the same-type
-        # anti-churn filter (filter_out_same_type): a prefix that needs
-        # the fresh claim only ships if some available offering is both
-        # cheaper than the prefix's total cost and — once ANY option type
-        # overlaps a deleted node — cheaper than the cheapest such node.
-        # Per-type cheapest-available prices under-estimate real option
-        # prices, which over-includes types on the OPTION side (safe) but
-        # can over-include them on the same-type CAP side too (a type
-        # whose only requirement-compatible offerings are pricier than
-        # the global cheapest would not cap the host's filter): the
-        # ladder's misses are therefore only DEFINITIVE when every type's
-        # available offerings carry one price — heterogeneous catalogs
-        # hand the caller a seed instead, and the gallop/search recovers.
-        prices = np.array(
-            [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64
-        )
-        # a prefix containing an unpriceable candidate aborts its replace
-        # path outright (candidate_prices' getCandidatePrices stance)
-        prefix_known = np.logical_and.accumulate(prices > 0)
-        prefix_price = np.cumsum(prices)
-        p_by_name: dict = {}
-        for t, (_, it) in enumerate(bundle.snap.type_refs):
-            avail = bundle.snap.off_price[t][bundle.snap.off_avail[t]]
-            if avail.size:
-                p = float(avail.min())
-                if p < p_by_name.get(it.name, np.inf):
-                    p_by_name[it.name] = p
-        if p_by_name:
-            p_cat = np.fromiter(p_by_name.values(), dtype=np.float64)
-            name_idx = {nm: j for j, nm in enumerate(p_by_name)}
-            # cumulative cheapest candidate price per type over the prefix
-            cheapest = np.full((N, len(p_cat)), np.inf)
-            cur = np.full(len(p_cat), np.inf)
-            for i, c in enumerate(candidates):
-                nm = getattr(getattr(c, "instance_type", None), "name", None)
-                j = name_idx.get(nm)
-                if j is not None and prices[i] > 0:
-                    cur[j] = min(cur[j], prices[i])
-                cheapest[i] = cur
-            is_option = p_cat[None, :] < prefix_price[:, None]
-            overlap = is_option & np.isfinite(cheapest)
-            max_price = np.where(overlap, cheapest, np.inf).min(axis=1)
-            claim_ok = (
-                is_option & (p_cat[None, :] < max_price[:, None])
-            ).any(axis=1)
-        else:
-            claim_ok = np.zeros(N, dtype=bool)
-        feasible &= (used == 0) | (prefix_known & claim_ok)
-        # misses are definitive when the claim accounting above mirrored
-        # the sim (claimability proven, or no pending/drain pods rode the
-        # rows at all). The same-type cap-side corner noted above is the
-        # one residual under-approximation and is benign in direction: a
-        # rare smaller-than-optimal command this round, re-examined at the
-        # next generation — never an unsafe or permanently-skipped
-        # consolidation (the k<2 path always escalates total misses to the
-        # reference's full search).
-        definitive = base_exempt_ok
+        feasible, definitive = _prefix_criterion(
+            bundle, candidates, cum, placed_g, used)
     else:
         # topology ladders stay a SEED: per-group "the candidates' pods
         # land" only (a stuck pending pod must not poison the batch — the
@@ -1233,3 +1161,421 @@ def batched_single_feasible(provisioner, cluster, store, candidates,
             (used == 0) | ((prices > 0) & (bundle.min_price < prices))
         )
     return mask, bundle.plan is None
+
+
+def _prefix_criterion(bundle, candidates, cum, placed_g, used):
+    """The plan-free prefix ladder's model of the host's WHOLE decision —
+    shared verbatim by :func:`batched_feasible_prefix` (the per-candidate
+    ladder) and :func:`joint_retirement_plan` (the global joint ladder), so
+    the two paths can never drift on what "feasible" means. Returns
+    ``(feasible[N], definitive)``.
+
+    (1) every pod the simulation would open a claim for — pending and
+    drain pods of CLAIMABLE groups included — must place within the
+    surviving nodes plus the one fresh bin, because the reference's m→1
+    rule counts the claims those pods consume too (consolidation.go:164):
+    a mid-flight batch whose pending pods need their own claim can never
+    confirm, and rows that ignore them burn a binary search per
+    disagreement. Pods of UNclaimable groups are exempt exactly like the
+    sim exempts them (a pod that can land nowhere takes no claim and
+    all_pods_scheduled ignores it) — and when claimability is too large
+    to prove, the ladder simply stops being definitive instead of
+    guessing.
+
+    (2) the price ladder, modeling filterByPrice AND the same-type
+    anti-churn filter (filter_out_same_type): a prefix that needs the
+    fresh claim only ships if some available offering is both cheaper
+    than the prefix's total cost and — once ANY option type overlaps a
+    deleted node — cheaper than the cheapest such node. A prefix
+    containing an unpriceable candidate (price <= 0) aborts its replace
+    path outright (candidate_prices' getCandidatePrices stance), which on
+    the joint path degrades the selection toward the largest DELETE-ONLY
+    prefix — the ADVICE.md round-5 unknown-price stance, applied
+    identically on both ladders. Per-type cheapest-available prices
+    under-estimate real option prices, which over-includes types on the
+    OPTION side (safe) but can over-include them on the same-type CAP
+    side too (a type whose only requirement-compatible offerings are
+    pricier than the global cheapest would not cap the host's filter):
+    the ladder's misses are therefore only DEFINITIVE when every type's
+    available offerings carry one price — heterogeneous catalogs hand the
+    caller a seed instead, and the gallop/search recovers.
+
+    Misses are definitive when the claim accounting above mirrored the
+    sim (claimability proven, or no pending/drain pods rode the rows at
+    all). The same-type cap-side corner noted above is the one residual
+    under-approximation and is benign in direction: a rare
+    smaller-than-optimal command this round, re-examined at the next
+    generation — never an unsafe or permanently-skipped consolidation
+    (the k<2 path always escalates total misses to the reference's full
+    search)."""
+    base = bundle.base
+    G = bundle.snap.G
+    N = len(candidates)
+    claimable = bundle.claimable_groups()
+    if claimable is None:
+        required = base[None, :] + cum
+        base_exempt_ok = int(base.sum()) == 0
+    else:
+        required = cum + np.where(claimable[:G], base, 0)[None, :]
+        base_exempt_ok = True
+    feasible = (placed_g[:, :G] >= required).all(axis=1)
+    prices = np.array(
+        [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64
+    )
+    prefix_known = np.logical_and.accumulate(prices > 0)
+    prefix_price = np.cumsum(prices)
+    p_by_name: dict = {}
+    for t, (_, it) in enumerate(bundle.snap.type_refs):
+        avail = bundle.snap.off_price[t][bundle.snap.off_avail[t]]
+        if avail.size:
+            p = float(avail.min())
+            if p < p_by_name.get(it.name, np.inf):
+                p_by_name[it.name] = p
+    if p_by_name:
+        p_cat = np.fromiter(p_by_name.values(), dtype=np.float64)
+        name_idx = {nm: j for j, nm in enumerate(p_by_name)}
+        # cumulative cheapest candidate price per type over the prefix
+        cheapest = np.full((N, len(p_cat)), np.inf)
+        cur = np.full(len(p_cat), np.inf)
+        for i, c in enumerate(candidates):
+            nm = getattr(getattr(c, "instance_type", None), "name", None)
+            j = name_idx.get(nm)
+            if j is not None and prices[i] > 0:
+                cur[j] = min(cur[j], prices[i])
+            cheapest[i] = cur
+        is_option = p_cat[None, :] < prefix_price[:, None]
+        overlap = is_option & np.isfinite(cheapest)
+        max_price = np.where(overlap, cheapest, np.inf).min(axis=1)
+        claim_ok = (
+            is_option & (p_cat[None, :] < max_price[:, None])
+        ).any(axis=1)
+    else:
+        claim_ok = np.zeros(N, dtype=bool)
+    feasible &= (used == 0) | (prefix_known & claim_ok)
+    return feasible, base_exempt_ok
+
+
+# ---------------------------------------------------------------------------
+# global consolidation: ONE joint device-solved retirement over every
+# candidate (the 2k-node config) — deploy/README.md "Global consolidation"
+# ---------------------------------------------------------------------------
+
+# host rounding/repair drop budget: how many trailing candidates the
+# integral pass may shed from the device ladder's relaxed selection before
+# the round falls back to the per-candidate ladder (the
+# KARPENTER_SHARD_REPAIR_MAX stance applied to retirement sets)
+GLOBAL_REPAIR_MAX = 64
+
+# per-process joint-solve accounting, delta'd by `python -m perf global`
+# (the formulate/solve/round_repair breakdown the ISSUE-13 row emits)
+GLOBAL_STATS = {
+    "plans": 0,
+    "rows": 0,
+    "formulate_ms": 0.0,
+    "solve_ms": 0.0,
+    "round_repair_ms": 0.0,
+    "repair_drops": 0,
+}
+
+
+def _global_repair_bound() -> int:
+    from karpenter_tpu.utils.envknobs import env_int
+
+    return env_int("KARPENTER_GLOBAL_REPAIR_MAX", GLOBAL_REPAIR_MAX,
+                   minimum=0)
+
+
+class JointPlan:
+    """One global-consolidation proposal: the retirement set the joint
+    device ladder selected (post rounding/repair), the integral
+    displacement plan the host pass built for it, and the decision/timing
+    story the perf row and the ``consolidate.global`` ledger verdict are
+    written from. ``viable=False`` plans carry the fallback ``reason``
+    (a ``consolidate.global`` closed-enum member) instead of a set."""
+
+    def __init__(self, candidates, selected_idx=(), delete_only=True,
+                 definitive=False, displacement=(), overflow=None,
+                 k_device=0, dropped=0, timings=None, viable=True,
+                 reason="ok"):
+        self._candidates = list(candidates)
+        self.selected_idx = list(selected_idx)
+        self.delete_only = delete_only
+        self.definitive = definitive
+        # [(provider_id, group_index, pod_count)] — where each displaced
+        # pod group lands among the survivors (exact-arithmetic integral)
+        self.displacement = list(displacement)
+        # {group_index: pod_count} headed for the ONE fresh claim (empty
+        # on delete-only plans)
+        self.overflow = dict(overflow or {})
+        self.k_device = k_device  # the device ladder's pre-repair k
+        self.dropped = dropped  # candidates shed by the repair pass
+        self.timings = dict(timings or {})
+        self.viable = viable
+        self.reason = reason
+
+    @property
+    def selected(self):
+        return [self._candidates[i] for i in self.selected_idx]
+
+
+def joint_retirement_plan(provisioner, cluster, store, candidates,
+                          cache=None, registry=None, build_candidates=None):
+    """The global consolidation solve: ONE joint device ladder over ALL
+    candidates simultaneously — every prefix of the disruption-cost order
+    is a counterfactual row of a single batched dispatch (the LP-relaxed
+    selection), and a host-side rounding/repair pass (the
+    parallel/mesh.py bounded-repair stance) makes the winning row's
+    displacement plan integral, shedding trailing candidates when exact
+    arithmetic disagrees with the kernel's f32 fit. The caller pays
+    exactly ONE confirming ``simulate_scheduling`` for the returned set;
+    any disagreement there falls back to the per-candidate ladder, which
+    this mode retires to oracle duty.
+
+    Returns ``None`` when the probe cannot express the scenario at all
+    (no bundle, invisible candidates, unmapped pods — the caller records
+    the ``sequential`` rung), else a :class:`JointPlan`; non-``viable``
+    plans name their fallback cause (``topology-plan``,
+    ``no-retirement``, ``repair-bound``)."""
+    t0 = time.perf_counter()
+    bundle = _bundle_for(
+        provisioner, cluster, store, candidates, cache, registry,
+        build_candidates,
+    )
+    if bundle is None:
+        return None
+    if bundle.plan is not None:
+        # waves-compiled bundles make every counterfactual row approximate
+        # (module docstring): a joint set chosen from approximate rows
+        # would burn its one confirm routinely — the per-candidate ladder
+        # (whose gallop recovers cheaply) keeps topology clusters
+        return JointPlan(candidates, viable=False, reason="topology-plan")
+    cols = bundle.columns_for(candidates)
+    if cols is None:
+        return None
+    contrib = bundle.contribs_for(candidates)
+    if contrib is None:
+        return None
+
+    N = len(candidates)
+    cum = np.cumsum(contrib, axis=0)  # [N,G]
+    g_count_k = bundle.base[None, :] + cum
+    col_arr = np.asarray(cols, dtype=np.intp)
+    e_zero_cols = [col_arr[: k + 1] for k in range(N)]
+    t1 = time.perf_counter()
+
+    with obs.span("global.dispatch", rows=N):
+        placed_g, used = bundle.dispatch(g_count_k, e_zero_cols,
+                                         seam="global.dispatch")
+    t2 = time.perf_counter()
+
+    feasible, definitive = _prefix_criterion(
+        bundle, candidates, cum, placed_g, used)
+    ks = np.flatnonzero(feasible)
+    k = 0 if ks.size == 0 else int(ks[-1]) + 1
+    timings = {
+        "formulate_ms": (t1 - t0) * 1000.0,
+        "solve_ms": (t2 - t1) * 1000.0,
+    }
+    if not definitive:
+        # a non-definitive ladder (claimability too large to prove, with
+        # pending/drain pods riding the rows) UNDER-estimates k; the
+        # MultiNode ladder gallops/searches above such a seed, and a
+        # joint command shipped at the seed would both retire fewer
+        # nodes than the reference AND preempt that recovery (this
+        # method runs first) — so the round is handed to the ladder,
+        # whose gallop is exactly the machinery the seed needs
+        _account(timings, N, 0)
+        return JointPlan(candidates, k_device=k, timings=timings,
+                         viable=False, reason="non-definitive")
+    if k < 2:
+        # nothing worth a joint command: single-candidate rounds (and the
+        # probe's residual false-negative corner) stay the ladder's job
+        _account(timings, N, 0)
+        return JointPlan(candidates, definitive=definitive,
+                         k_device=k, timings=timings, viable=False,
+                         reason="no-retirement")
+
+    t3 = time.perf_counter()
+    k_final, plan, dropped = _round_repair(
+        bundle, col_arr, contrib, k, used, feasible)
+    timings["round_repair_ms"] = (time.perf_counter() - t3) * 1000.0
+    _account(timings, N, dropped)
+    if plan is None:
+        # the device ladder scored k>=2 feasible but integral rounding
+        # failed at every prefix it tried (budget spent, or shed below
+        # 2): ARMED as repair-bound either way — a fleet persistently
+        # losing its joint rounds to f32-vs-f64 disagreement is exactly
+        # the steady-state descent the ledger site exists to catch,
+        # never the benign nothing-to-do verdict
+        return JointPlan(candidates, definitive=definitive, k_device=k,
+                         dropped=dropped, timings=timings, viable=False,
+                         reason="repair-bound")
+    placements, overflow = plan
+    return JointPlan(
+        candidates,
+        selected_idx=range(k_final),
+        delete_only=not overflow,
+        definitive=definitive,
+        displacement=placements,
+        overflow=overflow,
+        k_device=k,
+        dropped=dropped,
+        timings=timings,
+    )
+
+
+def _account(timings, rows, dropped):
+    GLOBAL_STATS["plans"] += 1
+    GLOBAL_STATS["rows"] += rows
+    GLOBAL_STATS["repair_drops"] += dropped
+    for key in ("formulate_ms", "solve_ms", "round_repair_ms"):
+        GLOBAL_STATS[key] += timings.get(key, 0.0)
+
+
+def _round_repair(bundle, col_arr, contrib, k, used, feasible):
+    """Host-side integral rounding of the device ladder's relaxed
+    selection (the parallel/mesh.py ``_repair_merged`` stance applied to
+    retirement sets): re-derive the winning prefix's displacement plan in
+    exact float64 arithmetic over the survivors' residual capacity, and
+    when the kernel's f32 fit over-estimated, shed TRAILING candidates
+    down to the next prefix the device ladder itself scored feasible
+    (shedding strictly loosens the problem — the trailing node returns
+    to the survivor pool AND its pods leave the demand; prefixes the
+    kernel already rejected are skipped, not re-derived) and retry,
+    attempts bounded by ``KARPENTER_GLOBAL_REPAIR_MAX``. Returns
+    ``(k_final, (placements, overflow) | None, drops)`` — ``drops`` is
+    the number of candidates shed from the device selection, and the
+    plan is ``None`` when the attempt budget ran out or the set shrank
+    below 2."""
+    base = bundle.base
+    G = bundle.snap.G
+    claimable = bundle.claimable_groups()
+    if claimable is not None:
+        base_req = np.where(claimable[:G], base, 0)
+    else:
+        base_req = base
+    live = np.asarray(bundle.esnap.live, dtype=bool)
+    budget = _global_repair_bound()
+    attempts = 0
+    k_cur = k
+    while k_cur >= 2:
+        surv = live.copy()
+        surv[col_arr[:k_cur]] = False
+        required = contrib[:k_cur].sum(axis=0) + base_req
+        plan = _greedy_displace(
+            bundle, surv, required, allow_claim=bool(used[k_cur - 1] > 0))
+        if plan is not None:
+            return k_cur, plan, k - k_cur
+        if attempts >= budget:
+            return k_cur, None, k - k_cur
+        attempts += 1
+        ks = np.flatnonzero(feasible[:k_cur - 1])
+        k_cur = int(ks[-1]) + 1 if ks.size else 0
+    return k_cur, None, k - k_cur
+
+
+def _greedy_displace(bundle, surv, required, allow_claim):
+    """Exact-arithmetic displacement plan for one retirement set: place
+    each group's required pods into surviving nodes' residual capacity
+    (ge_ok-compatible, biggest-demand groups first, fullest-fitting nodes
+    first — the FFD stance of the mesh repair pass), route any remainder
+    to the ONE fresh claim when the ladder row allowed it. Returns
+    ``(placements, overflow)`` or ``None`` when the set does not round
+    integrally (the caller repairs by shrinking it).
+
+    Residual capacity + ``ge_ok`` is the COMPLETE constraint set here:
+    the joint path only reaches this pass on plan-free bundles (topology
+    plans fell back before the solve), so the kernel's spread/anti/
+    affinity columns (e_scnt/e_decl/e_match/e_aff) are all empty,
+    per-node max-pods rides the PODS column of ``e_avail``, and
+    ``e_npods`` is a fill-priority heuristic, not a constraint."""
+    snap, esnap = bundle.snap, bundle.esnap
+    G = snap.G
+    g_demand = np.asarray(snap.g_demand, dtype=np.float64)
+    resid = np.maximum(np.asarray(esnap.e_avail, dtype=np.float64), 0.0)
+    resid[~surv] = 0.0
+    ge_ok = np.asarray(esnap.ge_ok, dtype=bool)
+    placements: list = []
+    overflow: dict = {}
+    order = np.argsort(-g_demand.sum(axis=1), kind="stable")
+    for g in order:
+        n = int(required[g])
+        if n <= 0:
+            continue
+        d = g_demand[g]
+        pos = d > 0
+        if not pos.any():
+            continue  # zero-demand pods land anywhere; the sim agrees
+        rows = np.flatnonzero(surv & ge_ok[g])
+        if rows.size:
+            cap = np.floor(
+                (resid[np.ix_(rows, np.flatnonzero(pos))] / d[pos][None, :])
+                .min(axis=1) + _REPAIR_EPS
+            ).astype(np.int64)
+            for j in np.argsort(-cap, kind="stable"):
+                if n <= 0:
+                    break
+                take = min(n, int(cap[j]))
+                if take <= 0:
+                    break  # caps are sorted descending: the rest are 0 too
+                e = int(rows[j])
+                placements.append((esnap.nodes[e].state_node.provider_id,
+                                   int(g), take))
+                resid[e] -= take * d
+                n -= take
+        if n > 0:
+            if not allow_claim:
+                return None
+            overflow[int(g)] = overflow.get(int(g), 0) + n
+    if overflow and not _one_claim_fits(snap, overflow):
+        return None
+    return placements, overflow
+
+
+_REPAIR_EPS = 1e-9
+
+
+def _group_type_compat(snap, gsel=None):
+    """[n,T] bool — template compat ∧ requirement overlap (with the
+    Intersects tolerance rule) ∧ some offering admissible for the
+    group's zone/capacity-type sets, availability included. ONE copy
+    shared by :meth:`DisruptionSnapshot.claimable_groups` and
+    :func:`_one_claim_fits` so the joint path's claim check can never
+    drift from the per-candidate ladder's; the per-pod vs aggregate FIT
+    check stays with each caller."""
+    s = snap
+    sel = slice(None) if gsel is None else gsel
+    tmpl_ok = s.g_tmpl_ok[sel][:, s.t_tmpl]  # [n,T]
+    shared = s.g_has[sel][:, None, :] & s.t_has[None, :, :]
+    ov = ((s.g_mask[sel][:, None] & s.t_mask[None, :]) != 0).any(-1)
+    both_tol = s.g_tol[sel][:, None, :] & s.t_tol[None, :, :]
+    req_ok = (~shared | ov | both_tol).all(-1)  # [n,T]
+    zo, co = s.off_zone, s.off_ct
+    zok = np.where(
+        zo[None, :, :] >= 0,
+        s.g_zone_allowed[sel][:, np.maximum(zo, 0)], True)
+    cok = np.where(
+        co[None, :, :] >= 0,
+        s.g_ct_allowed[sel][:, np.maximum(co, 0)], True)
+    off_ok = (s.off_avail[None] & zok & cok).any(-1)  # [n,T]
+    return tmpl_ok & req_ok & off_ok
+
+
+def _one_claim_fits(snap, overflow) -> bool:
+    """Whether SOME instance type can carry every overflow pod on one
+    fresh node: the shared group×type compat mask, jointly over every
+    overflow group, and the aggregate demand (net of daemon overhead)
+    inside the type's allocatable. Over-estimating here is caught by the
+    confirming simulation (the safe direction); an under-estimate only
+    sheds one more candidate than strictly needed."""
+    gsel = np.fromiter(overflow.keys(), dtype=np.intp)
+    counts = np.fromiter(overflow.values(), dtype=np.int64)
+    if snap.T == 0:
+        return False
+    ok_t = _group_type_compat(snap, gsel).all(axis=0)  # [T]
+    if not ok_t.any():
+        return False
+    demand = (counts[:, None] * snap.g_demand[gsel]).sum(axis=0)
+    alloc_eff = snap.t_alloc - snap.m_overhead[snap.t_tmpl]
+    fits = (demand[None, :] <= alloc_eff + 1e-6).all(-1)  # [T]
+    return bool((ok_t & fits).any())

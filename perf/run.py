@@ -34,6 +34,21 @@ Usage:
                                    # preemption: counterfactual probe →
                                    # confirm-by-simulation → PDB-gated
                                    # evictions)
+    python -m perf global          # the ISSUE-13 global-consolidation
+                                   # row: the 2000-node underutilized
+                                   # config (PERF_GLOBAL_NODES) converges
+                                   # under the JOINT device-solved
+                                   # retirement, then a fresh identical
+                                   # fleet converges under the
+                                   # per-candidate LADDER (the oracle);
+                                   # the row carries the joint-vs-ladder
+                                   # breakdown (formulate_ms/solve_ms/
+                                   # round_repair_ms/confirm_count/
+                                   # end_cost) and the three acceptance
+                                   # verdicts bench.py --consolidation
+                                   # gates on (<10s joint wall clock,
+                                   # end cost <= the ladder's, exactly
+                                   # one confirm per joint command)
     python -m perf multitenant     # N concurrent synthetic clusters
                                    # (PERF_TENANTS=8) round-robin through
                                    # one solver service: per-tenant
@@ -208,16 +223,7 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
     start_pods = len([p for p in env.store.list("pods") if p.node_name])
     stats0 = dict(_tz.STATS)  # process-wide: delta against the env build
     dec0 = decisions.counts()
-    t0 = time.perf_counter()
-    rounds = 0
-    stable = 0
-    while rounds < 100 and stable < 3:
-        before = len(env.store.list("nodes"))
-        env.clock.step(20.0)  # past validation TTLs and poll periods
-        env.run_until_idle(max_rounds=300)
-        rounds += 1
-        stable = stable + 1 if len(env.store.list("nodes")) == before else 0
-    elapsed = time.perf_counter() - t0
+    elapsed, rounds = _converge_disruption(env, idle_rounds=300)
     end_nodes = len(env.store.list("nodes"))
     end_pods = len([p for p in env.store.list("pods") if p.node_name])
     hist = env.registry.histogram("karpenter_disruption_evaluation_duration_seconds")
@@ -322,6 +328,132 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
         "rungs": decisions.rung_delta(dec0, decisions.counts()),
         **out_extra,
     }))
+
+
+def _fleet_cost(env) -> float:
+    """Sum of the fleet's current offering prices (the end-state cost the
+    joint-vs-ladder parity bar compares) — Candidate.price's resolution,
+    applied to every node in the store."""
+    from karpenter_tpu.api import labels as wk
+
+    d = env.disruption
+    pools = {np_.name: np_ for np_ in env.store.list("nodepools")}
+    catalogs: dict = {}
+    total = 0.0
+    for node in env.store.list("nodes"):
+        pool = pools.get(node.labels.get(wk.NODEPOOL_LABEL, ""))
+        if pool is None:
+            continue
+        if pool.name not in catalogs:
+            catalogs[pool.name] = {
+                it.name: it for it in d.cloud.get_instance_types(pool)}
+        it = catalogs[pool.name].get(
+            node.labels.get(wk.INSTANCE_TYPE_LABEL, ""))
+        if it is None:
+            continue
+        zone = node.labels.get(wk.TOPOLOGY_ZONE_LABEL, "")
+        ct = node.labels.get(
+            wk.CAPACITY_TYPE_LABEL, wk.CAPACITY_TYPE_ON_DEMAND)
+        for o in it.offerings:
+            if o.zone == zone and o.capacity_type == ct:
+                total += o.price
+                break
+    return total
+
+
+def _converge_disruption(env, max_rounds=100, idle_rounds=500):
+    """Drive the env's disruption loop to a 3-round-stable fleet; returns
+    (elapsed_s, rounds). ONE copy shared by the config-4 row and the
+    global joint-vs-ladder legs, so the stability criterion (node count
+    unchanged for 3 rounds) cannot drift between the numbers the
+    sentinel compares."""
+    t0 = time.perf_counter()
+    rounds = 0
+    stable = 0
+    while rounds < max_rounds and stable < 3:
+        before = len(env.store.list("nodes"))
+        env.clock.step(20.0)  # past validation TTLs and poll periods
+        env.run_until_idle(max_rounds=idle_rounds)
+        rounds += 1
+        stable = stable + 1 if len(env.store.list("nodes")) == before else 0
+    return time.perf_counter() - t0, rounds
+
+
+def run_global_consolidation():
+    """The ISSUE-13 row: the 2000-node underutilized config under the
+    JOINT global-consolidation mode vs the per-candidate LADDER on a
+    fresh identical fleet (KARPENTER_GLOBAL_CONSOLIDATION=0 — the oracle
+    duty the ladder is retired to). One JSON row with the joint
+    breakdown, both end states/costs, and the three acceptance verdicts
+    bench.py --consolidation gates at exit 3."""
+    from karpenter_tpu.obs import decisions
+    from karpenter_tpu.operator import metrics as m
+    from karpenter_tpu.ops.consolidate import GLOBAL_STATS
+
+    n_nodes = int(os.environ.get("PERF_GLOBAL_NODES", "2000"))
+    budget_ms = float(os.environ.get("PERF_GLOBAL_BUDGET_MS", "10000"))
+
+    def leg(enabled: bool) -> dict:
+        prior = os.environ.get("KARPENTER_GLOBAL_CONSOLIDATION")
+        os.environ["KARPENTER_GLOBAL_CONSOLIDATION"] = (
+            "1" if enabled else "0")
+        try:
+            env = C.config4_consolidation_env(n_nodes)
+            g0 = dict(GLOBAL_STATS)
+            dec0 = decisions.counts()
+            elapsed, rounds = _converge_disruption(env)
+            out = {
+                "total_ms": round(elapsed * 1000, 2),
+                "rounds": rounds,
+                "end_nodes": len(env.store.list("nodes")),
+                "pods_bound": len(
+                    [p for p in env.store.list("pods") if p.node_name]),
+                "end_cost": round(_fleet_cost(env), 6),
+                "rungs": decisions.rung_delta(dec0, decisions.counts()),
+            }
+            confirms = env.registry.counter(m.DISRUPTION_HOST_CONFIRMS)
+            out["confirm_count"] = int(confirms.value(method="global"))
+            if enabled:
+                out["breakdown"] = {
+                    k: round(GLOBAL_STATS[k] - g0[k], 2)
+                    for k in ("formulate_ms", "solve_ms", "round_repair_ms")
+                }
+                out["repair_drops"] = (
+                    GLOBAL_STATS["repair_drops"] - g0["repair_drops"])
+                # joint commands = ("consolidate.global", joint, ok)
+                # verdicts: each paid exactly one confirming simulation —
+                # any extra confirm is a confirm-mismatch fallback
+                joint = out["rungs"].get("consolidate.global", {})
+                out["joint_commands"] = int(joint.get("joint", 0))
+            return out
+        finally:
+            if prior is None:
+                os.environ.pop("KARPENTER_GLOBAL_CONSOLIDATION", None)
+            else:
+                os.environ["KARPENTER_GLOBAL_CONSOLIDATION"] = prior
+
+    joint = leg(True)
+    ladder = leg(False)
+    row = {
+        "config": f"4-consolidation-{n_nodes}-global",
+        "nodes": n_nodes,
+        **{k: joint[k] for k in (
+            "total_ms", "rounds", "end_nodes", "pods_bound", "end_cost",
+            "confirm_count", "joint_commands", "breakdown", "repair_drops",
+            "rungs")},
+        "ladder": {k: ladder[k] for k in (
+            "total_ms", "rounds", "end_nodes", "pods_bound", "end_cost")},
+        # the three acceptance verdicts (bench.py --consolidation):
+        # <budget wall clock, end cost <= the ladder oracle's, and exactly
+        # one confirming simulation per executed joint command
+        "within_budget_ms": bool(joint["total_ms"] <= budget_ms),
+        "cost_le_ladder": bool(
+            joint["end_cost"] <= ladder["end_cost"] + 1e-9),
+        "confirm_contract_ok": bool(
+            joint["joint_commands"] >= 1
+            and joint["confirm_count"] == joint["joint_commands"]),
+    }
+    print(json.dumps(row))
 
 
 def _multichip_row(jax, mesh, snap, args, trace, gate=False,
@@ -1079,6 +1211,11 @@ def main():
         return
     if args == ["multichip"]:
         run_multichip(trace=breakdown)
+        return
+    if args == ["global"]:
+        # (no --json toggle: the joint breakdown IS the row's point and
+        # is always emitted)
+        run_global_consolidation()
         return
     if args == ["priority"]:
         run_priority(trace=breakdown)

@@ -1,0 +1,455 @@
+"""Global consolidation (ISSUE 13): ONE joint device-solved retirement
+over all candidates (ops/consolidate.py joint_retirement_plan +
+controllers/disruption/methods.py GlobalConsolidation), the per-candidate
+ladder retired to oracle duty.
+
+The suite pins (1) the parity bar — joint-mode end-state cost ≤ the
+ladder oracle's on identical seeded fleets, and the shipped set
+bit-identical to MultiNode's prefix when the relaxation rounds cleanly —
+(2) the fallback-trigger matrix (inexpressible shapes, budget-gated
+candidates, topology bundles) proving the ladder rung still produces the
+reference end state, (3) the ADVICE.md round-5 unknown-price stance on
+the joint path (delete-only, never a replacement anchored on an
+unpriceable node), and (4) the `global.dispatch` replay-capsule seam.
+"""
+
+from __future__ import annotations
+
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.controllers.disruption.helpers import (
+    build_disruption_budgets,
+    get_candidates,
+)
+from karpenter_tpu.controllers.disruption.methods import (
+    GlobalConsolidation,
+    MultiNodeConsolidation,
+)
+from perf import configs as C
+
+GIB = 2**30
+
+
+def build_env(n_nodes=8):
+    env = C.config4_consolidation_env(n_nodes=n_nodes)
+    env.disruption.poll_period = float("inf")  # drive polls by hand
+    return env
+
+
+def seeded_mixed_env(n_deploys: int, seed: int):
+    """The config-4 shape with a seeded pod-size mix (2.5/5/7.5 cpu), so
+    the joint ladder sees several groups instead of one."""
+    from karpenter_tpu.api.objects import Deployment, ObjectMeta
+    from karpenter_tpu.cloudprovider.catalog import make_instance_type
+    from karpenter_tpu.operator import Environment
+    from karpenter_tpu.operator.options import Options
+
+    r = random.Random(seed)
+    env = Environment(
+        instance_types=[make_instance_type("xl", 16, 64)],
+        enable_disruption=True,
+        options=Options.from_env(
+            feature_gates={"spot_to_spot_consolidation": True}),
+    )
+    env.disruption.poll_period = float("inf")
+    pool = C._pool()
+    pool.spec.disruption.consolidate_after = 0.0
+    pool.spec.disruption.budgets[0].nodes = "100%"
+    env.create("nodepools", pool)
+    for i in range(n_deploys):
+        cpu = r.choice((2.5, 5.0, 7.5))
+        env.store.create("deployments", Deployment(
+            metadata=ObjectMeta(name=f"d{i}"), replicas=3,
+            template=C._pod(f"d{i}-tpl", cpu, cpu * 2)))
+    env.run_until_idle(max_rounds=400)
+    for d in env.store.list("deployments"):
+        d.replicas = 1
+        env.store.update("deployments", d)
+    env.run_until_idle(max_rounds=400)
+    return env
+
+
+def gmethod(env):
+    return next(
+        m for m in env.disruption.methods
+        if isinstance(m, GlobalConsolidation)
+    )
+
+
+def compute_global(env):
+    """One GlobalConsolidation.compute_command against live state."""
+    d = env.disruption
+    method = gmethod(env)
+    candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                                queue=d.queue)
+    budgets = build_disruption_budgets(d.cluster, d.store, d.clock)
+    return method.compute_command(candidates, budgets), method
+
+
+def compute_multi(env):
+    from tests.test_batched_consolidation import compute
+
+    return compute(env)
+
+
+def converge(env, max_rounds=60):
+    env.disruption.poll_period = 0.0
+    rounds = stable = 0
+    while rounds < max_rounds and stable < 3:
+        before = len(env.store.list("nodes"))
+        env.clock.step(20.0)
+        env.run_until_idle(max_rounds=400)
+        rounds += 1
+        stable = stable + 1 if len(env.store.list("nodes")) == before else 0
+    env.disruption.poll_period = float("inf")
+
+
+def fleet(env):
+    nodes = len(env.store.list("nodes"))
+    pods = len([p for p in env.store.list("pods") if p.node_name])
+    return nodes, pods
+
+
+class TestJointRetirement:
+    def test_joint_command_ships_with_one_confirm(self):
+        from karpenter_tpu.operator import metrics as m
+
+        env = build_env(8)
+        cmd, method = compute_global(env)
+        assert method.last_rung == "joint"
+        assert cmd is not None and len(cmd.candidates) >= 2
+        assert cmd.action == "delete"  # uniform fleet: pure retirement
+        confirms = env.registry.counter(m.DISRUPTION_HOST_CONFIRMS)
+        assert confirms.value(method="global") == 1
+        # the plan carries the full displacement story: every displaced
+        # pod lands on a named survivor, none on the fresh claim
+        plan = method.last_plan
+        assert plan.viable and plan.delete_only and not plan.overflow
+        displaced = sum(
+            len(c.reschedulable_pods) for c in cmd.candidates)
+        assert sum(n for _, _, n in plan.displacement) == displaced
+        retired = {c.provider_id for c in cmd.candidates}
+        assert all(pid not in retired for pid, _, _ in plan.displacement)
+
+    def test_bit_identical_to_multinode_prefix_when_clean(self):
+        # same env, same state: when the relaxation rounds cleanly (no
+        # repair drops), the joint set IS MultiNode's winning prefix —
+        # same cost order, same criterion, same confirm
+        env = build_env(8)
+        cmd_g, method = compute_global(env)
+        assert method.last_plan.dropped == 0
+        cmd_m, probe = compute_multi(env)
+        assert probe == "device"
+        assert cmd_g is not None and cmd_m is not None
+        assert {c.name for c in cmd_g.candidates} == {
+            c.name for c in cmd_m.candidates}
+
+    def test_joint_ladder_definitive_on_uniform_fleet(self):
+        env = build_env(8)
+        _, method = compute_global(env)
+        assert method.last_plan.definitive
+
+    @pytest.mark.parametrize("seed", (3, 11, 29))
+    def test_seeded_parity_joint_cost_le_ladder(self, seed, monkeypatch):
+        from perf.run import _fleet_cost
+
+        n = 24
+        env_j = seeded_mixed_env(n, seed)
+        monkeypatch.setenv("KARPENTER_GLOBAL_CONSOLIDATION", "1")
+        converge(env_j)
+        monkeypatch.setenv("KARPENTER_GLOBAL_CONSOLIDATION", "0")
+        env_l = seeded_mixed_env(n, seed)
+        converge(env_l)
+        nodes_j, pods_j = fleet(env_j)
+        nodes_l, pods_l = fleet(env_l)
+        assert pods_j == pods_l, "joint mode lost workload pods"
+        assert _fleet_cost(env_j) <= _fleet_cost(env_l) + 1e-9
+        assert nodes_j <= nodes_l
+
+    def test_convergence_confirm_contract(self):
+        # over a whole convergence: one confirming simulation per joint
+        # command, every command executed (no probe-vs-host mismatch)
+        from karpenter_tpu.obs import decisions
+        from karpenter_tpu.operator import metrics as m
+
+        env = build_env(18)
+        dec0 = decisions.counts()
+        converge(env)
+        nodes, pods = fleet(env)
+        assert pods == 18
+        assert nodes == 6  # ceil(18 pods / 3 per node): the packed floor
+        delta = decisions.rung_delta(dec0, decisions.counts())
+        joint = delta.get("consolidate.global", {}).get("joint", 0)
+        assert joint >= 1
+        confirms = env.registry.counter(m.DISRUPTION_HOST_CONFIRMS)
+        assert confirms.value(method="global") == joint
+
+
+@pytest.mark.slow
+class TestSeededParityAtScale:
+    def test_200_node_mix_parity(self, monkeypatch):
+        from perf.run import _fleet_cost
+
+        env_j = seeded_mixed_env(200, seed=7)
+        monkeypatch.setenv("KARPENTER_GLOBAL_CONSOLIDATION", "1")
+        converge(env_j)
+        monkeypatch.setenv("KARPENTER_GLOBAL_CONSOLIDATION", "0")
+        env_l = seeded_mixed_env(200, seed=7)
+        converge(env_l)
+        assert fleet(env_j)[1] == fleet(env_l)[1]
+        assert _fleet_cost(env_j) <= _fleet_cost(env_l) + 1e-9
+
+
+class TestFallbackMatrix:
+    """Every trigger hands the round to the ladder (or the sequential
+    rung) and the reference machinery still produces its end state."""
+
+    def test_disabled_records_sequential(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_GLOBAL_CONSOLIDATION", "0")
+        env = build_env(4)
+        cmd, method = compute_global(env)
+        assert cmd is None and method.last_rung == "sequential"
+        # the ladder still consolidates the round
+        cmd_m, _ = compute_multi(env)
+        assert cmd_m is not None
+
+    def test_inexpressible_candidate_pod_falls_back(self):
+        env = build_env(4)
+        # a volume-bearing pod is outside the device vocabulary
+        # (device_basic_eligible): every node hosting one is unprobeable,
+        # and a query naming all candidates cannot ride the joint ladder —
+        # the joint mode must answer sequential/inexpressible while the
+        # ladder's sequential search still owns the round
+        for p in [q for q in env.store.list("pods") if q.node_name]:
+            p.volumes = [{"name": "v", "persistentVolumeClaim": "pvc"}]
+            env.store.update("pods", p)
+        cmd, method = compute_global(env)
+        assert cmd is None
+        assert method.last_rung == "sequential"
+        cmd_seq, probe = compute_multi(env)
+        assert probe == "sequential"
+        assert cmd_seq is not None  # the reference search still decides
+
+    def test_topology_bundle_hands_round_to_ladder(self):
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.api.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+
+        env = build_env(4)
+        pods = [p for p in env.store.list("pods") if p.node_name]
+        for p in pods[:2]:
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "x"}))]
+            p.metadata.labels["app"] = "x"
+            env.store.update("pods", p)
+        cmd, method = compute_global(env)
+        assert cmd is None
+        assert method.last_rung == "ladder"
+        assert method.last_plan is not None
+        assert method.last_plan.reason == "topology-plan"
+        # the ladder (MultiNode on the waves-compiled bundle) still
+        # decides the round — the reference end state is preserved
+        cmd_dev, _ = compute_multi(env)
+        env2 = build_env(4)
+        for p in [q for q in env2.store.list("pods") if q.node_name][:2]:
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.TOPOLOGY_ZONE_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "x"}))]
+            p.metadata.labels["app"] = "x"
+            env2.store.update("pods", p)
+        from tests.test_batched_consolidation import compute
+
+        cmd_seq, _ = compute(env2, force_sequential=True)
+        assert (cmd_dev is None) == (cmd_seq is None)
+
+    def test_budget_gated_candidates_respect_budgets(self):
+        env = build_env(8)
+        for np_ in env.store.list("nodepools"):
+            np_.spec.disruption.budgets[0].nodes = "3"
+            env.store.update("nodepools", np_)
+        cmd, method = compute_global(env)
+        if cmd is not None:
+            assert len(cmd.candidates) <= 3
+        # convergence under the budget still reaches the packed floor —
+        # just over more rounds (the ladder's own pace)
+        converge(env)
+        nodes, pods = fleet(env)
+        assert pods == 8
+        assert nodes == 3
+
+    def test_repair_bound_falls_back_to_ladder(self, monkeypatch):
+        # a zero repair budget + a forced greedy failure: the joint mode
+        # must answer ladder/repair-bound, never ship an unrounded set
+        from karpenter_tpu.ops import consolidate as cons
+
+        env = build_env(8)
+        monkeypatch.setenv("KARPENTER_GLOBAL_REPAIR_MAX", "0")
+        monkeypatch.setattr(cons, "_greedy_displace",
+                            lambda *a, **k: None)
+        cmd, method = compute_global(env)
+        assert cmd is None
+        assert method.last_rung == "ladder"
+        assert method.last_plan.reason == "repair-bound"
+
+    def test_repair_steps_through_device_feasible_prefixes(
+            self, monkeypatch):
+        # shedding must jump to the next prefix the device ladder itself
+        # scored feasible — never re-derive prefixes the kernel already
+        # rejected — and `drops` reports candidates shed, attempts bound
+        # the budget
+        from karpenter_tpu.ops import consolidate as cons
+
+        bundle = SimpleNamespace(
+            base=np.zeros(1, np.int32),
+            snap=SimpleNamespace(G=1),
+            claimable_groups=lambda: np.ones(1, bool),
+            esnap=SimpleNamespace(live=np.ones(8, bool)),
+        )
+        monkeypatch.setattr(cons, "_greedy_displace", lambda *a, **k: None)
+        feasible = np.array([False, True, False, False, False, True])
+        args = (bundle, np.arange(6),
+                np.ones((6, 1), np.int32), 6, np.zeros(6), feasible)
+        monkeypatch.setenv("KARPENTER_GLOBAL_REPAIR_MAX", "1")
+        assert cons._round_repair(*args) == (2, None, 4)
+        monkeypatch.setenv("KARPENTER_GLOBAL_REPAIR_MAX", "2")
+        assert cons._round_repair(*args) == (0, None, 6)
+        monkeypatch.setenv("KARPENTER_GLOBAL_REPAIR_MAX", "0")
+        assert cons._round_repair(*args) == (6, None, 0)
+
+    def test_confirm_mismatch_falls_back_to_ladder(self, monkeypatch):
+        import karpenter_tpu.controllers.disruption.methods as M
+
+        env = build_env(8)
+        monkeypatch.setattr(
+            M, "compute_consolidation", lambda ctx, cands: None)
+        cmd, method = compute_global(env)
+        assert cmd is None
+        assert method.last_rung == "ladder"
+
+
+class TestUnknownPriceJointPath:
+    """ADVICE.md round 5: unknown (<=0) prices must keep the joint path
+    delete-only — `_prefix_criterion` (shared with the MultiNode ladder)
+    rejects every fresh-claim row whose prefix holds an unpriceable
+    candidate, and `candidate_prices`/`filter_out_same_type` guard the
+    confirm exactly as on the ladder."""
+
+    def _bundle(self, G=1, min_type_price=1.0):
+        snap = SimpleNamespace(
+            G=G,
+            type_refs=[(None, SimpleNamespace(name="xl"))],
+            off_price=np.array([[min_type_price]], dtype=np.float64),
+            off_avail=np.array([[True]]),
+        )
+        return SimpleNamespace(
+            base=np.zeros(G, dtype=np.int32),
+            snap=snap,
+            claimable_groups=lambda: np.ones(G, dtype=bool),
+        )
+
+    def _cands(self, prices):
+        return [
+            SimpleNamespace(price=p, instance_type=SimpleNamespace(name="c"))
+            for p in prices
+        ]
+
+    def test_unknown_price_rejects_claim_rows(self):
+        from karpenter_tpu.ops.consolidate import _prefix_criterion
+
+        bundle = self._bundle(min_type_price=0.5)
+        cands = self._cands([2.0, 0.0, 2.0])  # candidate 1 is unpriceable
+        cum = np.array([[1], [2], [3]], dtype=np.int64)
+        placed = np.array([[1], [2], [3]], dtype=np.int64)  # all pods land
+        used = np.array([1, 1, 1], dtype=np.int64)  # every row needs the claim
+        feasible, _ = _prefix_criterion(bundle, cands, cum, placed, used)
+        # prefix 1 is fully priced: the cheap offering may back its claim;
+        # prefixes 2 and 3 contain the unpriceable candidate — the replace
+        # path aborts for them (delete-only stance)
+        assert feasible.tolist() == [True, False, False]
+
+    def test_unknown_price_delete_only_rows_unaffected(self):
+        from karpenter_tpu.ops.consolidate import _prefix_criterion
+
+        bundle = self._bundle(min_type_price=0.5)
+        cands = self._cands([0.0, 0.0])
+        cum = np.array([[1], [2]], dtype=np.int64)
+        placed = np.array([[1], [2]], dtype=np.int64)
+        used = np.zeros(2, dtype=np.int64)  # pure deletes: no price involved
+        feasible, _ = _prefix_criterion(bundle, cands, cum, placed, used)
+        assert feasible.tolist() == [True, True]
+
+    def test_delisted_fleet_still_consolidates_delete_only(self):
+        # end-to-end: every offering price zeroed (delisted catalog) — the
+        # joint mode still retires nodes, but only ever as pure deletes
+        from karpenter_tpu.operator import metrics as m
+
+        env = build_env(8)
+        for np_ in env.store.list("nodepools"):
+            for it in env.disruption.cloud.get_instance_types(np_):
+                for o in it.offerings:
+                    o.price = 0.0
+        cmd, method = compute_global(env)
+        assert cmd is not None
+        assert cmd.action == "delete"
+        assert not cmd.replacements
+        converge(env)
+        nodes, pods = fleet(env)
+        assert pods == 8 and nodes == 3
+        acts = env.registry.counter(m.DISRUPTION_ACTIONS)
+        assert acts.value(action="replace") == 0
+
+
+class TestGlobalDispatchCapsule:
+    def test_joint_ladder_records_global_seam_and_replays(self, tmp_path):
+        from karpenter_tpu.obs import capsule
+        from karpenter_tpu.ops.consolidate import joint_retirement_plan
+
+        capsule.reset()
+        env = build_env(4)
+        d = env.disruption
+        candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                                    queue=d.queue)
+        assert candidates
+        plan = joint_retirement_plan(d.provisioner, d.cluster, d.store,
+                                     list(candidates))
+        assert plan is not None and plan.viable
+        rec = capsule.last_capture()
+        assert rec is not None and rec["seam"] == "global.dispatch"
+        path = capsule.write_capsule(
+            rec, path=str(tmp_path / "global.capsule.npz"), why="forced")
+        cap = capsule.load(path)
+        rep = capsule.replay(cap)
+        assert rep["parity"] == "exact"
+        rungs = [row["rung"] for row in capsule.ab_compare(cap)]
+        assert rungs == ["device", "native"]
+
+
+class TestLedgerSiteClosed:
+    def test_global_producers_are_enum_members(self):
+        import inspect
+        import re
+
+        from karpenter_tpu.controllers.disruption import methods
+        from karpenter_tpu.obs.decisions import SITES
+        from karpenter_tpu.ops import consolidate
+
+        src = inspect.getsource(methods)
+        produced = set(re.findall(
+            r'_verdict\("[a-z]+", "([a-z-]+)"\)', src))
+        csrc = inspect.getsource(consolidate)
+        produced |= set(re.findall(r'reason="([a-z-]+)"\)?', csrc))
+        assert '"repair-bound"' in csrc, (
+            "repair producer vanished — update the pin")
+        produced |= {"repair-bound"}
+        produced.discard("ok")
+        assert produced, "verdict producers vanished — update the pin"
+        assert produced <= SITES["consolidate.global"]["reasons"]
