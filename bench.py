@@ -27,11 +27,12 @@ committed BENCH_r*.json (same-engine records only — a CPU-ladder rescue
 is an environment event, not a regression) and, under `--consolidation`,
 a fresh `python -m perf --json 4` run is compared against the newest
 PERF_r*.json consolidation row, and a fresh `python -m perf global` run
-must hold the ISSUE-13 global-consolidation acceptance as a HARD gate:
+must hold the ISSUE-13/14 global-consolidation acceptance as a HARD gate:
 the joint 2000-node convergence inside its wall-clock budget
-(PERF_GLOBAL_BUDGET_MS, default 10 s), end cost ≤ the per-candidate
-ladder oracle's on an identical fleet, and exactly one confirming
-simulation per executed joint command — exit 3 on any violation. `--multitenant` adds the multi-tenant
+(PERF_GLOBAL_BUDGET_MS, default 5 s since ISSUE 14), end cost ≤ the
+per-candidate ladder oracle's on an identical fleet, exactly one
+confirming simulation per executed joint command, and at most one probe
+dispatch per cluster-state generation — exit 3 on any violation. `--multitenant` adds the multi-tenant
 fleet leg the same way: a fresh `python -m perf multitenant` run vs the
 newest committed multitenant row, on BOTH total wall clock and the
 concurrent worst-tenant p99 (baseline-gated — no committed row, no fresh
@@ -627,12 +628,16 @@ def _priority_pairs():
 def _global_pairs():
     """(sentinel pairs, hard-gate problems) for the global-consolidation
     leg (rides `--consolidation`): one fresh `python -m perf global` run
-    must hold the ISSUE-13 acceptance — the joint 2000-node convergence
-    inside its wall-clock budget (PERF_GLOBAL_BUDGET_MS, default 10 s),
-    end-state cost ≤ the per-candidate ladder oracle's on the identical
-    fleet, and exactly one confirming simulation per executed joint
-    command. Regression pairs compare the joint total_ms against the
-    newest committed PERF_r*.json row of the same config."""
+    must hold the ISSUE-13/14 acceptance — the joint 2000-node
+    convergence inside its wall-clock budget (PERF_GLOBAL_BUDGET_MS,
+    default 5 s since ISSUE 14), end-state cost ≤ the per-candidate
+    ladder oracle's on the identical fleet, exactly one confirming
+    simulation per executed joint command, and at most ONE probe
+    dispatch per cluster-state generation (the short-circuit contract —
+    gated only when the row carries the ISSUE-14 key, so pre-ISSUE-14
+    rows still parse). Regression pairs compare the joint total_ms
+    against the newest committed PERF_r*.json row of the same config,
+    old or new schema alike (both carry total_ms)."""
     fresh = _fresh_perf_rows(["global"])
     problems, pairs = [], []
     row = next((r for r in fresh.values()
@@ -657,6 +662,12 @@ def _global_pairs():
             f"global: {cfg} ran {row.get('confirm_count')} confirming "
             f"simulations for {row.get('joint_commands')} joint "
             "command(s) — the one-confirm-per-command contract broke")
+    if row.get("dispatch_contract_ok") is False:
+        problems.append(
+            f"global: {cfg} paid "
+            f"{row.get('max_dispatches_per_generation')} probe dispatches "
+            "in one cluster-state generation — the short-circuit's "
+            "max-one-dispatch-per-generation contract broke")
     base = _perf_baseline_rows().get(cfg)
     if base is not None and "total_ms" in base and "total_ms" in row:
         pairs.append((cfg, float(base["total_ms"]), float(row["total_ms"])))

@@ -52,6 +52,15 @@ class DisruptionContext:
         # consolidation probe and confirming simulation in a round
         # (ops/consolidate.py documents the invalidation contract)
         self.snapshot_cache = SnapshotCache()
+        # the round's joint-dispatch seed (ops/consolidate.py JointSeed):
+        # published by GlobalConsolidation, consumed by the MultiNode/
+        # SingleNode probes of the SAME generation so one state bump pays
+        # one device dispatch, not three (ISSUE 14 short-circuit)
+        self.joint_seed = None
+        # per-(generation, pool) memo of the shared candidate order
+        # (methods._candidate_order): all three consolidation methods
+        # sort the same objects within one round — pay it once
+        self.order_memo = None
 
 
 class DisruptionController:
@@ -206,16 +215,31 @@ class DisruptionController:
             return False
         fence = self.cluster.consolidation_state()
         ran_search = False
+        bundle_warmed = False
         for method in self.methods:
             if method.is_consolidation and fence == self._noop_fence:
                 continue  # nothing moved since the last fruitless search
             ran_search = ran_search or method.is_consolidation
+            if getattr(method, "uses_bundle", False) and not bundle_warmed:
+                # the round's shared snapshot belongs to the ROUND, not to
+                # whichever consolidation method happens to run first:
+                # acquire (build or delta-advance) it once here so the
+                # joint row's formulate_ms measures formulation, and the
+                # tensorization cost is attributable as bundle_ms
+                bundle_warmed = True
+                self._prewarm_bundle(candidates)
             with obs.span(f"method.{type(method).__name__}"), \
                     self.registry.measure(
                         m.DISRUPTION_EVAL_DURATION,
                         method=type(method).__name__):
                 cmd = method.compute_command(list(candidates), budgets)
             if cmd is None or not cmd.candidates:
+                if getattr(method, "fence_round", False):
+                    # the joint dispatch PROVED round-wide no-retirement
+                    # (deploy/README.md "Global consolidation"): the
+                    # remaining probes could only re-pay dispatches to
+                    # learn nothing — close the consolidation round
+                    break
                 continue
             if method.needs_validation:
                 self._pending = (cmd, method, self.clock.now())
@@ -230,6 +254,46 @@ class DisruptionController:
             # the flight-recorder ring
             obs.discard_round()
         return False
+
+    def _prewarm_bundle(self, candidates):
+        """Acquire the round's shared DisruptionSnapshot before the first
+        bundle-consuming method runs. This hoists the tensorization
+        (build or delta-advance) out of the joint ladder's formulate
+        window — the bundle serves Global/MultiNode/SingleNode AND every
+        confirming simulation of the round, so its cost is round
+        orchestration, reported as ``bundle_ms`` in the perf breakdown
+        (deploy/README.md "Global consolidation", perf-row schema). A
+        failed build is not fatal: methods re-attempt on demand and fall
+        back to their sequential rungs as before."""
+        import time as _time
+
+        from karpenter_tpu.controllers.disruption.methods import (
+            _consolidatable,
+        )
+        from karpenter_tpu.models.solver import TPUSolver
+        from karpenter_tpu.ops import consolidate as cons
+
+        if not isinstance(getattr(self.provisioner, "solver", None),
+                          TPUSolver):
+            return
+        pool = _consolidatable(candidates)
+        if len(pool) < 2:
+            return
+        t0 = _time.perf_counter()
+        with obs.span("disrupt.bundle", kind="cache",
+                      candidates=len(pool)):
+            try:
+                self.ctx.snapshot_cache.get(
+                    self.provisioner, self.cluster, self.store, pool,
+                    registry=self.registry)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "snapshot prewarm failed; methods build on demand",
+                    exc_info=True)
+        cons.GLOBAL_STATS["bundle_ms"] += (
+            _time.perf_counter() - t0) * 1000.0
 
     # -- validation TTL (validation.go:55-212) ---------------------------
     def _handle_pending(self) -> bool:

@@ -144,6 +144,97 @@ def _consolidatable(candidates):
     return out
 
 
+def _candidate_order(ctx, pool):
+    """ONE disruption-cost order shared by GlobalConsolidation,
+    MultiNodeConsolidation, and SingleNodeConsolidation — sharing it is
+    what lets the joint dispatch's seed answer the per-candidate probes
+    (ops/consolidate.py ``JointSeed`` aligns by pid sequence, and a
+    method sorting differently would decline every seed).
+
+    Primary key: ``disruption_cost`` (the reference's order). Secondary
+    key (ISSUE 14 — the first slice of the PR-11/PR-13 priority lever):
+    on EXACT cost ties only, prefer retiring the candidate displacing
+    lower-tier pods — effective priority per admission/priority.py's
+    apiserver matrix, keyed ``(max tier, summed tier)`` over the node's
+    reschedulable pods. The sort is stable and a priority-free fleet
+    resolves every tier key to ``(0, 0)``, so end states without
+    priorities are bit-identical to the plain cost sort. Tier
+    resolution is paid only for the candidates actually inside a tie
+    run, and the whole order is memoized per (generation, pool) on the
+    DisruptionContext — all three methods order the SAME candidate
+    objects within one round, so the second and third calls are a tuple
+    compare."""
+    cluster = getattr(ctx, "cluster", None)
+    key = None
+    if cluster is not None:
+        key = (cluster.consolidation_state(),
+               tuple(c.provider_id for c in pool))
+        memo = getattr(ctx, "order_memo", None)
+        if memo is not None and memo[0] == key:
+            return list(memo[1])
+    out = _compute_candidate_order(ctx, pool)
+    if key is not None:
+        ctx.order_memo = (key, out)
+    return list(out)
+
+
+def _compute_candidate_order(ctx, pool):
+    pool = sorted(pool, key=lambda c: c.disruption_cost)
+    if len(pool) < 2 or getattr(ctx, "store", None) is None:
+        return pool
+    # tie runs of equal cost: only their members pay tier resolution
+    runs = []
+    i = 0
+    while i < len(pool):
+        j = i + 1
+        while j < len(pool) and (
+                pool[j].disruption_cost == pool[i].disruption_cost):
+            j += 1
+        if j - i > 1:
+            runs.append((i, j))
+        i = j
+    if not runs:
+        return pool  # all distinct: the tie-break can never reorder
+    from karpenter_tpu.admission.priority import (
+        default_class,
+        resolve_priority,
+    )
+
+    classes = {
+        pc.metadata.name: pc for pc in ctx.store.list("priorityclasses")
+    }
+    dflt = default_class(classes)
+
+    def tier_key(c):
+        prios = [
+            resolve_priority(p, classes, dflt)[0]
+            for p in c.reschedulable_pods
+        ]
+        return (max(prios, default=0), sum(prios))
+
+    # a stable per-run re-sort is exactly the global (cost, tier) sort:
+    # runs are maximal equal-cost spans, so keys never cross runs
+    for i, j in runs:
+        pool[i:j] = sorted(pool[i:j], key=tier_key)
+    return pool
+
+
+def _seed_answer(ctx, cands, kind):
+    """The joint dispatch's seed answer for a per-candidate probe
+    (ops/consolidate.py ``JointSeed``), or None: the seed must be from
+    the SAME cluster-state generation (any state bump invalidates it)
+    and the querying method's candidate list must be a prefix of the
+    seeded pool in the shared order. Records nothing — the caller
+    records the probe.confirm verdict with reason ``joint-seeded``."""
+    seed = getattr(ctx, "joint_seed", None)
+    if seed is None or not seed.valid(getattr(ctx, "cluster", None)):
+        return None
+    pids = tuple(c.provider_id for c in cands)
+    if kind == "prefix":
+        return seed.prefix_answer(pids)
+    return seed.single_answer(pids)
+
+
 class EmptyNodeConsolidation(Method):
     """Bulk-delete empty nodes under WhenUnderutilized
     (disruption/emptynodeconsolidation.go:30-115)."""
@@ -424,6 +515,13 @@ def _global_cap() -> int:
 # candidate list could enqueue in one dispatch
 GLOBAL_CANDIDATE_CAP = 4096
 
+# fleets at or below this size always carry the single-candidate rows in
+# the joint dispatch (they're near-free there); larger fleets carry them
+# only after a noop verdict armed the hint or the bundle is
+# mid-transition — a fresh underutilized fleet's first dispatch (which
+# almost surely ships a command) skips ~N wasted rows
+GLOBAL_SINGLES_MAX = 256
+
 
 class GlobalConsolidation(Method):
     """Global consolidation: ONE joint device solve over ALL candidates
@@ -454,8 +552,24 @@ class GlobalConsolidation(Method):
     reason = REASON_UNDERUTILIZED
     needs_validation = True
     is_consolidation = True
+    uses_bundle = True  # the controller prewarms the round's snapshot
     last_rung: str = ""  # "joint" | "ladder" | "sequential" (tests + perf)
     last_plan = None  # the round's JointPlan (tests + observability)
+    # when True the controller closes the consolidation round after this
+    # method returns None: the joint dispatch PROVED round-wide
+    # no-retirement (every prefix and every single candidate infeasible,
+    # misses definitive) on a mid-transition snapshot — running the
+    # MultiNode/SingleNode probes would re-pay dispatches to learn
+    # nothing (deploy/README.md "Global consolidation", short-circuit)
+    fence_round: bool = False
+    # singles hint: armed after the method's FIRST dispatch-bearing round
+    # of the process. Every round after a ship or a noop is near-certain
+    # to answer no-retirement (the fleet was just consolidated, or
+    # already judged packed), and carrying the single rows lets that
+    # round seed or fence the whole ladder off its one dispatch — only
+    # the cold first solve of a process (the classic underutilized fleet
+    # that ships immediately) skips the ~N extra rows
+    _singles_armed: bool = False
 
     def _verdict(self, rung, reason="ok"):
         from karpenter_tpu.obs import decisions
@@ -466,12 +580,18 @@ class GlobalConsolidation(Method):
 
     def compute_command(self, candidates, budgets):
         self.last_plan = None
+        self.fence_round = False
         if not _global_enabled():
             self._verdict("sequential", "disabled")
             return None
-        pool = _consolidatable(candidates)
-        pool.sort(key=lambda c: c.disruption_cost)
-        cands = within_budget(budgets, self.reason, pool)[:_global_cap()]
+        pool = _candidate_order(self.ctx, _consolidatable(candidates))
+        allowed = within_budget(budgets, self.reason, pool)
+        cands = allowed[:_global_cap()]
+        # whether the joint dispatch saw EVERY budget-allowed candidate:
+        # a cap-truncated view can seed the capped MultiNode question but
+        # must never claim round-wide no-retirement (SingleNode's scan is
+        # uncapped, and candidates beyond the cap were never examined)
+        pool_complete = len(cands) == len(allowed)
         if len(cands) < 2:
             self._verdict("sequential", "too-few-candidates")
             return None
@@ -491,6 +611,14 @@ class GlobalConsolidation(Method):
                     cache=getattr(self.ctx, "snapshot_cache", None),
                     registry=self.ctx.registry,
                     build_candidates=pool,
+                    # singles hint: any round after the process's first
+                    # dispatch (or on small fleets, where the rows are
+                    # near-free) carries the per-candidate rows so the
+                    # verdict can seed/fence SingleNode too;
+                    # mid-transition bundles force them regardless
+                    # (joint_retirement_plan)
+                    want_singles=(self._singles_armed
+                                  or len(cands) <= GLOBAL_SINGLES_MAX),
                 )
         except Exception:
             import logging
@@ -507,6 +635,20 @@ class GlobalConsolidation(Method):
         if plan is None:
             self._verdict("sequential", "inexpressible")
             return None
+        if plan.prefix_feasible is not None:
+            self._singles_armed = True
+            # publish the dispatch's answers as the round's seed: the
+            # MultiNode/SingleNode probes below answer off it instead of
+            # re-paying a device dispatch for the same generation
+            from karpenter_tpu.ops.consolidate import JointSeed
+
+            self.ctx.joint_seed = JointSeed(
+                plan.generation,
+                [c.provider_id for c in cands],
+                plan.prefix_feasible,
+                plan.definitive,
+                plan.single_mask,
+            )
         if plan.timings.get("solve_ms") is not None:
             # rows were actually ranked (the dispatch ran — viable or
             # not), mirroring _device_probe's any-non-None stance
@@ -518,6 +660,24 @@ class GlobalConsolidation(Method):
                 buckets=m.PROBE_BATCH_BUCKETS,
             ).observe(len(cands), method="global")
         if not plan.viable:
+            if (plan.transient and plan.reason == "no-retirement"
+                    and plan.definitive and pool_complete
+                    and plan.single_mask is not None
+                    and not plan.single_mask.any()):
+                # provable round-wide noop off the one dispatch, on a
+                # MID-TRANSITION snapshot (pending or drain-in-flight
+                # pods): every prefix AND every single candidate is
+                # infeasible with definitive misses, so the ladder below
+                # could only re-learn it — close the round. The next
+                # state bump (the wave is still moving) re-probes; a
+                # SETTLED fleet's noop verdict deliberately does NOT
+                # fence, so the ladder's seeded descent still pays its
+                # paranoia confirms against the probe's residual f32
+                # false-negative corner — zero extra dispatches either
+                # way.
+                self._verdict("joint", "joint-noop-fenced")
+                self.fence_round = True
+                return None
             self._verdict("ladder", plan.reason)
             return None
         cmd = self._confirm(plan.selected)
@@ -563,8 +723,13 @@ class MultiNodeConsolidation(Method):
     reason = REASON_UNDERUTILIZED
     needs_validation = True
     is_consolidation = True
-    last_probe: str = ""  # "device" | "sequential" (observability + tests)
+    uses_bundle = True
+    # "device" | "seeded" | "sequential" (observability + tests) —
+    # "seeded" means the answer came from the round's joint dispatch
+    # (JointSeed) without paying a second device dispatch
+    last_probe: str = ""
     last_host_confirms: int = 0  # host simulations this round (tests + perf)
+    _seeded: bool = False
 
     def compute_command(self, candidates, budgets):
         # reset BEFORE the search: an early return inside _compute (fewer
@@ -572,6 +737,7 @@ class MultiNodeConsolidation(Method):
         # fire a spurious anomaly on a quiet round
         self.last_host_confirms = 0
         self.last_probe = ""
+        self._seeded = False
         cmd = self._compute(candidates, budgets)
         if self.last_host_confirms > 1:
             # anomaly trigger: the batched confirm ladder targets exactly
@@ -585,8 +751,7 @@ class MultiNodeConsolidation(Method):
         return cmd
 
     def _compute(self, candidates, budgets):
-        pool = _consolidatable(candidates)
-        pool.sort(key=lambda c: c.disruption_cost)
+        pool = _candidate_order(self.ctx, _consolidatable(candidates))
         cands = within_budget(budgets, self.reason, pool)[:MULTI_NODE_CANDIDATE_CAP]
         if len(cands) < 2:
             return None
@@ -595,17 +760,22 @@ class MultiNodeConsolidation(Method):
         probed = self._probe(cands, pool)
         if probed is not None:
             k, definitive = probed
-            self.last_probe = "device"
+            self.last_probe = "seeded" if self._seeded else "device"
             # the round's probe.confirm verdict (obs/decisions.py): a
             # definitive ladder pays ONE confirming simulation; a
             # non-definitive one keeps the gallop/search around the seed.
-            # The sequential rungs were recorded by _device_probe.
+            # Seeded answers (the joint dispatch already ranked these
+            # prefixes this generation — no second dispatch) carry the
+            # joint-seeded reason so the skipped-probe path is accounted,
+            # never silent. The sequential rungs were recorded by
+            # _device_probe.
             from karpenter_tpu.obs import decisions
 
             decisions.record_decision(
                 "probe.confirm",
                 "definitive" if definitive else "gallop",
-                "ok" if definitive else "non-definitive",
+                ("joint-seeded" if self._seeded
+                 else "ok" if definitive else "non-definitive"),
                 registry=self.ctx.registry)
             if k < 2:
                 # paranoia confirm of the smallest prefix guards the
@@ -641,6 +811,10 @@ class MultiNodeConsolidation(Method):
     def _probe(self, cands, pool=None):
         from karpenter_tpu.ops.consolidate import batched_feasible_prefix
 
+        seeded = _seed_answer(self.ctx, cands, "prefix")
+        if seeded is not None:
+            self._seeded = True
+            return seeded
         return _device_probe(self.ctx, batched_feasible_prefix, "multi",
                              cands, pool)
 
@@ -697,11 +871,14 @@ class SingleNodeConsolidation(Method):
     reason = REASON_UNDERUTILIZED
     needs_validation = True
     is_consolidation = True
-    last_probe: str = ""  # "device" | "sequential" (observability + tests)
+    uses_bundle = True
+    # "device" | "seeded" | "sequential" (observability + tests)
+    last_probe: str = ""
+    _seeded: bool = False
 
     def compute_command(self, candidates, budgets):
-        pool = _consolidatable(candidates)
-        pool.sort(key=lambda c: c.disruption_cost)
+        self._seeded = False
+        pool = _candidate_order(self.ctx, _consolidatable(candidates))
         cands = within_budget(budgets, self.reason, pool)
         if not cands:
             return None
@@ -712,15 +889,17 @@ class SingleNodeConsolidation(Method):
             res = self._scan(cands, deadline)
             return None if res is _TIMED_OUT else res
         feas, definitive = probed
-        self.last_probe = "device"
+        self.last_probe = "seeded" if self._seeded else "device"
         # one probe.confirm verdict per ladder descent, mirroring
-        # MultiNode's (sequential rungs recorded by _device_probe)
+        # MultiNode's (sequential rungs recorded by _device_probe;
+        # joint-seeded answers paid no dispatch of their own)
         from karpenter_tpu.obs import decisions
 
         decisions.record_decision(
             "probe.confirm",
             "definitive" if definitive else "gallop",
-            "ok" if definitive else "non-definitive",
+            ("joint-seeded" if self._seeded
+             else "ok" if definitive else "non-definitive"),
             registry=self.ctx.registry)
         # confirm hits in disruption-cost order; probe misses are only
         # SKIPPED, never discarded: when a hit confirms, any miss that
@@ -806,5 +985,9 @@ class SingleNodeConsolidation(Method):
     def _probe(self, cands, pool=None):
         from karpenter_tpu.ops.consolidate import batched_single_feasible
 
+        seeded = _seed_answer(self.ctx, cands, "single")
+        if seeded is not None:
+            self._seeded = True
+            return seeded
         return _device_probe(self.ctx, batched_single_feasible, "single",
                              cands, pool)

@@ -11,10 +11,22 @@ replacement claims are left for the emptiness path to reap.
 
 from __future__ import annotations
 
+import time
+
 from karpenter_tpu.api import labels as wk
 from karpenter_tpu.api.objects import Taint
 
 MAX_RETRY_DURATION = 10 * 60.0  # queue.go:56
+
+# process-wide command-orchestration accounting, delta'd by
+# `python -m perf global` (the orchestrate_ms slice of the post-command
+# wave's breakdown — replacement waits, candidate-claim deletion,
+# rollbacks; the drain and rebind halves live in
+# controllers/node/termination.py and kube/binder.py STATS)
+STATS = {
+    "orchestrate_ms": 0.0,
+    "polls": 0,
+}
 
 DISRUPTION_TAINT = Taint(
     key=wk.DISRUPTION_TAINT_KEY, value=wk.DISRUPTION_TAINT_VALUE, effect="NoSchedule"
@@ -56,6 +68,9 @@ class OrchestrationQueue:
         self.commands.append(command)
 
     def poll(self) -> bool:
+        if not self.commands:
+            return False
+        t0 = time.perf_counter()
         progressed = False
         remaining = []
         for cmd in self.commands:
@@ -64,6 +79,8 @@ class OrchestrationQueue:
             if not done:
                 remaining.append(cmd)
         self.commands = remaining
+        STATS["orchestrate_ms"] += (time.perf_counter() - t0) * 1000.0
+        STATS["polls"] += 1
         return progressed
 
     def _reconcile(self, cmd) -> tuple:

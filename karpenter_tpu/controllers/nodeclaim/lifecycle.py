@@ -46,11 +46,29 @@ class NodeClaimLifecycleController:
         pass  # reconciled via poll() sweeps in the hermetic runtime
 
     def poll(self) -> bool:
-        progressed = False
-        for claim in list(self.store.list("nodeclaims")):
-            if self.reconcile(claim):
-                progressed = True
-        return progressed
+        claims = list(self.store.list("nodeclaims"))
+        if not claims:
+            return False
+        # one providerID→node index per poll: `_node_for` per claim was a
+        # full node scan, O(claims × nodes) per poll — it dominated the
+        # post-command wave at fleet scale, where every retired claim's
+        # finalizer walks the lookup several times. A node _launch creates
+        # mid-poll belongs to the claim that just launched (which already
+        # returned for this poll), so the index cannot serve a stale miss
+        # to any OTHER claim; deletion timestamps are visible through the
+        # shared object identity.
+        self._nodes_by_pid = {}
+        for node in self.store.list("nodes"):
+            if node.provider_id:
+                self._nodes_by_pid.setdefault(node.provider_id, node)
+        try:
+            progressed = False
+            for claim in claims:
+                if self.reconcile(claim):
+                    progressed = True
+            return progressed
+        finally:
+            self._nodes_by_pid = None
 
     def reconcile(self, claim) -> bool:
         if claim.metadata.deletion_timestamp is not None:
@@ -170,9 +188,13 @@ class NodeClaimLifecycleController:
                       nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""))
         return True
 
+    _nodes_by_pid = None  # per-poll providerID index (see poll)
+
     def _node_for(self, claim):
         if not claim.status.provider_id:
             return None
+        if self._nodes_by_pid is not None:
+            return self._nodes_by_pid.get(claim.status.provider_id)
         for node in self.store.list("nodes"):
             if node.provider_id == claim.status.provider_id:
                 return node

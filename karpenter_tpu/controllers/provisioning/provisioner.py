@@ -142,6 +142,8 @@ class Provisioner:
         self._change_monitor = ChangeMonitor(clock=self.clock)
         self.cluster = cluster  # state plane (M4); optional
         self._admission = None  # admission plane (priority/gang), lazy
+        # unschedulable-pod retry clock (see _maybe_retry_pending)
+        self._pending_retry_at: float = -1e18
 
     # -- triggering (provisioning/controller.go:52-107) ------------------
     def on_event(self, event):
@@ -152,9 +154,53 @@ class Provisioner:
         elif event.kind == "nodes" and event.type == "Modified":
             if event.obj.metadata.deletion_timestamp is not None:
                 self.batcher.trigger()
+        elif event.kind == "nodeclaims" and event.type == "Deleted":
+            # a reaped UNREGISTERED claim (liveness TTL, insufficient-
+            # capacity rollback) strands any pod nominated onto capacity
+            # that will now never materialize: re-arm the batcher so the
+            # next round re-solves those pods. The reference's scheduler
+            # retries unschedulable pods on a timer; the hermetic runtime
+            # is event-driven and must be told. (Pre-ISSUE-14 this was
+            # masked by the leader re-acquiring its own stale lease and
+            # resyncing — a side effect, not a contract.) REGISTERED
+            # claims are exempt: their node's drain path owns the pods
+            # (evict → recreate → bind), and re-triggering on every
+            # consolidation-wave claim deletion would re-solve the whole
+            # displaced set the binder is about to place.
+            from karpenter_tpu.api.nodeclaim import COND_REGISTERED
+
+            if not event.obj.is_true(COND_REGISTERED):
+                self.batcher.trigger()
 
     def trigger(self):
         self.batcher.trigger()
+
+    # how often unschedulable pending pods are re-examined without any
+    # triggering event — the kube-scheduler's unschedulable-queue retry
+    # (and the reference provisioner's periodic singleton reconcile)
+    # compressed to the hermetic runtime
+    PENDING_RETRY_PERIOD = 10.0
+
+    def _maybe_retry_pending(self) -> bool:
+        """Re-arm the batcher for unschedulable pending pods on a slow
+        clock, with no triggering event required: capacity can return
+        WITHOUT one — an in-place offering flip after an ICE storm, a
+        reaped unregistered claim, a PDB releasing — and a purely
+        event-driven batcher would strand those pods forever. (The
+        pre-ISSUE-14 accidental rescue was the leader resyncing on its
+        own stale lease.) At most one pod-list scan per
+        PENDING_RETRY_PERIOD of wall clock, so idle rounds between clock
+        steps stay free; the fake clock only moves between test rounds,
+        bounding this to one retry per step."""
+        now = self.clock.now()
+        if now - self._pending_retry_at < self.PENDING_RETRY_PERIOD:
+            return False
+        self._pending_retry_at = now
+        if any(pod_util.is_provisionable(p)
+               for p in self.store.list("pods")):
+            self.batcher.trigger()
+            return True
+        return False
 
     @property
     def pending_trigger(self) -> bool:
@@ -162,7 +208,7 @@ class Provisioner:
 
     # -- the solve round (provisioner.go Schedule:316) -------------------
     def reconcile(self) -> bool:
-        if not self.batcher.triggered:
+        if not self.batcher.triggered and not self._maybe_retry_pending():
             return False
         if not self.batcher.ready():
             return False

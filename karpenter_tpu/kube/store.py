@@ -210,6 +210,56 @@ class KubeStore(KubeClient):
                         )
             self.delete("pods", pod)
 
+    def evict_wave(self, pods):
+        """One PDB-checked eviction WAVE: the batched form of
+        :meth:`evict` the drain orchestration uses (node termination
+        drains whole command waves — thousands of pods — and per-pod
+        ``evict`` recomputes every matching PDB's allowance from a full
+        pod-list scan each time). Returns ``(evicted, blocked)`` lists.
+
+        Semantics are EXACTLY sequential ``evict`` calls in ``pods``
+        order: each pod's check sees every earlier deletion of the wave.
+        The batching is pure memoization — a PDB's allowance is computed
+        once and reused until a pod MATCHING that PDB is deleted (only a
+        matching pod's deletion can move its counts), then lazily
+        recomputed; the lock is held across the wave, so the PDB set
+        itself cannot change mid-wave."""
+        evicted, blocked = [], []
+        with self._lock:
+            pdbs_by_ns: dict = {}
+            allowance: dict = {}  # (ns, pdb name) -> disruptions allowed
+            for pod in pods:
+                ns = pod.namespace
+                pdbs = pdbs_by_ns.get(ns)
+                if pdbs is None:
+                    pdbs = pdbs_by_ns[ns] = [
+                        pdb for pdb in self.list("pdbs", namespace=ns)
+                        if pdb.selector is not None
+                    ]
+                matching = [
+                    pdb for pdb in pdbs
+                    if pdb.selector.matches(pod.metadata.labels)
+                ]
+                allowed = True
+                for pdb in matching:
+                    key = (ns, pdb.metadata.name)
+                    a = allowance.get(key)
+                    if a is None:
+                        a = allowance[key] = self._disruptions_allowed(pdb)
+                    if a <= 0:
+                        allowed = False
+                        break
+                if not allowed:
+                    blocked.append(pod)
+                    continue
+                self.delete("pods", pod)
+                for pdb in matching:
+                    # a matching pod left the pod set: the memoized
+                    # allowance is stale — recompute on next sight
+                    allowance.pop((ns, pdb.metadata.name), None)
+                evicted.append(pod)
+        return evicted, blocked
+
     def _disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
         pods = [
             p

@@ -133,11 +133,14 @@ SITES = {
         # controllers/disruption/methods.py: how a consolidation method's
         # probe ladder resolved — definitive (one confirming simulation),
         # gallop (device seed + sequential recovery), or the reference's
-        # sequential search outright.
+        # sequential search outright. joint-seeded = the answer came from
+        # the round's joint dispatch (ops/consolidate.py JointSeed)
+        # without a second device dispatch — the ISSUE-14 short-circuit's
+        # accounted, never-silent skipped-probe path.
         "rungs": ("definitive", "gallop", "sequential"),
         "reasons": frozenset({
             "ok", "non-definitive", "inexpressible", "probe-error",
-            "no-device", OTHER_REASON,
+            "no-device", "joint-seeded", OTHER_REASON,
         }),
     },
     "consolidate.global": {
@@ -150,16 +153,20 @@ SITES = {
         # repair-bound, probe-error, and inexpressible stay armed — a
         # steady 2k fleet quietly descending to the ladder every round is
         # exactly the regression this site exists to catch.
+        # joint-noop-fenced = the joint dispatch PROVED round-wide
+        # no-retirement on a mid-transition snapshot and the controller
+        # closed the round without running the MultiNode/SingleNode
+        # probes (ISSUE-14 short-circuit) — workload-driven, benign.
         "rungs": ("joint", "ladder", "sequential"),
         "reasons": frozenset({
             "ok", "no-retirement", "non-definitive", "confirm-mismatch",
             "repair-bound", "topology-plan", "inexpressible",
             "probe-error", "no-device", "disabled", "too-few-candidates",
-            OTHER_REASON,
+            "joint-noop-fenced", OTHER_REASON,
         }),
         "benign": frozenset({
             "no-retirement", "non-definitive", "topology-plan", "disabled",
-            "too-few-candidates", "no-device",
+            "too-few-candidates", "no-device", "joint-noop-fenced",
         }),
     },
     "solver.route": {

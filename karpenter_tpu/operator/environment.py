@@ -181,12 +181,18 @@ class Environment:
         it (state/informer/*)."""
         was_leader = self.elector.is_leader()
         leading = self.elector.try_acquire()
-        if leading and not was_leader:
+        if leading and not was_leader and self.elector.last_acquire_takeover:
             # takeover: warm the informer cache from the store snapshot —
             # the hermetic store's event queue is single-consumer, so a
             # standby has not seen the events the old leader drained — and
             # arm the batcher: pod events the old leader consumed but never
-            # finished reconciling must not strand pending pods
+            # finished reconciling must not strand pending pods. Renewing
+            # our OWN stale lease (clock jumped past the duration with no
+            # contender) is NOT a takeover: the holder never changed, so
+            # nobody else drained events and the informer state is
+            # continuous — resyncing there would journal an opaque
+            # consolidation bump (and rebuild every cached snapshot) each
+            # time the clock outruns the lease
             self.cluster.resync()
             self.provisioner.trigger()
         if not leading:

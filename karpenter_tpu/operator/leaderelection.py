@@ -49,6 +49,17 @@ class LeaderElector:
             and self.clock.now() - lease.renewed < self.lease_duration
         )
 
+    # whether the most recent successful try_acquire TOOK the lease
+    # (first creation, or expiry takeover from another holder) rather
+    # than renewing this identity's own: only a real takeover requires
+    # the informer-cache resync — the store's watch queue is
+    # single-consumer and only the leader drains it, so a leader
+    # re-acquiring its OWN stale lease (a fake-clock jump, a long GC
+    # pause with no contender) has missed nothing, and resyncing there
+    # would needlessly journal an opaque consolidation bump every time
+    # the clock outruns the lease duration
+    last_acquire_takeover: bool = False
+
     def try_acquire(self) -> bool:
         """Acquire or renew; True iff this identity holds the lease after
         the call (leaderelection.go tryAcquireOrRenew)."""
@@ -60,12 +71,16 @@ class LeaderElector:
                 self.store.create("leases", lease)
             except Exception:
                 return self.is_leader()  # lost the race
+            self.last_acquire_takeover = True
             return True
         expired = now - lease.renewed >= lease.duration
         if lease.holder == self.identity:
             # renew at most once per RETRY_PERIOD: an update per reconcile
             # round would flood the watch stream (and read as progress to
-            # idle detection)
+            # idle detection). Renewing our OWN lease — even one the clock
+            # let expire — is not a takeover: the holder never changed, so
+            # no other instance can have drained the event queue meanwhile
+            self.last_acquire_takeover = False
             if now - lease.renewed >= RETRY_PERIOD:
                 lease.renewed = now
                 self.store.update("leases", lease)
@@ -75,6 +90,7 @@ class LeaderElector:
             lease.acquired = now
             lease.renewed = now
             self.store.update("leases", lease)
+            self.last_acquire_takeover = True
             return True
         return False
 

@@ -52,6 +52,33 @@ fallback ladder, confirm contract, and the ``consolidate.global`` ledger
 site are documented in deploy/README.md ("Global consolidation"); the
 joint dispatch records the ``global.dispatch`` replay-capsule seam.
 
+ISSUE 14 makes the whole disruption round device-bound end to end:
+
+* **Vectorized formulation.** The bundle caches a per-node-row ``[E,G]``
+  contribution matrix (built lazily from its own node snapshots,
+  row-invalidated by delta advances — a delta-advanced snapshot reuses
+  the prior round's formulation rows) and :meth:`DisruptionSnapshot.
+  contribs_for` is one fancy-index gather; :func:`_prefix_criterion`'s
+  per-type price vectors are cached the same way and its cheapest-cum
+  pass is one ``minimum.accumulate`` per present type. The original
+  per-candidate Python loops stay as the bit-exactness ORACLE —
+  ``KARPENTER_GLOBAL_FORMULATE_LOOP=1`` forces them everywhere, and the
+  parity suite pins gather ≡ loop across 100+ seeded snapshots.
+* **Joint-verdict short-circuit.** :func:`joint_retirement_plan` can
+  carry the per-candidate SINGLE rows in the same dispatch (scored by
+  the shared :func:`_single_criterion`), and its answers publish as the
+  round's :class:`JointSeed` — the MultiNode/SingleNode probes of the
+  SAME generation answer off it (``probe.confirm`` reason
+  ``joint-seeded``) instead of re-dispatching, and a definitive
+  mid-transition no-retirement verdict closes the round outright
+  (``consolidate.global`` ``joint-noop-fenced``). One state bump pays
+  ONE dispatch; :func:`note_probe_dispatch` accounts the per-generation
+  contract perf/bench gate on.
+* The round's bundle acquisition is hoisted into the controller's
+  prewarm (``bundle_ms``), and the post-command wave batches through
+  the store's ``evict_wave`` — see deploy/README.md "Global
+  consolidation" for the row schema and knob table.
+
 Snapshot-cache invalidation contract
 ------------------------------------
 
@@ -140,6 +167,7 @@ from __future__ import annotations
 
 import functools
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -163,12 +191,64 @@ from karpenter_tpu.ops.tensorize import (
 # kernel instead of paying a fresh XLA compile per fleet size
 PROBE_CHUNK_ROWS = 128
 
+# the native (C++) probe entry has no XLA compile to re-key, so its only
+# chunking constraint is the counterfactual e_avail materialization
+# (rows × E × R floats) — and the engine rebuilds feasibility once per
+# chunk, so a 2k-row joint ladder at 128-row chunks paid 16 redundant
+# builds. 1024 rows × 2048 nodes × a handful of resources stays in the
+# tens of MB; results are row-independent, so the chunk size can never
+# change an answer (replay included: the capsule re-executes through
+# this same constant).
+NATIVE_PROBE_CHUNK_ROWS = 1024
+
 
 def _pow2(n: int, lo: int = 8) -> int:
     """Next power of two >= n (>= lo) — the probe's pad ladder."""
     import math
 
     return max(lo, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+def _formulate_loop() -> bool:
+    """The vectorized-formulation oracle knob (ISSUE 14):
+    ``KARPENTER_GLOBAL_FORMULATE_LOOP=1`` forces the original
+    per-candidate Python loops (``_contribs_loop`` and the
+    ``_cheapest_cum_loop`` half of :func:`_prefix_criterion`) everywhere
+    the batched array construction would otherwise run — the bit-exactness
+    oracle the parity suite pins the gather against, and the production
+    kill-switch if a gather bug ever surfaces in the field."""
+    from karpenter_tpu.utils.envknobs import env_bool
+
+    return env_bool("KARPENTER_GLOBAL_FORMULATE_LOOP", False)
+
+
+# per-generation probe-dispatch accounting (ISSUE 14): the short-circuit
+# contract is ONE batched probe dispatch per cluster-state generation on
+# short-circuited rounds — `python -m perf global` reads the max over its
+# run (`max_dispatches_per_generation`) and bench.py hard-gates it; the
+# seeded slow tests pin it directly. Bounded LRU so a long-lived process
+# never grows the log without limit.
+_DISPATCH_GEN_CAP = 4096
+DISPATCHES_BY_GEN: "OrderedDict[int, int]" = OrderedDict()
+
+
+def note_probe_dispatch(generation) -> None:
+    """One probe-dispatch invocation (prefix, single, or joint rows)
+    against a bundle at ``generation`` — called by
+    :meth:`DisruptionSnapshot.dispatch`, the one funnel every
+    consolidation counterfactual batch runs through."""
+    n = DISPATCHES_BY_GEN.pop(generation, 0)
+    DISPATCHES_BY_GEN[generation] = n + 1
+    while len(DISPATCHES_BY_GEN) > _DISPATCH_GEN_CAP:
+        DISPATCHES_BY_GEN.popitem(last=False)
+
+
+def reset_dispatch_log() -> None:
+    DISPATCHES_BY_GEN.clear()
+
+
+def max_dispatches_per_generation() -> int:
+    return max(DISPATCHES_BY_GEN.values(), default=0)
 
 
 @functools.lru_cache(maxsize=8)
@@ -251,6 +331,21 @@ class DisruptionSnapshot:
         self._shared = None
         self._dims = None
         self._claimable = None
+        # vectorized-formulation row cache (ISSUE 14): per existing-node
+        # row, the reschedulable-pod contribution over the group axis —
+        # built lazily from the bundle's own node snapshots, row-
+        # invalidated by delta advances, and GATHERED by contribs_for so
+        # a 2k-candidate formulation is one fancy-index instead of a
+        # Python loop over every candidate pod (the loop stays as the
+        # oracle, KARPENTER_GLOBAL_FORMULATE_LOOP=1)
+        self._contrib_rows = None  # [E, G] int32
+        self._contrib_ok = None  # [E] bool: every pod of the row mapped
+        self._contrib_built = None  # [E] bool: row computed since dirty
+        # _prefix_criterion's static half: cheapest available offering
+        # price per instance-type name (the tensorized offering tables
+        # never mutate within a bundle's lifetime — catalog flips arrive
+        # via rebuilds, and probe answers are seeds either way)
+        self._type_prices = None
         # why the most recent delta-advance attempt declined (the
         # snapshot.advance decision ledger's rebuild reason — one of the
         # site's closed-enum causes, obs/decisions.py)
@@ -267,10 +362,39 @@ class DisruptionSnapshot:
             cols.append(col)
         return cols
 
-    def contribs_for(self, candidates):
+    def contribs_for(self, candidates, cols=None):
         """[N,G] per-candidate reschedulable-pod counts over the snapshot's
         group axis; None when a pod is missing from the snapshot (a stale
-        view the generation key should have caught — stay sequential)."""
+        view the generation key should have caught — stay sequential).
+
+        The default path GATHERS rows from the bundle's cached [E,G]
+        contribution matrix — built lazily from the bundle's own node
+        snapshots (same generation as the candidates, so the same pod
+        sets) and row-invalidated by delta advances, which is what lets a
+        delta-advanced snapshot reuse the prior round's formulation rows.
+        ``cols`` optionally carries an already-resolved ``columns_for``
+        result so the three probe entry points don't pay the lookup
+        twice. Any candidate without a cached row (invisible to the
+        bundle, or a row whose pods failed to map) falls back to
+        ``_contribs_loop`` — the original per-candidate loop, bit-exact by
+        definition and forced everywhere by
+        ``KARPENTER_GLOBAL_FORMULATE_LOOP=1`` (the parity oracle)."""
+        if _formulate_loop():
+            return self._contribs_loop(candidates)
+        if cols is None:
+            cols = self.columns_for(candidates)
+        if cols is None:
+            return self._contribs_loop(candidates)
+        rows = np.asarray(cols, dtype=np.intp)
+        self._ensure_contrib_rows(rows)
+        if not self._contrib_ok[rows].all():
+            return self._contribs_loop(candidates)
+        return self._contrib_rows[rows]
+
+    def _contribs_loop(self, candidates):
+        """The original per-candidate Python loop — the vectorized
+        gather's bit-exactness oracle, and the fallback whenever a
+        candidate falls outside the cached matrix."""
         G = self.snap.G
         contrib = np.zeros((len(candidates), G), dtype=np.int32)
         for j, c in enumerate(candidates):
@@ -280,6 +404,68 @@ class DisruptionSnapshot:
                     return None
                 contrib[j, g] += 1
         return contrib
+
+    def _ensure_contrib_rows(self, rows):
+        """Materialize the cached contribution rows the gather needs.
+        Each row is computed ONCE from the bundle's node snapshot (the
+        same pod set a same-generation Candidate carries) and reused
+        until a delta advance dirties it."""
+        E, G = self.esnap.E, self.snap.G
+        if self._contrib_rows is None or len(self._contrib_rows) < E:
+            old_rows, old_ok, old_built = (
+                self._contrib_rows, self._contrib_ok, self._contrib_built)
+            self._contrib_rows = np.zeros((E, G), dtype=np.int32)
+            self._contrib_ok = np.zeros(E, dtype=bool)
+            self._contrib_built = np.zeros(E, dtype=bool)
+            if old_rows is not None:
+                k = len(old_rows)
+                self._contrib_rows[:k] = old_rows
+                self._contrib_ok[:k] = old_ok
+                self._contrib_built[:k] = old_built
+        for r in np.unique(rows[~self._contrib_built[rows]]):
+            self._build_contrib_row(int(r))
+
+    def _build_contrib_row(self, r):
+        row = self._contrib_rows[r]
+        row[:] = 0
+        ok = True
+        for p in self.enodes[r].state_node.reschedulable_pods():
+            g = self.gidx_of.get(p.uid)
+            if g is None:
+                ok = False  # unmapped pod: the loop oracle answers None
+                break
+            row[g] += 1
+        self._contrib_ok[r] = ok
+        self._contrib_built[r] = True
+
+    def _contrib_invalidate(self, pids):
+        """Mark rows dirty after a delta advance: the next gather
+        recomputes exactly these rows and keeps every other one."""
+        if self._contrib_built is None:
+            return
+        E = self.esnap.E
+        if len(self._contrib_built) < E:
+            grow = E - len(self._contrib_built)
+            self._contrib_rows = np.concatenate(
+                [self._contrib_rows,
+                 np.zeros((grow, self.snap.G), dtype=np.int32)])
+            self._contrib_ok = np.concatenate(
+                [self._contrib_ok, np.zeros(grow, dtype=bool)])
+            self._contrib_built = np.concatenate(
+                [self._contrib_built, np.zeros(grow, dtype=bool)])
+        for pid in pids:
+            r = self.esnap.row_of.get(pid)
+            if r is not None:
+                self._contrib_built[r] = False
+
+    def type_price_vectors(self):
+        """``(p_cat, name_idx)``: cheapest AVAILABLE offering price per
+        instance-type NAME over the snapshot's catalog — the static half
+        of :func:`_prefix_criterion`'s same-type ladder, cached on the
+        bundle so every probe invocation stops re-scanning the T-axis."""
+        if self._type_prices is None:
+            self._type_prices = _type_price_vectors(self.snap)
+        return self._type_prices
 
     def claimable_groups(self):
         """[G] bool — groups a fresh claim could ever be opened for
@@ -438,6 +624,11 @@ class DisruptionSnapshot:
             self.snap, dirty=dirty_nodes, removed=removed, added=added_nodes,
             registry=registry,
         )
+        # formulation rows ride the delta too: exactly the touched rows
+        # recompute on next gather, every other row is reused verbatim
+        self._contrib_invalidate(
+            [en.state_node.provider_id for en in dirty_nodes]
+            + added_pids + removed)
         for pid in added_pids:
             self.col_by_pid[pid] = esnap.row_of[pid]
             self.build_key.add(pid)
@@ -576,6 +767,9 @@ class DisruptionSnapshot:
         NATIVE_CUTOFF_PODS stance): few-group batches are short sequential
         loops the native engine finishes without paying an XLA compile per
         fleet-size family."""
+        # per-generation invocation accounting: the short-circuit contract
+        # (one probe dispatch per generation) is read off this log
+        note_probe_dispatch(self.generation)
         if self._native_routable():
             try:
                 return self._dispatch_native(g_count_k, e_zero_cols, seam)
@@ -738,8 +932,8 @@ def dispatch_counterfactual_rows_native(shared, Gp, Ep, e_avail, max_minv,
     rows = g_count_k.shape[0]
     placed_g = np.empty((rows, Gp), dtype=np.int64)
     used = np.empty(rows, dtype=np.int64)
-    for lo in range(0, rows, PROBE_CHUNK_ROWS):
-        hi = min(lo + PROBE_CHUNK_ROWS, rows)
+    for lo in range(0, rows, NATIVE_PROBE_CHUNK_ROWS):
+        hi = min(lo + NATIVE_PROBE_CHUNK_ROWS, rows)
         n = hi - lo
         e_chunk = np.repeat(e_avail[None, :, :], n, axis=0)
         for i in range(n):
@@ -1073,7 +1267,7 @@ def batched_feasible_prefix(provisioner, cluster, store, candidates,
     cols = bundle.columns_for(candidates)
     if cols is None:
         return None
-    contrib = bundle.contribs_for(candidates)
+    contrib = bundle.contribs_for(candidates, cols=cols)
     if contrib is None:
         return None
 
@@ -1126,41 +1320,51 @@ def batched_single_feasible(provisioner, cluster, store, candidates,
     cols = bundle.columns_for(candidates)
     if cols is None:
         return None
-    contrib = bundle.contribs_for(candidates)
+    contrib = bundle.contribs_for(candidates, cols=cols)
     if contrib is None:
         return None
 
     base = bundle.base
     N = len(candidates)
-    G = bundle.snap.G
     g_count_k = base[None, :] + contrib  # [N,G]
     col_arr = np.asarray(cols, dtype=np.intp)
     # row c removes ONLY candidate c
     e_zero_cols = [col_arr[c : c + 1] for c in range(N)]
 
     placed_g, used = bundle.dispatch(g_count_k, e_zero_cols)
-    # same group-wise criterion as the prefix probe: candidate c's pods all
-    # land iff every group places at least c's contribution (stuck pending
-    # pods are not the candidate's problem — all_pods_scheduled checks only
-    # candidate pods)
+    mask = _single_criterion(bundle, candidates, contrib, placed_g, used)
+    return mask, bundle.plan is None
+
+
+def _single_criterion(bundle, candidates, contrib, placed_g, used):
+    """The per-candidate feasibility criterion — ONE copy shared by
+    :func:`batched_single_feasible` and the joint ladder's single-
+    candidate rows (:func:`joint_retirement_plan`), so the SingleNode
+    probe and the short-circuit seed can never drift on what a single
+    hit means.
+
+    Candidate c's pods all land iff every group places at least c's
+    contribution (stuck pending pods are not the candidate's problem —
+    all_pods_scheduled checks only candidate pods). Plan-free bundles
+    additionally apply the price prefilter, mirroring the prefix probe:
+    a candidate whose pods need the one fresh claim only consolidates if
+    SOME available offering could launch strictly cheaper than the
+    candidate costs today (an unpriceable candidate aborts the replace
+    path outright); a used==0 counterfactual is a pure delete — no price
+    involved. Plan-free bundles only: the kernel fills existing nodes
+    before opening the fresh bin, so ``used`` is reliable there, while a
+    topology bundle's tightened fit can inflate it — which is exactly why
+    those misses are flagged non-definitive."""
+    G = bundle.snap.G
     mask = (placed_g[:, :G] >= contrib).all(axis=1)
     if bundle.plan is None:
-        # price prefilter, mirroring the prefix probe: a candidate whose
-        # pods need the one fresh claim only consolidates if SOME available
-        # offering could launch strictly cheaper than the candidate costs
-        # today (an unpriceable candidate aborts the replace path
-        # outright); a used==0 counterfactual is a pure delete — no price
-        # involved. Plan-free bundles only: the kernel fills existing nodes
-        # before opening the fresh bin, so `used` is reliable there, while
-        # a topology bundle's tightened fit can inflate it — which is
-        # exactly why those misses are flagged non-definitive.
         prices = np.array(
             [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64
         )
         mask = mask & (
             (used == 0) | ((prices > 0) & (bundle.min_price < prices))
         )
-    return mask, bundle.plan is None
+    return mask
 
 
 def _prefix_criterion(bundle, candidates, cum, placed_g, used):
@@ -1224,25 +1428,24 @@ def _prefix_criterion(bundle, candidates, cum, placed_g, used):
     )
     prefix_known = np.logical_and.accumulate(prices > 0)
     prefix_price = np.cumsum(prices)
-    p_by_name: dict = {}
-    for t, (_, it) in enumerate(bundle.snap.type_refs):
-        avail = bundle.snap.off_price[t][bundle.snap.off_avail[t]]
-        if avail.size:
-            p = float(avail.min())
-            if p < p_by_name.get(it.name, np.inf):
-                p_by_name[it.name] = p
-    if p_by_name:
-        p_cat = np.fromiter(p_by_name.values(), dtype=np.float64)
-        name_idx = {nm: j for j, nm in enumerate(p_by_name)}
-        # cumulative cheapest candidate price per type over the prefix
-        cheapest = np.full((N, len(p_cat)), np.inf)
-        cur = np.full(len(p_cat), np.inf)
-        for i, c in enumerate(candidates):
-            nm = getattr(getattr(c, "instance_type", None), "name", None)
-            j = name_idx.get(nm)
-            if j is not None and prices[i] > 0:
-                cur[j] = min(cur[j], prices[i])
-            cheapest[i] = cur
+    tp = getattr(bundle, "type_price_vectors", None)
+    p_cat, name_idx = (tp() if tp is not None
+                       else _type_price_vectors(bundle.snap))
+    if p_cat.size:
+        # cumulative cheapest candidate price per type over the prefix —
+        # one minimum.accumulate per type PRESENT among the candidates
+        # (absent types stay +inf in every row); the original running-min
+        # loop stays as the oracle under KARPENTER_GLOBAL_FORMULATE_LOOP
+        j_arr = np.fromiter(
+            (name_idx.get(
+                getattr(getattr(c, "instance_type", None), "name", None),
+                -1)
+             for c in candidates),
+            dtype=np.int64, count=N)
+        if _formulate_loop():
+            cheapest = _cheapest_cum_loop(prices, j_arr, len(p_cat))
+        else:
+            cheapest = _cheapest_cum_vec(prices, j_arr, len(p_cat))
         is_option = p_cat[None, :] < prefix_price[:, None]
         overlap = is_option & np.isfinite(cheapest)
         max_price = np.where(overlap, cheapest, np.inf).min(axis=1)
@@ -1253,6 +1456,50 @@ def _prefix_criterion(bundle, candidates, cum, placed_g, used):
         claim_ok = np.zeros(N, dtype=bool)
     feasible &= (used == 0) | (prefix_known & claim_ok)
     return feasible, base_exempt_ok
+
+
+def _type_price_vectors(snap):
+    """Module-level body of :meth:`DisruptionSnapshot.type_price_vectors`
+    for callers holding a bare snapshot (test doubles, the oracle path):
+    cheapest available offering price per instance-type name."""
+    p_by_name: dict = {}
+    for t, (_, it) in enumerate(snap.type_refs):
+        avail = snap.off_price[t][snap.off_avail[t]]
+        if avail.size:
+            p = float(avail.min())
+            if p < p_by_name.get(it.name, np.inf):
+                p_by_name[it.name] = p
+    p_cat = (np.fromiter(p_by_name.values(), dtype=np.float64)
+             if p_by_name else np.zeros(0, dtype=np.float64))
+    return p_cat, {nm: j for j, nm in enumerate(p_by_name)}
+
+
+def _cheapest_cum_loop(prices, j_arr, M):
+    """Oracle: the original per-candidate running-min loop over the
+    prefix (cheapest already-seen candidate price per type)."""
+    N = len(prices)
+    cheapest = np.full((N, M), np.inf)
+    cur = np.full(M, np.inf)
+    for i in range(N):
+        j = int(j_arr[i])
+        if j >= 0 and prices[i] > 0:
+            cur[j] = min(cur[j], prices[i])
+        cheapest[i] = cur
+    return cheapest
+
+
+def _cheapest_cum_vec(prices, j_arr, M):
+    """Vectorized :func:`_cheapest_cum_loop` — bit-identical by
+    construction: the same float64 min over the same values in the same
+    prefix order, just one ``np.minimum.accumulate`` per present type."""
+    N = len(prices)
+    cheapest = np.full((N, M), np.inf)
+    for j in np.unique(j_arr):
+        if j < 0:
+            continue
+        col = np.where((j_arr == j) & (prices > 0), prices, np.inf)
+        cheapest[:, int(j)] = np.minimum.accumulate(col)
+    return cheapest
 
 
 # ---------------------------------------------------------------------------
@@ -1275,6 +1522,10 @@ GLOBAL_STATS = {
     "solve_ms": 0.0,
     "round_repair_ms": 0.0,
     "repair_drops": 0,
+    # the round's shared snapshot acquisition (build or delta-advance),
+    # hoisted out of formulate_ms by the controller's prewarm — ISSUE-14
+    # schema note in deploy/README.md "Global consolidation"
+    "bundle_ms": 0.0,
 }
 
 
@@ -1296,7 +1547,8 @@ class JointPlan:
     def __init__(self, candidates, selected_idx=(), delete_only=True,
                  definitive=False, displacement=(), overflow=None,
                  k_device=0, dropped=0, timings=None, viable=True,
-                 reason="ok"):
+                 reason="ok", prefix_feasible=None, single_mask=None,
+                 generation=None, transient=False):
         self._candidates = list(candidates)
         self.selected_idx = list(selected_idx)
         self.delete_only = delete_only
@@ -1312,14 +1564,77 @@ class JointPlan:
         self.timings = dict(timings or {})
         self.viable = viable
         self.reason = reason
+        # short-circuit seed data (ISSUE 14): the dispatch's per-prefix
+        # criterion verdicts (always present when the joint dispatch ran),
+        # the per-candidate single-row mask (present when the dispatch
+        # carried the single rows too), the bundle generation they were
+        # solved at, and whether the snapshot was mid-transition (pending
+        # or drain-in-flight pods) when it answered
+        self.prefix_feasible = prefix_feasible
+        self.single_mask = single_mask
+        self.generation = generation
+        self.transient = transient
 
     @property
     def selected(self):
         return [self._candidates[i] for i in self.selected_idx]
 
 
+class JointSeed:
+    """The joint dispatch's answer re-keyed for the per-candidate probes
+    (the ISSUE-14 short-circuit): the prefix criterion verdicts ARE
+    MultiNode's capped question over the same disruption-cost order
+    (every criterion row depends only on its own prefix), and the single
+    rows — when the dispatch carried them — ARE SingleNodeConsolidation's
+    per-candidate question scored by the shared ``_single_criterion``. So
+    within one cluster-state generation the ladder's probes answer off
+    this seed instead of re-paying a device dispatch; any state bump
+    invalidates it (generation check at use time), and any
+    order/membership mismatch between the querying method's candidate
+    list and the seeded pool declines the seed rather than guessing."""
+
+    def __init__(self, generation, pids, prefix_feasible, definitive,
+                 single_mask):
+        self.generation = generation
+        self.pids = tuple(pids)
+        self.prefix_feasible = np.asarray(prefix_feasible, dtype=bool)
+        self.definitive = bool(definitive)
+        self.single_mask = (
+            None if single_mask is None
+            else np.asarray(single_mask, dtype=bool))
+
+    def valid(self, cluster) -> bool:
+        return (cluster is not None
+                and cluster.consolidation_state() == self.generation)
+
+    def _aligned(self, pids) -> bool:
+        n = len(pids)
+        return bool(n) and tuple(pids) == self.pids[:n]
+
+    def prefix_answer(self, pids):
+        """``(k, definitive)`` for a capped prefix query over the same
+        candidate order — exactly what ``batched_feasible_prefix`` would
+        have dispatched — or None when the query is not a prefix of the
+        seeded pool."""
+        if not self._aligned(pids):
+            return None
+        feas = self.prefix_feasible[: len(pids)]
+        ks = np.flatnonzero(feas)
+        return (0 if ks.size == 0 else int(ks[-1]) + 1), self.definitive
+
+    def single_answer(self, pids):
+        """``(mask, definitive)`` for a per-candidate query — exactly
+        ``batched_single_feasible``'s answer (the joint path is always
+        plan-free, so its misses are definitive) — or None when the seed
+        carried no single rows or the query order mismatches."""
+        if self.single_mask is None or not self._aligned(pids):
+            return None
+        return self.single_mask[: len(pids)].copy(), True
+
+
 def joint_retirement_plan(provisioner, cluster, store, candidates,
-                          cache=None, registry=None, build_candidates=None):
+                          cache=None, registry=None, build_candidates=None,
+                          want_singles=False):
     """The global consolidation solve: ONE joint device ladder over ALL
     candidates simultaneously — every prefix of the disruption-cost order
     is a counterfactual row of a single batched dispatch (the LP-relaxed
@@ -1330,6 +1645,15 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
     exactly ONE confirming ``simulate_scheduling`` for the returned set;
     any disagreement there falls back to the per-candidate ladder, which
     this mode retires to oracle duty.
+
+    ``want_singles`` asks the SAME dispatch to also carry the
+    per-candidate single rows (candidate c removed alone — exactly
+    SingleNodeConsolidation's question, row 0 shared with prefix row 0),
+    so a definitive verdict can seed or fence the whole method ladder
+    off one device solve; the rows are ALWAYS included when the bundle
+    is mid-transition (pending or drain-in-flight pods — the rounds the
+    noop fence exists for), because those rounds resolve no-retirement
+    almost surely and the fence needs the single answer to be provable.
 
     Returns ``None`` when the probe cannot express the scenario at all
     (no bundle, invisible candidates, unmapped pods — the caller records
@@ -1352,7 +1676,7 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
     cols = bundle.columns_for(candidates)
     if cols is None:
         return None
-    contrib = bundle.contribs_for(candidates)
+    contrib = bundle.contribs_for(candidates, cols=cols)
     if contrib is None:
         return None
 
@@ -1361,13 +1685,33 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
     g_count_k = bundle.base[None, :] + cum
     col_arr = np.asarray(cols, dtype=np.intp)
     e_zero_cols = [col_arr[: k + 1] for k in range(N)]
+    transient = bool(int(bundle.base.sum())) or bool(bundle.deleting_pods)
+    singles = (want_singles or transient) and N >= 2
+    if singles:
+        # the per-candidate single rows ride the SAME dispatch: row 0 is
+        # prefix row 0 (remove only candidate 0), rows N.. are candidates
+        # 1..N-1 removed alone — _single_criterion (shared verbatim with
+        # batched_single_feasible) scores them below
+        g_single = bundle.base[None, :] + contrib
+        g_count_k = np.concatenate([g_count_k, g_single[1:]], axis=0)
+        e_zero_cols = e_zero_cols + [
+            col_arr[c: c + 1] for c in range(1, N)]
+    rows_total = g_count_k.shape[0]
     t1 = time.perf_counter()
 
-    with obs.span("global.dispatch", rows=N):
+    with obs.span("global.dispatch", rows=rows_total, singles=singles):
         placed_g, used = bundle.dispatch(g_count_k, e_zero_cols,
                                          seam="global.dispatch")
     t2 = time.perf_counter()
 
+    single_mask = None
+    if singles:
+        placed_single = np.concatenate(
+            [placed_g[0:1], placed_g[N:]], axis=0)
+        used_single = np.concatenate([used[0:1], used[N:]])
+        single_mask = _single_criterion(
+            bundle, candidates, contrib, placed_single, used_single)
+        placed_g, used = placed_g[:N], used[:N]
     feasible, definitive = _prefix_criterion(
         bundle, candidates, cum, placed_g, used)
     ks = np.flatnonzero(feasible)
@@ -1376,6 +1720,8 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
         "formulate_ms": (t1 - t0) * 1000.0,
         "solve_ms": (t2 - t1) * 1000.0,
     }
+    seed_kw = dict(prefix_feasible=feasible, single_mask=single_mask,
+                   generation=bundle.generation, transient=transient)
     if not definitive:
         # a non-definitive ladder (claimability too large to prove, with
         # pending/drain pods riding the rows) UNDER-estimates k; the
@@ -1384,22 +1730,24 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
         # nodes than the reference AND preempt that recovery (this
         # method runs first) — so the round is handed to the ladder,
         # whose gallop is exactly the machinery the seed needs
-        _account(timings, N, 0)
+        _account(timings, rows_total, 0)
         return JointPlan(candidates, k_device=k, timings=timings,
-                         viable=False, reason="non-definitive")
+                         viable=False, reason="non-definitive", **seed_kw)
     if k < 2:
         # nothing worth a joint command: single-candidate rounds (and the
-        # probe's residual false-negative corner) stay the ladder's job
-        _account(timings, N, 0)
+        # probe's residual false-negative corner) stay the ladder's job —
+        # unless the single rows above prove the whole round noop, in
+        # which case the caller fences it (methods.py GlobalConsolidation)
+        _account(timings, rows_total, 0)
         return JointPlan(candidates, definitive=definitive,
                          k_device=k, timings=timings, viable=False,
-                         reason="no-retirement")
+                         reason="no-retirement", **seed_kw)
 
     t3 = time.perf_counter()
     k_final, plan, dropped = _round_repair(
         bundle, col_arr, contrib, k, used, feasible)
     timings["round_repair_ms"] = (time.perf_counter() - t3) * 1000.0
-    _account(timings, N, dropped)
+    _account(timings, rows_total, dropped)
     if plan is None:
         # the device ladder scored k>=2 feasible but integral rounding
         # failed at every prefix it tried (budget spent, or shed below
@@ -1409,7 +1757,7 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
         # never the benign nothing-to-do verdict
         return JointPlan(candidates, definitive=definitive, k_device=k,
                          dropped=dropped, timings=timings, viable=False,
-                         reason="repair-bound")
+                         reason="repair-bound", **seed_kw)
     placements, overflow = plan
     return JointPlan(
         candidates,
@@ -1421,6 +1769,7 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
         k_device=k,
         dropped=dropped,
         timings=timings,
+        **seed_kw,
     )
 
 

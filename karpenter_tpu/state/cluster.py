@@ -61,6 +61,40 @@ def delta_from_wire(obj):
     return ("pod", obj["uid"], obj.get("node"), bool(obj.get("gone")))
 
 
+def _nodepool_sched_fingerprint(np_) -> tuple:
+    """Everything on a NodePool that can change a scheduling or
+    disruption answer, folded into one comparable value: the drift
+    static-hash (template labels/annotations/taints/kubelet/class ref)
+    plus the fields it deliberately excludes but the solver and the
+    disruption ladder consume — template requirements and resource
+    requests, weight, limits, the whole disruption block (policy,
+    consolidate/expire windows, budgets), the status conditions
+    (readiness gates which pools the provisioner solves over), and —
+    only when the pool HAS limits — the aggregated usage itself
+    (remaining = spec − usage feeds the solve). An event whose
+    fingerprint is unchanged is status bookkeeping and must not bump
+    the consolidation generation."""
+    spec = np_.spec
+    t = spec.template
+    d = spec.disruption
+    return (
+        np_.static_hash(),
+        repr(t.requirements),
+        repr(t.resource_requests),
+        spec.weight,
+        repr(spec.limits),
+        d.consolidation_policy,
+        d.consolidate_after,
+        d.expire_after,
+        repr(d.budgets),
+        tuple(
+            (getattr(c, "type", None), getattr(c, "status", None))
+            for c in np_.status.conditions
+        ),
+        repr(np_.status.resources) if spec.limits else None,
+    )
+
+
 class Cluster:
     def __init__(self, store, clock=None):
         from karpenter_tpu.utils.clock import Clock
@@ -81,6 +115,12 @@ class Cluster:
         self._delta_journal: collections.deque = collections.deque(
             maxlen=DELTA_JOURNAL_CAP
         )
+        # per-nodepool scheduling fingerprint (ISSUE 14): the counter
+        # controller rewrites status.resources after every node wave, and
+        # treating those bookkeeping writes as consolidation-relevant
+        # re-opened the noop fence (and rebuilt the snapshot cache) once
+        # per wave for nothing — only a fingerprint CHANGE bumps now
+        self._np_fingerprints: dict = {}
 
     # -- informer entry point -------------------------------------------
     def on_event(self, event):
@@ -100,11 +140,29 @@ class Cluster:
                 self.delete_pod(obj)
             else:
                 self.update_pod(obj)
-        elif kind in ("nodepools", "daemonsets"):
-            # any nodepool or daemonset change can change the consolidation
-            # answer (templates, budgets, daemon overhead) — and both feed
-            # the solver inputs cached by the disruption snapshot cache
-            # (ops/consolidate.py), whose generation key is this counter
+        elif kind == "nodepools":
+            # a nodepool SPEC or readiness change can change the
+            # consolidation answer (templates, requirements, budgets,
+            # limits, weight — all feed the solver inputs the disruption
+            # snapshot cache keys on this counter), so it bumps opaque.
+            # A STATUS-only write with the scheduling fingerprint
+            # unchanged — the counter controller refreshing
+            # status.resources on a pool without limits after every node
+            # wave — is bookkeeping: bumping for it re-opened the noop
+            # fence and displaced the cached snapshot once per wave for
+            # nothing. Usage still participates WHEN the pool has limits
+            # (remaining = spec − usage feeds the solve).
+            if typ == "Deleted":
+                self._np_fingerprints.pop(obj.metadata.name, None)
+                self.mark_unconsolidated()
+            else:
+                fp = _nodepool_sched_fingerprint(obj)
+                if self._np_fingerprints.get(obj.metadata.name) != fp:
+                    self._np_fingerprints[obj.metadata.name] = fp
+                    self.mark_unconsolidated()
+        elif kind == "daemonsets":
+            # any daemonset change can change the consolidation answer
+            # (daemon overhead rides the cached solver inputs)
             self.mark_unconsolidated()
 
     def resync(self):
@@ -118,6 +176,9 @@ class Cluster:
         self._claim_name_to_pid.clear()
         self._bindings.clear()
         self._antiaffinity_pods.clear()
+        # fingerprints re-learn from the next events (a cleared entry can
+        # only cause one extra opaque bump — the safe direction)
+        self._np_fingerprints.clear()
         self.mark_unconsolidated()  # opaque: a rebuilt mirror has no delta
         for claim in self.store.list("nodeclaims"):
             self.update_node_claim(claim)

@@ -38,6 +38,13 @@ def rec(tmp_path):
     obs.RECORDER.clear()
     capsule.reset()
     decisions.reset()
+    # the compile ledger is process-global too: a long warm streak left
+    # behind by another test file would make this file's first cold
+    # compile read as cold-compile-in-steady-state, capsuling a round
+    # the specs expect clean
+    from karpenter_tpu.obs import devplane
+
+    devplane.reset()
     yield tmp_path
     obs.reset()
 

@@ -172,6 +172,25 @@ class TestProducerEnumsClosed:
         assert "transport-retryable" in decisions.SOLVER_FALLBACK_REASONS
         assert "server-error" in decisions.SOLVER_FALLBACK_REASONS
 
+    def test_short_circuit_reasons_are_enum_members(self):
+        """ISSUE 14 producer pin: the seeded-probe and noop-fence
+        verdicts are closed-enum members on their sites (the skipped
+        probe path is accounted, never silent), and the fence is benign
+        (workload-driven, not a regression)."""
+        import inspect
+        import re
+
+        from karpenter_tpu.controllers.disruption import methods
+
+        src = inspect.getsource(methods)
+        assert '"joint-seeded"' in src, (
+            "seeded-probe producer vanished — update the pin")
+        assert re.search(r'_verdict\("joint", "joint-noop-fenced"\)', src), (
+            "noop-fence producer vanished — update the pin")
+        assert "joint-seeded" in SITES["probe.confirm"]["reasons"]
+        assert "joint-noop-fenced" in SITES["consolidate.global"]["reasons"]
+        assert "joint-noop-fenced" in SITES["consolidate.global"]["benign"]
+
 
 # ---------------------------------------------------------------------------
 # rung-regression anomaly
@@ -730,6 +749,88 @@ class TestProbeConfirmInvocations:
         assert delta["probe.confirm"] == {"gallop": 1}
         assert decisions.counts()[
             ("probe.confirm", "gallop", "non-definitive")] >= 1
+
+    def _seeded_ctx(self, cands, single_mask):
+        import numpy as np
+
+        from karpenter_tpu.ops.consolidate import JointSeed
+
+        ctx = self._ctx()
+        ctx.cluster = SimpleNamespace(consolidation_state=lambda: 42)
+        ctx.joint_seed = JointSeed(
+            42, [c.provider_id for c in cands],
+            np.array([True] * len(cands)), True,
+            np.array(single_mask))
+        return ctx
+
+    def test_multi_seeded_probe_records_joint_seeded(self, rec):
+        """ISSUE-14 invocation pin: a MultiNode round answered off the
+        round's JointSeed records (definitive, joint-seeded) — the
+        skipped dispatch is accounted, never silent."""
+        from karpenter_tpu.controllers.disruption.methods import (
+            MultiNodeConsolidation,
+        )
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        cands, budgets = self._cands(4)
+        meth = MultiNodeConsolidation(
+            self._seeded_ctx(cands, [True, False, False, False]))
+        meth._confirm = lambda prefix: (
+            Command(list(prefix), reason="Underutilized")
+            if len(prefix) >= 2 else None)
+        c0 = decisions.counts()
+        cmd = meth.compute_command(cands, budgets)
+        assert cmd is not None and len(cmd.candidates) == 4
+        assert meth.last_probe == "seeded"
+        assert decisions.counts()[
+            ("probe.confirm", "definitive", "joint-seeded")] \
+            == c0.get(("probe.confirm", "definitive", "joint-seeded"), 0) + 1
+
+    def test_single_seeded_probe_records_joint_seeded(self, rec):
+        from karpenter_tpu.controllers.disruption.methods import (
+            SingleNodeConsolidation,
+        )
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        cands, budgets = self._cands(3)
+        meth = SingleNodeConsolidation(
+            self._seeded_ctx(cands, [True, False, False]))
+        meth._confirm_one = lambda c: Command([c], reason="Underutilized")
+        c0 = decisions.counts()
+        cmd = meth.compute_command(cands, budgets)
+        assert cmd is not None and len(cmd.candidates) == 1
+        assert meth.last_probe == "seeded"
+        assert decisions.counts()[
+            ("probe.confirm", "definitive", "joint-seeded")] \
+            == c0.get(("probe.confirm", "definitive", "joint-seeded"), 0) + 1
+
+    def test_stale_seed_declines_and_device_probe_records_ok(self, rec):
+        """A generation bump invalidates the seed: the probe dispatches
+        its own answer and records plain (definitive, ok)."""
+        from karpenter_tpu.controllers.disruption.methods import (
+            MultiNodeConsolidation,
+        )
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        from karpenter_tpu.controllers.disruption.methods import (
+            _seed_answer,
+        )
+
+        cands, budgets = self._cands(4)
+        ctx = self._seeded_ctx(cands, [True, False, False, False])
+        ctx.cluster = SimpleNamespace(consolidation_state=lambda: 43)
+        assert _seed_answer(ctx, cands, "prefix") is None
+        meth = MultiNodeConsolidation(ctx)
+        meth._probe = lambda cs, pool=None: (4, True)
+        meth._confirm = lambda prefix: (
+            Command(list(prefix), reason="Underutilized")
+            if len(prefix) >= 2 else None)
+        c0 = decisions.counts()
+        meth.compute_command(cands, budgets)
+        assert meth.last_probe == "device"
+        assert decisions.counts()[
+            ("probe.confirm", "definitive", "ok")] \
+            == c0.get(("probe.confirm", "definitive", "ok"), 0) + 1
 
 
 class TestSessionSyncInvocations:

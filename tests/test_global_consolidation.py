@@ -143,7 +143,9 @@ class TestJointRetirement:
         cmd_g, method = compute_global(env)
         assert method.last_plan.dropped == 0
         cmd_m, probe = compute_multi(env)
-        assert probe == "device"
+        # the prefix answer may come from MultiNode's own dispatch or from
+        # the joint dispatch's seed — identical rows either way (ISSUE 14)
+        assert probe in ("device", "seeded")
         assert cmd_g is not None and cmd_m is not None
         assert {c.name for c in cmd_g.candidates} == {
             c.name for c in cmd_m.candidates}
@@ -182,8 +184,12 @@ class TestJointRetirement:
         nodes, pods = fleet(env)
         assert pods == 18
         assert nodes == 6  # ceil(18 pods / 3 per node): the packed floor
-        delta = decisions.rung_delta(dec0, decisions.counts())
-        joint = delta.get("consolidate.global", {}).get("joint", 0)
+        # joint COMMANDS are the ("joint", "ok") verdicts — the
+        # joint-noop-fenced verdicts share the rung but ship nothing and
+        # pay no confirm (ISSUE 14), so the contract counts reasons
+        c1 = decisions.counts()
+        key = ("consolidate.global", "joint", "ok")
+        joint = c1.get(key, 0) - dec0.get(key, 0)
         assert joint >= 1
         confirms = env.registry.counter(m.DISRUPTION_HOST_CONFIRMS)
         assert confirms.value(method="global") == joint
@@ -431,6 +437,418 @@ class TestGlobalDispatchCapsule:
         assert rep["parity"] == "exact"
         rungs = [row["rung"] for row in capsule.ab_compare(cap)]
         assert rungs == ["device", "native"]
+
+
+class TestFormulateParity:
+    """ISSUE 14: the vectorized formulation — cached [E,G] contribution
+    rows gathered by ``contribs_for`` plus the vectorized
+    cheapest-cum-price half of ``_prefix_criterion`` — must be
+    BIT-identical to the loop oracle (``KARPENTER_GLOBAL_FORMULATE_LOOP
+    =1``) on every snapshot, including delta-advanced ones."""
+
+    def test_gather_matches_loop_across_seeded_snapshots(self):
+        """≥100 seeded snapshot states: fresh builds AND delta-advanced
+        bundles, random candidate subsets, mutating workloads."""
+        checked = 0
+        for seed in (1, 5, 9):
+            env = seeded_mixed_env(8, seed)
+            d = env.disruption
+            r = random.Random(seed * 100 + 7)
+            cache = d.ctx.snapshot_cache
+            for step in range(6):
+                cands = get_candidates(d.cluster, d.store, d.cloud,
+                                       d.clock, queue=d.queue)
+                cands.sort(key=lambda c: c.disruption_cost)
+                if len(cands) >= 2:
+                    bundle = cache.get(d.provisioner, d.cluster, d.store,
+                                       cands)
+                    if bundle is not None:
+                        for _ in range(7):
+                            k = r.randint(1, len(cands))
+                            sub = r.sample(cands, k)
+                            loop = bundle._contribs_loop(sub)
+                            vec = bundle.contribs_for(sub)
+                            assert (loop is None) == (vec is None)
+                            if loop is not None:
+                                assert vec.dtype == loop.dtype
+                                assert np.array_equal(loop, vec), (
+                                    f"seed={seed} step={step}: vectorized "
+                                    "contribution rows diverged from the "
+                                    "loop oracle")
+                            checked += 1
+                # mutate the workload so later rounds exercise the
+                # delta-advance row invalidation
+                deploys = env.store.list("deployments")
+                if deploys:
+                    dep = r.choice(deploys)
+                    dep.replicas = r.choice((0, 1, 2))
+                    env.store.update("deployments", dep)
+                env.run_until_idle(max_rounds=200)
+        assert checked >= 100, f"only {checked} snapshot states checked"
+
+    def test_cheapest_cum_vec_matches_loop_fuzz(self):
+        from karpenter_tpu.ops.consolidate import (
+            _cheapest_cum_loop,
+            _cheapest_cum_vec,
+        )
+
+        r = random.Random(31)
+        for _ in range(50):
+            n = r.randint(1, 40)
+            m = r.randint(1, 6)
+            prices = np.array(
+                [r.choice((0.0, 0.5, 1.0, 2.5, 4.0)) for _ in range(n)])
+            j_arr = np.array(
+                [r.randint(-1, m - 1) for _ in range(n)], dtype=np.int64)
+            a = _cheapest_cum_loop(prices, j_arr, m)
+            b = _cheapest_cum_vec(prices, j_arr, m)
+            assert np.array_equal(a, b)  # inf positions included
+
+    def test_oracle_knob_forces_the_loop(self, monkeypatch):
+        env = build_env(4)
+        d = env.disruption
+        cands = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                               queue=d.queue)
+        cands.sort(key=lambda c: c.disruption_cost)
+        from karpenter_tpu.ops.consolidate import (
+            build_disruption_snapshot,
+        )
+
+        bundle = build_disruption_snapshot(
+            d.provisioner, d.cluster, d.store, cands)
+        monkeypatch.setenv("KARPENTER_GLOBAL_FORMULATE_LOOP", "1")
+        called = []
+        orig = bundle._contribs_loop
+        bundle._contribs_loop = lambda cs: (called.append(1), orig(cs))[1]
+        out = bundle.contribs_for(cands)
+        assert called and out is not None
+        # and the gather path stays untouched under the knob
+        assert bundle._contrib_rows is None
+
+    def test_advance_invalidates_exactly_dirty_rows(self):
+        """A delta-advanced bundle reuses prior-round formulation rows:
+        only the touched rows recompute (the ISSUE-14 reuse contract),
+        and the gather still matches the loop afterwards."""
+        env = build_env(6)
+        d = env.disruption
+        cache = d.ctx.snapshot_cache
+        cands = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                               queue=d.queue)
+        cands.sort(key=lambda c: c.disruption_cost)
+        bundle = cache.get(d.provisioner, d.cluster, d.store, cands)
+        assert bundle.contribs_for(cands) is not None
+        built_before = bundle._contrib_built.copy()
+        assert built_before.all()
+        # refresh one bound pod (a node-scoped delta)
+        p = next(q for q in env.store.list("pods") if q.node_name)
+        env.store.update("pods", p)
+        for ev in env.store.drain_events():
+            env.cluster.on_event(ev)
+        cands2 = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                                queue=d.queue)
+        cands2.sort(key=lambda c: c.disruption_cost)
+        b2 = cache.get(d.provisioner, d.cluster, d.store, cands2)
+        assert b2 is bundle, "the delta advance should keep the bundle"
+        dirty = int((~bundle._contrib_built).sum())
+        assert 1 <= dirty < len(built_before), (
+            "exactly the touched rows should be invalidated")
+        vec = bundle.contribs_for(cands2)
+        assert np.array_equal(vec, bundle._contribs_loop(cands2))
+
+
+class TestShortCircuit:
+    """ISSUE 14: one state bump pays ONE device dispatch — the joint
+    verdict seeds the MultiNode/SingleNode probes of the same
+    generation, and a definitive mid-transition no-retirement verdict
+    closes the round outright (the noop fence)."""
+
+    def _methods(self, env):
+        from karpenter_tpu.controllers.disruption.methods import (
+            SingleNodeConsolidation,
+        )
+
+        mn = next(m for m in env.disruption.methods
+                  if isinstance(m, MultiNodeConsolidation))
+        sn = next(m for m in env.disruption.methods
+                  if isinstance(m, SingleNodeConsolidation))
+        return mn, sn
+
+    def test_settled_noop_round_seeds_probes_one_dispatch(self):
+        from karpenter_tpu.obs import decisions
+        from karpenter_tpu.ops import consolidate as cons
+
+        env = build_env(8)
+        converge(env)  # packed floor reached
+        # re-open the fence with a benign state bump
+        p = next(q for q in env.store.list("pods") if q.node_name)
+        env.store.update("pods", p)
+        c0 = decisions.counts()
+        cons.reset_dispatch_log()
+        env.disruption.poll_period = 0.0
+        env.clock.step(20.0)
+        env.run_until_idle(max_rounds=200)
+        env.disruption.poll_period = float("inf")
+        assert cons.max_dispatches_per_generation() <= 1, (
+            "a settled noop round must pay at most the joint dispatch")
+        mn, sn = self._methods(env)
+        assert mn.last_probe == "seeded"
+        assert sn.last_probe == "seeded"
+        c1 = decisions.counts()
+        seeded = sum(
+            c1.get(("probe.confirm", rung, "joint-seeded"), 0)
+            - c0.get(("probe.confirm", rung, "joint-seeded"), 0)
+            for rung in ("definitive", "gallop"))
+        assert seeded >= 2, "both probes must account the seeded answer"
+
+    def test_transient_noop_verdict_fences_round(self):
+        from karpenter_tpu.obs import decisions
+        from karpenter_tpu.ops import consolidate as cons
+
+        env = build_env(8)
+        converge(env)
+        # mark one pod-bearing node for deletion: the bundle sees
+        # drain-in-flight pods -> transient
+        sn_state = next(s for s in env.cluster.state_nodes()
+                        if s.reschedulable_pods())
+        env.cluster.mark_for_deletion(sn_state.provider_id)
+        c0 = decisions.counts()
+        cons.reset_dispatch_log()
+        env.disruption.poll_period = 0.0
+        env.clock.step(20.0)
+        env.run_until_idle(max_rounds=200)
+        env.disruption.poll_period = float("inf")
+        c1 = decisions.counts()
+        fkey = ("consolidate.global", "joint", "joint-noop-fenced")
+        assert c1.get(fkey, 0) > c0.get(fkey, 0), (
+            "the transient noop verdict must close the round")
+        # the fence means the per-candidate probes never ran at all
+        probe_records = sum(
+            c1.get(k, 0) - c0.get(k, 0)
+            for k in c1
+            if k[0] == "probe.confirm")
+        assert probe_records == 0
+        assert cons.max_dispatches_per_generation() <= 1
+
+    def test_cap_truncated_pool_never_fences(self, monkeypatch):
+        """A KARPENTER_GLOBAL_CAP-truncated candidate list can seed the
+        capped MultiNode question but must NEVER close the round as
+        round-wide no-retirement: SingleNode's scan is uncapped and the
+        candidates beyond the cap were never examined."""
+        from karpenter_tpu.obs import decisions
+
+        monkeypatch.setenv("KARPENTER_GLOBAL_CAP", "2")
+        env = build_env(12)  # packs to 4 nodes: 3 candidates > the cap
+        converge(env)
+        # mid-transition bump (the fence-eligible shape)
+        sn_state = next(s for s in env.cluster.state_nodes()
+                        if s.reschedulable_pods())
+        env.cluster.mark_for_deletion(sn_state.provider_id)
+        c0 = decisions.counts()
+        env.disruption.poll_period = 0.0
+        env.clock.step(20.0)
+        env.run_until_idle(max_rounds=200)
+        env.disruption.poll_period = float("inf")
+        c1 = decisions.counts()
+        fkey = ("consolidate.global", "joint", "joint-noop-fenced")
+        assert c1.get(fkey, 0) == c0.get(fkey, 0), (
+            "a cap-truncated view must not claim round-wide no-retirement")
+        g = gmethod(env)
+        assert not g.fence_round
+
+    def test_state_bump_invalidates_seed(self):
+        env = build_env(8)
+        converge(env)
+        p = next(q for q in env.store.list("pods") if q.node_name)
+        env.store.update("pods", p)
+        env.disruption.poll_period = 0.0
+        env.clock.step(20.0)
+        env.run_until_idle(max_rounds=200)
+        env.disruption.poll_period = float("inf")
+        seed = env.disruption.ctx.joint_seed
+        assert seed is not None and seed.valid(env.cluster)
+        env.cluster.mark_unconsolidated()
+        assert not seed.valid(env.cluster), (
+            "a state bump mid-round must invalidate the seed")
+        # a stale seed declines: the next MultiNode probe pays its own
+        # dispatch instead of trusting last generation's answer
+        cmd_m, probe = compute_multi(env)
+        assert probe == "device"
+
+    def test_seed_declines_on_order_mismatch(self):
+        from karpenter_tpu.ops.consolidate import JointSeed
+
+        seed = JointSeed(7, ["a", "b", "c"],
+                         np.array([True, True, False]), True,
+                         np.array([True, False, False]))
+        assert seed.prefix_answer(("a", "b")) == (2, True)
+        assert seed.prefix_answer(("b", "a")) is None
+        assert seed.prefix_answer(()) is None
+        mask, definitive = seed.single_answer(("a", "b", "c"))
+        assert definitive and mask.tolist() == [True, False, False]
+        assert seed.single_answer(("a", "c")) is None
+        no_singles = JointSeed(7, ["a"], np.array([False]), True, None)
+        assert no_singles.single_answer(("a",)) is None
+
+    def test_joint_single_mask_matches_batched_single(self):
+        """The joint dispatch's single rows must answer exactly what
+        batched_single_feasible answers on the same state (the shared
+        _single_criterion contract)."""
+        from karpenter_tpu.ops.consolidate import (
+            batched_single_feasible,
+            joint_retirement_plan,
+        )
+
+        env = build_env(6)
+        d = env.disruption
+        candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                                    queue=d.queue)
+        candidates.sort(key=lambda c: c.disruption_cost)
+        plan = joint_retirement_plan(
+            d.provisioner, d.cluster, d.store, list(candidates),
+            want_singles=True)
+        assert plan is not None and plan.single_mask is not None
+        mask, definitive = batched_single_feasible(
+            d.provisioner, d.cluster, d.store, list(candidates))
+        assert definitive
+        assert plan.single_mask.tolist() == mask.tolist()
+
+
+@pytest.mark.slow
+class TestShortCircuitAtScale:
+    def test_200_node_one_dispatch_per_generation(self, monkeypatch):
+        """Seeded 200-node convergence: at most ONE probe dispatch per
+        cluster-state generation, cross-checked against the compile
+        ledger (XLA forced so every chunk lands a probe.kernel ledger
+        event), and the drain wave's spans keep the breakdown
+        attributable (leaf coverage on the drain rounds)."""
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs import devplane
+        from karpenter_tpu.ops import consolidate as cons
+
+        monkeypatch.setenv("KARPENTER_NATIVE_CUTOFF", "0")
+        kernel_events = []
+        orig_rd = devplane.record_dispatch
+
+        def spy_rd(family, key, seconds, registry=None):
+            if family == "probe.kernel":
+                kernel_events.append(key)
+            return orig_rd(family, key, seconds, registry=registry)
+
+        monkeypatch.setattr(devplane, "record_dispatch", spy_rd)
+        from karpenter_tpu.controllers.node import termination as term
+        from karpenter_tpu.kube import binder as kb
+
+        evict0 = term.STATS["evict_ms"]
+        rebind0 = kb.STATS["rebind_ms"]
+        env = seeded_mixed_env(200, seed=13)
+        cons.reset_dispatch_log()
+        converge(env, max_rounds=80)
+        assert fleet(env)[1] == 200, "workload must be preserved"
+        # the wave breakdown the perf row reports actually accumulated:
+        # the drain wave evicted and the binder rebound displaced pods
+        assert term.STATS["evict_ms"] > evict0
+        assert kb.STATS["rebind_ms"] > rebind0
+        assert cons.max_dispatches_per_generation() <= 1, (
+            "a short-circuited round must pay one dispatch per generation")
+        # the ledger saw the dispatches the log counted (chunked: at
+        # least one kernel event per logged invocation)
+        invocations = sum(cons.DISPATCHES_BY_GEN.values())
+        assert invocations >= 1
+        assert len(kernel_events) >= invocations
+        # drain rounds carry their span tree: the evict/finalize split is
+        # attributable, not a black box between disruption rounds
+        drains = [tr for tr in obs.RECORDER.traces() if tr.name == "drain"]
+        if drains:
+            assert max(tr.leaf_coverage() for tr in drains) >= 0.5
+
+
+class TestPriorityTieBreak:
+    """ISSUE 14 satellite: on EXACT disruption-cost ties the joint path
+    prefers retiring candidates displacing lower-tier pods; fleets
+    without priorities keep the plain cost order bit-identically."""
+
+    def _cand(self, pid, cost, prios):
+        pods = [SimpleNamespace(uid=f"{pid}-{i}", priority=p,
+                                priority_class_name="")
+                for i, p in enumerate(prios)]
+        return SimpleNamespace(provider_id=pid, disruption_cost=cost,
+                               reschedulable_pods=pods)
+
+    def _ctx(self, classes=()):
+        store = SimpleNamespace(
+            list=lambda kind: list(classes) if kind == "priorityclasses"
+            else [])
+        return SimpleNamespace(store=store)
+
+    def test_exact_tie_prefers_lower_tier_victims(self):
+        from karpenter_tpu.controllers.disruption.methods import (
+            _candidate_order,
+        )
+
+        high = self._cand("high", 1.0, [8000, 0])
+        low = self._cand("low", 1.0, [0, 0])
+        mid = self._cand("mid", 1.0, [1000])
+        out = _candidate_order(self._ctx(), [high, low, mid])
+        assert [c.provider_id for c in out] == ["low", "mid", "high"]
+
+    def test_cost_always_dominates_priority(self):
+        from karpenter_tpu.controllers.disruption.methods import (
+            _candidate_order,
+        )
+
+        cheap_high = self._cand("cheap-high", 0.5, [9000])
+        costly_low = self._cand("costly-low", 2.0, [0])
+        out = _candidate_order(self._ctx(), [costly_low, cheap_high])
+        assert [c.provider_id for c in out] == ["cheap-high", "costly-low"]
+
+    def test_priority_free_order_is_bit_identical(self):
+        from karpenter_tpu.controllers.disruption.methods import (
+            _candidate_order,
+        )
+
+        cands = [self._cand(f"n{i}", 1.0, [None]) for i in range(6)]
+        out = _candidate_order(self._ctx(), list(cands))
+        assert [c.provider_id for c in out] == [
+            c.provider_id for c in sorted(
+                cands, key=lambda c: c.disruption_cost)]
+
+    def test_priority_class_resolution_rides_the_store(self):
+        from karpenter_tpu.api.objects import ObjectMeta, PriorityClass
+        from karpenter_tpu.controllers.disruption.methods import (
+            _candidate_order,
+        )
+
+        pc = PriorityClass(metadata=ObjectMeta(name="gold"), value=5000)
+        via_class = self._cand("via-class", 1.0, [None])
+        via_class.reschedulable_pods[0].priority_class_name = "gold"
+        plain = self._cand("plain", 1.0, [None])
+        out = _candidate_order(self._ctx([pc]), [via_class, plain])
+        assert [c.provider_id for c in out] == ["plain", "via-class"]
+
+    def test_end_to_end_tie_break_on_joint_path(self):
+        """Exactly-tied disruption costs (eviction-cost annotations pin
+        them), one node carrying high-priority pods: a budget-capped
+        joint command retires lower-tier nodes first."""
+        from karpenter_tpu.utils.disruption import EVICTION_COST_ANNOTATION
+
+        env = build_env(8)
+        # pin every pod's eviction cost so disruption_cost ties EXACTLY
+        # (priority otherwise nudges it via 1 + priority/1e6), then raise
+        # one node's pods to a high tier — only the tie-break can see it
+        bound = [p for p in env.store.list("pods") if p.node_name]
+        protected = bound[0].node_name
+        for p in bound:
+            p.metadata.annotations[EVICTION_COST_ANNOTATION] = "1.0"
+            if p.node_name == protected:
+                p.priority = 9000
+            env.store.update("pods", p)
+        for np_ in env.store.list("nodepools"):
+            np_.spec.disruption.budgets[0].nodes = "3"
+            env.store.update("nodepools", np_)
+        cmd, method = compute_global(env)
+        if cmd is not None:
+            assert protected not in {c.name for c in cmd.candidates}, (
+                "equal-cost tie must prefer displacing lower-tier pods")
 
 
 class TestLedgerSiteClosed:

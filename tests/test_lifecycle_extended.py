@@ -157,16 +157,23 @@ class TestTermination:
             if p.owned_by_daemonset() and p.node_name == node.metadata.name
         }
         assert ds_pods, "fixture should place a daemonset pod"
-        # record every eviction the drain issues: the terminator must skip
-        # daemonset-owned pods entirely (terminator.go pod filtering)
+        # record every eviction the drain issues — per-pod AND the batched
+        # wave (ISSUE 14): the terminator must skip daemonset-owned pods
+        # entirely (terminator.go pod filtering)
         evicted = []
         orig_evict = env.store.evict
+        orig_wave = env.store.evict_wave
 
         def spy_evict(p, *a, **kw):
             evicted.append(p.metadata.name)
             return orig_evict(p, *a, **kw)
 
+        def spy_wave(pods, *a, **kw):
+            evicted.extend(p.metadata.name for p in pods)
+            return orig_wave(pods, *a, **kw)
+
         env.store.evict = spy_evict
+        env.store.evict_wave = spy_wave
         env.store.delete("nodes", node)
         env.run_until_idle(max_rounds=100)
         assert not (set(evicted) & ds_pods), (

@@ -149,7 +149,14 @@ class TestConsolidationMicroBench:
         assert data["end_nodes"] == 100, data
         assert data["pods_bound"][0] == data["pods_bound"][1] == 300, data
         assert data["probe_fallbacks"] == 0, data
-        assert data["probe_batches"]["single"] >= 1, data
+        # the per-candidate questions were answered on the device plane:
+        # either SingleNode dispatched its own probe batches, or it rode
+        # the joint dispatch's seed (ISSUE 14 — probe.confirm verdicts
+        # recorded, zero sequential fallbacks pinned above)
+        probe_rungs = data["rungs"].get("probe.confirm", {})
+        assert (data["probe_batches"]["single"] >= 1
+                or probe_rungs.get("definitive", 0) >= 1), data
+        assert probe_rungs.get("sequential", 0) == 0, data
         assert data["snapshot_cache"]["hits"] >= 1, data
         assert data["within_1min_budget"], data
         # the batched confirm ladder: on the seeded fixture every MultiNode
@@ -544,3 +551,80 @@ class TestPrioritySentinelLeg:
     def test_empty_run_fails_loudly(self, monkeypatch):
         _, problems = self._run(monkeypatch, {})
         assert any("no rows" in p for p in problems)
+
+
+class TestGlobalSentinelLeg:
+    """bench.py's global-consolidation hard gates (rides
+    `--consolidation`): wall-clock budget, cost ≤ ladder, the
+    one-confirm-per-command contract, and — since ISSUE 14 — the
+    max-one-probe-dispatch-per-generation contract. The pair parser must
+    accept BOTH the pre-ISSUE-14 row schema (no dispatch keys) and the
+    new one."""
+
+    def _row(self, **overrides):
+        row = {
+            "config": "4-consolidation-2000-global", "total_ms": 3500.0,
+            "end_cost": 216.64, "confirm_count": 2, "joint_commands": 2,
+            "within_budget_ms": True, "cost_le_ladder": True,
+            "confirm_contract_ok": True, "dispatch_contract_ok": True,
+            "max_dispatches_per_generation": 1,
+            "ladder": {"total_ms": 10000.0, "end_cost": 216.64},
+        }
+        row.update(overrides)
+        return {row["config"]: row}
+
+    def _run(self, monkeypatch, rows, baseline=None):
+        import bench
+
+        monkeypatch.setattr(bench, "_fresh_perf_rows",
+                            lambda args, env=None: rows)
+        monkeypatch.setattr(bench, "_perf_baseline_rows",
+                            lambda: baseline or {})
+        return bench._global_pairs()
+
+    def test_clean_run_pairs_against_baseline(self, monkeypatch):
+        pairs, problems = self._run(
+            monkeypatch, self._row(),
+            baseline={"4-consolidation-2000-global": {"total_ms": 3600.0}})
+        assert problems == []
+        assert pairs == [("4-consolidation-2000-global", 3600.0, 3500.0)]
+
+    def test_budget_violation_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(
+            monkeypatch, self._row(within_budget_ms=False, total_ms=7000.0))
+        assert any("wall-clock budget" in p for p in problems)
+
+    def test_cost_regression_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(
+            monkeypatch, self._row(cost_le_ladder=False))
+        assert any("worse end state" in p for p in problems)
+
+    def test_confirm_contract_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(
+            monkeypatch, self._row(confirm_contract_ok=False,
+                                   confirm_count=5))
+        assert any("one-confirm-per-command" in p for p in problems)
+
+    def test_dispatch_contract_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(
+            monkeypatch, self._row(dispatch_contract_ok=False,
+                                   max_dispatches_per_generation=3))
+        assert any("max-one-dispatch-per-generation" in p
+                   for p in problems)
+
+    def test_old_schema_row_parses_without_dispatch_gate(self, monkeypatch):
+        # a pre-ISSUE-14 row (no dispatch keys, 10s-era budget) must
+        # still parse and pair — the new gate only arms when present
+        old = self._row()
+        row = old["4-consolidation-2000-global"]
+        for k in ("dispatch_contract_ok", "max_dispatches_per_generation"):
+            row.pop(k)
+        pairs, problems = self._run(
+            monkeypatch, old,
+            baseline={"4-consolidation-2000-global": {"total_ms": 7000.0}})
+        assert problems == []
+        assert pairs == [("4-consolidation-2000-global", 7000.0, 3500.0)]
+
+    def test_missing_row_fails_loudly(self, monkeypatch):
+        _, problems = self._run(monkeypatch, {})
+        assert any("no row produced" in p for p in problems)

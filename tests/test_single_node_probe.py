@@ -223,9 +223,13 @@ class TestSnapshotCache:
         assert hits.value(kind="delta") == 1
         assert env.registry.counter(m.DISRUPTION_SNAPSHOT_CACHE_MISSES).value() == 1
 
-        # an opaque bump (nodepool change: solver inputs move) is
-        # inexpressible by design — the cache must rebuild from scratch
+        # an opaque bump (nodepool SPEC change: solver inputs move) is
+        # inexpressible by design — the cache must rebuild from scratch.
+        # The change must be real: a status-only rewrite (the counter
+        # controller's usage refresh) no longer bumps the generation at
+        # all (ISSUE 14, state/cluster.py nodepool fingerprint)
         pool = env.store.list("nodepools")[0]
+        pool.spec.weight += 1
         env.store.update("nodepools", pool)
         for event in env.store.drain_events():
             env.cluster.on_event(event)

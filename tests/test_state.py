@@ -260,3 +260,142 @@ class TestStatePlaneExtended:
         assert not env.cluster.synced()
         env.cluster.resync()
         assert env.cluster.synced()
+
+
+class TestNodePoolFingerprint:
+    """ISSUE 14: nodepool events only bump the consolidation generation
+    when their SCHEDULING fingerprint changed — the counter controller's
+    status.resources refresh on an unlimited pool is bookkeeping, and
+    bumping for it re-opened the noop fence (and displaced the cached
+    disruption snapshot) once per node wave for nothing."""
+
+    def _drain(self, env):
+        for event in env.store.drain_events():
+            env.cluster.on_event(event)
+
+    def _env_with_pool(self, **pool_kw):
+        from karpenter_tpu.operator import Environment
+
+        env = Environment(instance_types=[make_instance_type("s", 2, 8)])
+        np_ = nodepool()
+        for k, v in pool_kw.items():
+            setattr(np_.spec, k, v)
+        env.store.create("nodepools", np_)
+        self._drain(env)
+        return env, np_
+
+    def test_status_only_write_does_not_bump(self):
+        env, np_ = self._env_with_pool()
+        before = env.cluster.consolidation_state()
+        np_.status.resources = {"cpu": 32.0, "nodes": 2.0}
+        env.store.update("nodepools", np_)
+        self._drain(env)
+        assert env.cluster.consolidation_state() == before, (
+            "usage bookkeeping on an unlimited pool must not move the "
+            "consolidation fence")
+
+    def test_spec_change_bumps_opaque(self):
+        env, np_ = self._env_with_pool()
+        before = env.cluster.consolidation_state()
+        np_.spec.weight += 1
+        env.store.update("nodepools", np_)
+        self._drain(env)
+        after = env.cluster.consolidation_state()
+        assert after > before
+        # and the bump is OPAQUE: the snapshot cache must rebuild
+        deltas = env.cluster.deltas_since(before)
+        assert deltas is not None and None in deltas
+
+    def test_template_requirement_change_bumps(self):
+        from karpenter_tpu.api.objects import NodeSelectorRequirement
+
+        env, np_ = self._env_with_pool()
+        before = env.cluster.consolidation_state()
+        np_.spec.template.requirements = [NodeSelectorRequirement(
+            key="kubernetes.io/arch", operator="In", values=["arm64"])]
+        env.store.update("nodepools", np_)
+        self._drain(env)
+        assert env.cluster.consolidation_state() > before
+
+    def test_disruption_budget_change_bumps(self):
+        env, np_ = self._env_with_pool()
+        before = env.cluster.consolidation_state()
+        np_.spec.disruption.budgets[0].nodes = "50%"
+        env.store.update("nodepools", np_)
+        self._drain(env)
+        assert env.cluster.consolidation_state() > before
+
+    def test_readiness_flip_bumps(self):
+        env, np_ = self._env_with_pool()
+        before = env.cluster.consolidation_state()
+        np_.set_condition("Ready", status="False", reason="Test")
+        env.store.update("nodepools", np_)
+        self._drain(env)
+        assert env.cluster.consolidation_state() > before
+
+    def test_usage_bumps_when_pool_has_limits(self):
+        env, np_ = self._env_with_pool(limits={"cpu": "64"})
+        before = env.cluster.consolidation_state()
+        np_.status.resources = {"cpu": 32.0, "nodes": 2.0}
+        env.store.update("nodepools", np_)
+        self._drain(env)
+        assert env.cluster.consolidation_state() > before, (
+            "remaining = spec - usage feeds the solve when limits exist")
+
+    def test_deletion_bumps(self):
+        env, np_ = self._env_with_pool()
+        before = env.cluster.consolidation_state()
+        env.store.delete("nodepools", np_)
+        self._drain(env)
+        assert env.cluster.consolidation_state() > before
+
+    def test_daemonset_events_still_bump(self):
+        from karpenter_tpu.api.objects import DaemonSet
+
+        env, _ = self._env_with_pool()
+        before = env.cluster.consolidation_state()
+        env.store.create("daemonsets", DaemonSet(
+            metadata=ObjectMeta(name="ds"),
+            template=pod("ds-tpl")))
+        self._drain(env)
+        assert env.cluster.consolidation_state() > before
+
+
+class TestOwnLeaseRenewalIsNotTakeover:
+    """ISSUE 14: a leader re-acquiring its OWN expired lease (the fake
+    clock jumped past the duration with no contender) must not resync —
+    the store's watch queue is single-consumer and only the leader
+    drains it, so nothing was missed; the resync's opaque journal bump
+    was re-opening the noop fence every time the clock outran the
+    lease. A REAL takeover (holder changed) still resyncs."""
+
+    def test_stale_own_lease_renewal_skips_resync(self, env):
+        from karpenter_tpu.operator.leaderelection import LEASE_DURATION
+
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        gen = env.cluster.consolidation_state()
+        env.clock.step(LEASE_DURATION + 5.0)  # lease now stale
+        env.run_until_idle()
+        assert env.cluster.consolidation_state() == gen, (
+            "renewing our own stale lease must not opaque-bump via resync")
+
+    def test_real_takeover_still_resyncs(self, env):
+        from karpenter_tpu.operator.leaderelection import (
+            LEASE_DURATION,
+            LeaderElector,
+        )
+
+        env.create("nodepools", nodepool())
+        env.provision(pod("p1"))
+        # another instance steals the expired lease...
+        env.clock.step(LEASE_DURATION + 5.0)
+        thief = LeaderElector(env.store, "thief", clock=env.clock)
+        assert thief.try_acquire() and thief.last_acquire_takeover
+        gen = env.cluster.consolidation_state()
+        # ...and when this instance later re-acquires, it must resync
+        env.clock.step(LEASE_DURATION + 5.0)
+        env.run_until_idle()
+        assert env.cluster.consolidation_state() > gen, (
+            "a genuine takeover must resync (events were drained by "
+            "another holder)")

@@ -122,6 +122,129 @@ class TestVolumeDetachWait:
         assert node.metadata.name not in node_names(env)
 
 
+class TestEvictWave:
+    """ISSUE 14: the store's batched eviction wave must be semantically
+    identical to sequential per-pod `evict` calls in the same order —
+    PDB allowances included — while computing each allowance once per
+    change instead of once per pod."""
+
+    def _store_with(self, n_pods, pdb=None, labels=None):
+        from karpenter_tpu.kube.store import KubeStore
+        from karpenter_tpu.utils.clock import FakeClock
+
+        store = KubeStore(FakeClock())
+        pods = []
+        for i in range(n_pods):
+            p = Pod(metadata=ObjectMeta(name=f"w{i}",
+                                        labels=dict(labels or {"app": "w"})),
+                    requests={"cpu": 0.1})
+            p.node_name = "n1"
+            p.phase = "Running"
+            store.create("pods", p)
+            pods.append(p)
+        if pdb is not None:
+            store.create("pdbs", pdb)
+        return store, pods
+
+    def _pdb(self, **kw):
+        from karpenter_tpu.api.objects import (
+            LabelSelector,
+            PodDisruptionBudget,
+        )
+
+        return PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            selector=LabelSelector(match_labels={"app": "w"}), **kw)
+
+    def _sequential(self, store, pods):
+        from karpenter_tpu.kube.store import TooManyRequests
+
+        evicted, blocked = [], []
+        for p in pods:
+            try:
+                store.evict(p)
+                evicted.append(p.metadata.name)
+            except TooManyRequests:
+                blocked.append(p.metadata.name)
+        return evicted, blocked
+
+    @pytest.mark.parametrize("pdb_kw", (
+        {"min_available": 3},
+        {"min_available": "40%"},
+        {"max_unavailable": 2},
+        {"max_unavailable": "25%"},
+        {},
+    ))
+    def test_wave_matches_sequential_evictions(self, pdb_kw):
+        a, pods_a = self._store_with(
+            8, self._pdb(**pdb_kw) if pdb_kw else None)
+        b, pods_b = self._store_with(
+            8, self._pdb(**pdb_kw) if pdb_kw else None)
+        seq_ev, seq_bl = self._sequential(a, pods_a)
+        ev, bl = b.evict_wave(pods_b)
+        assert [p.metadata.name for p in ev] == seq_ev
+        assert [p.metadata.name for p in bl] == seq_bl
+        assert {p.metadata.name for p in b.list("pods")} == {
+            p.metadata.name for p in a.list("pods")}
+
+    def test_wave_interleaves_matching_and_free_pods(self):
+        # matching pods bounded by the PDB; unmatched pods always evict —
+        # and a matched eviction invalidates only the matching PDB's memo
+        store, pods = self._store_with(4, self._pdb(min_available=3))
+        free = Pod(metadata=ObjectMeta(name="free",
+                                       labels={"app": "other"}),
+                   requests={"cpu": 0.1})
+        free.node_name = "n1"
+        free.phase = "Running"
+        store.create("pods", free)
+        ev, bl = store.evict_wave([pods[0], free, pods[1], pods[2]])
+        names = [p.metadata.name for p in ev]
+        assert "free" in names and "w0" in names
+        assert {p.metadata.name for p in bl} == {"w1", "w2"}
+
+    def test_empty_wave_is_a_noop(self):
+        store, _ = self._store_with(2)
+        assert store.evict_wave([]) == ([], [])
+
+
+class TestBatchedDrain:
+    """The termination controller drains whole command waves through ONE
+    evict_wave per poll (pods-by-node indexed), with PDB-blocked pods
+    retried on later polls — the reference's per-pod 429 semantics."""
+
+    def test_pdb_blocked_drain_retries_after_release(self, env):
+        from karpenter_tpu.api.objects import (
+            LabelSelector,
+            PodDisruptionBudget,
+        )
+        from karpenter_tpu.controllers.node import termination as term
+
+        env.create("nodepools", nodepool())
+        env.provision(pod("a"), pod("b"))
+        # a PDB that permits no disruption at all for pod "a"
+        env.create("pdbs", PodDisruptionBudget(
+            metadata=ObjectMeta(name="hold"),
+            selector=LabelSelector(match_labels={"app": "a"}),
+            min_available=1))
+        target = env.store.get("nodes", env.store.list("pods")[0].node_name)
+        blocked0 = term.STATS["evict_blocked"]
+        env.store.delete("nodes", target)
+        env.run_until_idle(max_rounds=50)
+        # the protected pod blocked the drain: node still held by the
+        # finalizer, blocked eviction accounted
+        assert term.STATS["evict_blocked"] > blocked0
+        held = [n for n in env.store.list("nodes")
+                if n.metadata.name == target.metadata.name]
+        assert held and wk.TERMINATION_FINALIZER in (
+            held[0].metadata.finalizers)
+        # release the PDB: the retry wave completes the drain
+        for pdb in env.store.list("pdbs"):
+            env.store.delete("pdbs", pdb)
+        env.run_until_idle(max_rounds=100)
+        assert all(n.metadata.name != target.metadata.name
+                   for n in env.store.list("nodes"))
+
+
 class TestHashVersionMigration:
     def test_drifted_claim_keeps_stale_hash(self, env):
         env.create("nodepools", nodepool())
