@@ -49,7 +49,12 @@ order never violated, gangs all-or-nothing (the starved-budget case
 routed, zero partial binds), node count ≤ the tiered-FFD oracle +2%,
 every preemption confirmed by real simulation before execute — and each
 row's ms regression-compares against the newest committed PERF_r*.json
-row of the same config. A >15% regression on any leg prints a delta
+row of the same config. `--spot` adds the spot-resilience leg (ISSUE 15):
+a fresh `python -m perf spot` 1000-node seeded storm must converge with
+the risk-aware end cost strictly below the risk-blind (λ=0) baseline on
+the same seed, churn bounded by the storm's interruption events, and
+zero pods lost to reclaims whose notice arrived with ≥1 round of lead —
+exit 3 on any violation. A >15% regression on any leg prints a delta
 table on stderr and
 exits 3 — the record is still on stdout, so drivers always get their
 line. KARPENTER_BENCH_SENTINEL=0 disables the gate (noisy shared boxes).
@@ -527,16 +532,21 @@ def _perf_baseline_rows() -> dict:
     }
 
 
-def _fresh_perf_rows(perf_args: list, env: dict | None = None) -> dict:
+def _fresh_perf_rows(perf_args: list, env: dict | None = None,
+                     timeout: float = 900) -> dict:
     """{config: row} from one fresh `python -m perf <args>` run."""
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "perf", *perf_args],
-            capture_output=True, text=True, timeout=900,
+            capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
             env={**os.environ, **env} if env else None,
         )
     except subprocess.TimeoutExpired:
+        # say WHY no rows exist: a leg's missing-row hard gate would
+        # otherwise misread a slow box as a broken perf harness
+        print(f"bench: perf {' '.join(perf_args)} timed out after "
+              f"{timeout:.0f}s — no rows to gate on", file=sys.stderr)
         return {}
     out = {}
     for line in proc.stdout.strip().splitlines():
@@ -668,6 +678,52 @@ def _global_pairs():
             f"{row.get('max_dispatches_per_generation')} probe dispatches "
             "in one cluster-state generation — the short-circuit's "
             "max-one-dispatch-per-generation contract broke")
+    base = _perf_baseline_rows().get(cfg)
+    if base is not None and "total_ms" in base and "total_ms" in row:
+        pairs.append((cfg, float(base["total_ms"]), float(row["total_ms"])))
+    return pairs, problems
+
+
+def _spot_pairs():
+    """(sentinel pairs, hard-gate problems) for the spot-resilience leg
+    (`--spot`): one fresh `python -m perf spot` run must hold the
+    ISSUE-15 acceptance — the 1000-node seeded storm converges with the
+    risk-aware end cost strictly below the risk-blind (λ=0) baseline on
+    the same seed, churn bounded by the storm's interruption events, and
+    zero pods lost to reclaims whose notice arrived with ≥1 round of
+    lead. Regression pairs compare the row's total_ms against the newest
+    committed PERF_r*.json row of the same config."""
+    # the risk-blind leg alone measures ~30 min on the reference box (its
+    # churn IS the point): give the child real headroom over that
+    fresh = _fresh_perf_rows(["spot"], timeout=4500)
+    problems, pairs = [], []
+    row = next((r for r in fresh.values()
+                if r.get("config", "").startswith("spot-")), None)
+    if row is None:
+        problems.append(
+            "spot: no row produced — the spot-resilience gate was never "
+            "evaluated")
+        return pairs, problems
+    cfg = row["config"]
+    if row.get("cost_beats_blind") is False:
+        aware = (row.get("risk_aware") or {}).get("end_cost")
+        blind = (row.get("risk_blind") or {}).get("end_cost")
+        problems.append(
+            f"spot: {cfg} risk-aware end cost {aware} did not beat the "
+            f"risk-blind baseline {blind} — the risk discount bought "
+            "nothing")
+    if row.get("churn_bound_ok") is False:
+        problems.append(
+            f"spot: {cfg} created {(row.get('risk_aware') or {}).get('creates')} "
+            f"nodes against a churn bound of {row.get('churn_bound')} — "
+            "the storm cascaded")
+    if row.get("zero_late_drain_ok") is False:
+        lost = ((row.get("risk_aware") or {}).get("pods_lost_with_lead", 0)
+                + (row.get("risk_blind") or {}).get("pods_lost_with_lead", 0))
+        problems.append(
+            f"spot: {cfg} lost {lost} pod(s) to reclaims whose notice "
+            "arrived with >=1 round of lead — the proactive drain "
+            "machinery failed")
     base = _perf_baseline_rows().get(cfg)
     if base is not None and "total_ms" in base and "total_ms" in row:
         pairs.append((cfg, float(base["total_ms"]), float(row["total_ms"])))
@@ -876,7 +932,7 @@ def _multichip_pairs():
 
 def sentinel(record: dict, consolidation: bool = False,
              multitenant: bool = False, multichip: bool = False,
-             priority: bool = False) -> int:
+             priority: bool = False, spot: bool = False) -> int:
     """Exit code for the regression gate: 0 clean/ungated, 3 on a >15%
     headline-solve, consolidation, or multi-tenant-fleet regression vs
     the newest committed records. Headline comparison is ENGINE-GATED (an
@@ -945,6 +1001,15 @@ def sentinel(record: dict, consolidation: bool = False,
             print("bench: priority/gang admission gate failed "
                   "(KARPENTER_BENCH_SENTINEL=0 to disable):", file=sys.stderr)
             for p in p_problems:
+                print(f"bench:   {p}", file=sys.stderr)
+            return 3
+    if spot:
+        s_pairs, s_problems = _spot_pairs()
+        pairs.extend(s_pairs)
+        if s_problems:
+            print("bench: spot-resilience gate failed "
+                  "(KARPENTER_BENCH_SENTINEL=0 to disable):", file=sys.stderr)
+            for p in s_problems:
                 print(f"bench:   {p}", file=sys.stderr)
             return 3
     if not pairs:
@@ -1065,7 +1130,8 @@ def main():
                     rec, consolidation="--consolidation" in sys.argv,
                     multitenant="--multitenant" in sys.argv,
                     multichip="--multichip" in sys.argv,
-                    priority="--priority" in sys.argv)
+                    priority="--priority" in sys.argv,
+                    spot="--spot" in sys.argv)
                 if rc == 0 and "--replay-verify" in sys.argv:
                     # capture the headline solve, replay it in a fresh
                     # interpreter, exit 3 on parity/rung mismatch

@@ -29,6 +29,12 @@ REASON_EMPTY = "Empty"
 REASON_DRIFTED = "Drifted"
 REASON_EXPIRED = "Expired"
 ALL_REASONS = (REASON_UNDERUTILIZED, REASON_EMPTY, REASON_DRIFTED, REASON_EXPIRED)
+# spot interruption is INVOLUNTARY disruption: the provider reclaims the
+# capacity whether or not a budget window is open, so the proactive drain
+# is not budget-gated and the reason stays OUT of ALL_REASONS (budgets
+# bound voluntary disruption only — the reference's interruption
+# controller takes the same stance)
+REASON_INTERRUPTED = "Interrupted"
 
 
 @dataclass

@@ -18,7 +18,7 @@ from karpenter_tpu.cloudprovider.types import (
     CloudProvider,
     InsufficientCapacityError,
     NodeClaimNotFoundError,
-    instance_type_compatible,
+    cheapest_effective_offering,
 )
 from karpenter_tpu.scheduling import Requirements, node_selector_requirements
 
@@ -75,14 +75,9 @@ class FakeCloudProvider(CloudProvider):
             return claim
 
     def _cheapest(self, reqs: Requirements, requests: dict):
-        best = None
-        for it in self.instance_types:
-            if not instance_type_compatible(it, reqs, requests):
-                continue
-            for o in it.offerings.available().compatible(reqs):
-                if best is None or o.price < best[1].price:
-                    best = (it, o)
-        return best
+        # cheapest EFFECTIVE offering (the shared launch-placement rule)
+        return cheapest_effective_offering(self.instance_types, reqs,
+                                           requests)
 
     def delete(self, node_claim: NodeClaim) -> None:
         with self._lock:

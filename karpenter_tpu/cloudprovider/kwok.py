@@ -19,7 +19,7 @@ from karpenter_tpu.cloudprovider.types import (
     CloudProvider,
     InsufficientCapacityError,
     NodeClaimNotFoundError,
-    instance_type_compatible,
+    cheapest_effective_offering,
 )
 from karpenter_tpu.scheduling import node_selector_requirements
 
@@ -41,13 +41,11 @@ class KwokCloudProvider(CloudProvider):
 
     def create(self, node_claim: NodeClaim) -> NodeClaim:
         reqs = node_selector_requirements(node_claim.spec.requirements)
-        best = None
-        for it in self.instance_types:
-            if not instance_type_compatible(it, reqs, node_claim.spec.resource_requests):
-                continue
-            for o in it.offerings.available().compatible(reqs):
-                if best is None or o.price < best[1].price:
-                    best = (it, o)
+        # launch placement is risk-aware (the shared
+        # cheapest_effective_offering rule): a λ > 0 deployment buys
+        # low-interruption-risk capacity; λ=0 keeps the nominal cheapest
+        best = cheapest_effective_offering(
+            self.instance_types, reqs, node_claim.spec.resource_requests)
         if best is None:
             raise InsufficientCapacityError(
                 f"no instance type available for claim {node_claim.name}"

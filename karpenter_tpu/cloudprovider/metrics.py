@@ -18,6 +18,10 @@ class MetricsCloudProvider(CloudProvider):
     def __init__(self, inner: CloudProvider, registry=None):
         self.inner = inner
         self.registry = registry or m.REGISTRY
+        # offering-risk gauge bookkeeping: instance-type name -> the
+        # (type, zone, ct) label keys last exported for it, so a refresh
+        # retires exactly the stale series of the types it re-saw
+        self._risk_keys: dict = {}
 
     def _timed(self, method: str, fn, *args, **kw):
         t0 = time.perf_counter()
@@ -47,10 +51,47 @@ class MetricsCloudProvider(CloudProvider):
         return self._timed("List", self.inner.list)
 
     def get_instance_types(self, node_pool):
-        return self._timed("GetInstanceTypes", self.inner.get_instance_types, node_pool)
+        its = self._timed(
+            "GetInstanceTypes", self.inner.get_instance_types, node_pool)
+        self._export_offering_risk(its)
+        return its
+
+    def _export_offering_risk(self, its):
+        """Refresh the ``karpenter_offering_risk`` gauge from the catalog
+        snapshot (offerings with a KNOWN nonzero risk only — on-demand's
+        0.0 and unknown Nones would just multiply series). Catalog lists
+        are memoized by the callers (get_candidates' catalog cache, the
+        solver's type cache), so this runs per cache fill, not per poll.
+        Reconciled PER TYPE, never a family-wide clear: providers may
+        filter catalogs per nodepool, and one pool's refresh must retire
+        only the stale series of the types it re-saw — not wipe every
+        other pool's. (A type that vanishes from the catalog entirely
+        keeps its last series until some call re-lists it; per-pool
+        attribution isn't available at this seam.)"""
+        g = self.registry.gauge(
+            m.OFFERING_RISK,
+            "per-offering interruption-risk signal (spot resilience)")
+        for it in its:
+            new = {}
+            for o in it.offerings:
+                if o.interruption_risk:
+                    new[(it.name, o.zone, o.capacity_type)] = (
+                        o.interruption_risk)
+            for tn, z, ct in self._risk_keys.get(it.name, set()) - new.keys():
+                g.remove(instance_type=tn, zone=z, capacity_type=ct)
+            for (tn, z, ct), v in new.items():
+                g.set(v, instance_type=tn, zone=z, capacity_type=ct)
+            self._risk_keys[it.name] = set(new)
 
     def is_drifted(self, node_claim):
         return self._timed("IsDrifted", self.inner.is_drifted, node_claim)
+
+    def interruption_notices(self):
+        # explicit delegation: the CloudProvider base default ([]) would
+        # otherwise shadow __getattr__ and swallow the inner provider's
+        # (or an armed ChaosCloud's) notice feed
+        return self._timed(
+            "InterruptionNotices", self.inner.interruption_notices)
 
     def __getattr__(self, item):
         # pass through provider-specific surface (e.g. kwok's .created)

@@ -24,6 +24,7 @@ from karpenter_tpu.controllers.disruption.methods import (
     Emptiness,
     EmptyNodeConsolidation,
     GlobalConsolidation,
+    InterruptionDrain,
     MultiNodeConsolidation,
     SingleNodeConsolidation,
 )
@@ -38,7 +39,8 @@ ABNORMAL_RUN_GAP = 15 * 60.0  # logAbnormalRuns threshold (controller.go:274-283
 
 
 class DisruptionContext:
-    def __init__(self, provisioner, cluster, store, clock, options=None, registry=None):
+    def __init__(self, provisioner, cluster, store, clock, options=None,
+                 registry=None, cloud=None):
         from karpenter_tpu.operator import metrics as _m
         from karpenter_tpu.ops.consolidate import SnapshotCache
 
@@ -48,6 +50,9 @@ class DisruptionContext:
         self.clock = clock
         self.options = options or {}
         self.registry = registry or _m.REGISTRY
+        # the cloud provider seam: InterruptionDrain rebuilds candidates
+        # for noticed nodes the voluntary-disruption filters excluded
+        self.cloud = cloud
         # one tensorization per cluster-state generation, shared by every
         # consolidation probe and confirming simulation in a round
         # (ops/consolidate.py documents the invalidation contract)
@@ -93,10 +98,16 @@ class DisruptionController:
         self.poll_period = poll_period
         self.validation_ttl = validation_ttl
         self.ctx = DisruptionContext(
-            provisioner, cluster, store, self.clock, options, registry=self.registry
+            provisioner, cluster, store, self.clock, options,
+            registry=self.registry, cloud=cloud,
         )
         self.queue = OrchestrationQueue(store, cluster, self.clock, recorder)
         self.methods = [
+            # interruption FIRST: a reclaim deadline outranks every
+            # voluntary method — the node is leaving whether we act or
+            # not, and acting early is the whole resilience story
+            # (deploy/README.md "Spot resilience")
+            InterruptionDrain(self.ctx),
             Drift(self.ctx),
             Emptiness(self.ctx),
             EmptyNodeConsolidation(self.ctx),
@@ -129,6 +140,10 @@ class DisruptionController:
 
     def poll(self) -> bool:
         progressed = self.queue.poll()
+        # interruption notices are pulled on EVERY poll (not only on the
+        # 10 s round cadence): a two-minute warning must reach cluster
+        # state the moment it exists — the pull is one drained list
+        self._pull_interruption_notices()
         now = self.clock.now()
         if now - self._last_run < self.poll_period:
             return progressed
@@ -146,6 +161,49 @@ class DisruptionController:
             if self._pending is not None:
                 return self._handle_pending() or progressed
             return self._compute_round() or progressed
+
+    # -- interruption notices (spot resilience) --------------------------
+    def _pull_interruption_notices(self):
+        """Drain the cloud provider's interruption feed onto cluster
+        state: each notice marks its StateNode with the reclaim deadline
+        (``Cluster.note_interruption`` — a node-scoped journal entry, so
+        the cached disruption snapshot delta-advances), lands a store
+        event through the recorder, and counts on
+        ``karpenter_interruption_notices_total{outcome}``."""
+        from karpenter_tpu.operator import metrics as m
+
+        fn = getattr(self.cloud, "interruption_notices", None)
+        if fn is None:
+            return
+        try:
+            notices = fn()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "interruption-notice pull failed; retrying next poll",
+                exc_info=True)
+            return
+        if not notices:
+            return
+        counter = self.registry.counter(
+            m.INTERRUPTION_NOTICES,
+            "spot interruption notices pulled from the cloud provider")
+        for n in notices:
+            marked = self.cluster.note_interruption(n.provider_id,
+                                                    n.deadline)
+            counter.inc(outcome="marked" if marked else "unknown-node")
+            if marked and self.recorder is not None:
+                sn = self.cluster.node_for(n.provider_id)
+                self.recorder.publish(
+                    "SpotInterruptionNotice",
+                    f"capacity behind {sn.name if sn else n.provider_id} "
+                    f"will be reclaimed at {n.deadline:.0f}",
+                )
+
+    def _has_interruptions(self) -> bool:
+        return any(sn.interruption_pending()
+                   for sn in self.cluster.state_nodes())
 
     # -- watchdog (logAbnormalRuns, controller.go:274-283) ---------------
     def _log_abnormal_run(self, now: float):
@@ -210,7 +268,10 @@ class DisruptionController:
         for pool, by_reason in budgets.items():
             for reason, allowed in by_reason.items():
                 bg.set(allowed, nodepool=pool, reason=reason)
-        if not candidates:
+        if not candidates and not self._has_interruptions():
+            # noticed nodes must reach InterruptionDrain even when every
+            # node fails the VOLUNTARY-disruption filters (do-not-disrupt,
+            # PDB) — the reclaim doesn't care about those
             obs.discard_round()  # idle tick: nothing disruptable
             return False
         fence = self.cluster.consolidation_state()

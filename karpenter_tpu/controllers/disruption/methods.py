@@ -20,6 +20,7 @@ from karpenter_tpu.api.nodepool import (
     CONSOLIDATION_WHEN_UNDERUTILIZED,
     REASON_DRIFTED,
     REASON_EMPTY,
+    REASON_INTERRUPTED,
     REASON_UNDERUTILIZED,
 )
 from karpenter_tpu.controllers.disruption.helpers import (
@@ -132,6 +133,232 @@ class Emptiness(Method):
         if not empty:
             return None
         return Command(empty, reason=self.reason)
+
+
+class InterruptionDrain(Method):
+    """Proactive spot drain-and-replace (deploy/README.md "Spot
+    resilience"). An interruption notice marks the node on cluster state
+    (``Cluster.note_interruption``, pulled from the cloud provider by the
+    disruption controller); this method — ordered before every
+    consolidation method, because a reclaim deadline outranks any
+    voluntary optimization — ships ONE command per notice-bearing round:
+
+    * **proactive** (the top rung): the replacement is solved off the
+      round's cached :class:`DisruptionSnapshot` — one counterfactual row
+      on the existing probe/dispatch seam (recorded under the
+      ``interruption.dispatch`` replay-capsule seam) asks whether the
+      SURVIVORS absorb every displaced pod, then the confirming
+      ``simulate_scheduling`` sizes the actual replacement claims — and
+      because ``needs_validation`` is False the command executes this
+      round: replacements launch immediately, the orchestration queue
+      holds the candidate-claim deletion until every replacement is
+      Initialized, and only then does the PDB-gated drain wave ship.
+      A notice with ≥1 round of lead therefore never loses a pod to the
+      reclaim — the zero-late-drain acceptance ``python -m perf spot``
+      and ``bench.py --spot`` gate on.
+    * **degraded**: a deadline already inside
+      ``KARPENTER_INTERRUPTION_MIN_LEAD`` (30 s) — or one that arrives
+      MID-SOLVE (the simulation outran the clock) — degrades gracefully
+      to an immediate drain with no replacement wait: salvaging part of
+      the workload beats wedging the round against a dead deadline.
+    * **reactive**: the replacement solve cannot place the pods (no
+      capacity); the node drains bare and the provisioner's
+      deleting-node pre-provisioning rescues what it can post-drain.
+
+    Interruption is INVOLUNTARY disruption: budgets are not consulted
+    (the capacity is leaving either way) and nodes the candidate filters
+    exclude (do-not-disrupt, PDB-blocked) are still drained — a blocked
+    eviction retries until the deadline kills the node, which is the
+    cloud's doing, not ours. Every round records one
+    ``disrupt.interruption`` decision-ledger verdict (closed enums,
+    obs/decisions.py)."""
+
+    reason = REASON_INTERRUPTED
+    needs_validation = False  # a validation TTL would eat the deadline
+    last_rung: str = ""  # "proactive" | "reactive" | "degraded" (tests)
+
+    @property
+    def uses_bundle(self) -> bool:
+        """Ask the controller to prewarm the round's snapshot ONLY when a
+        live notice exists: the absorb probe rides the bundle, but a
+        notice-free round must not pay a fleet tensorization for a method
+        that returns None immediately."""
+        cluster = getattr(self.ctx, "cluster", None)
+        if cluster is None:
+            return False
+        return any(sn.interruption_pending()
+                   for sn in cluster.state_nodes())
+
+    def _verdict(self, rung, reason="ok"):
+        from karpenter_tpu.obs import decisions
+
+        self.last_rung = rung
+        decisions.record_decision("disrupt.interruption", rung, reason,
+                                  registry=self.ctx.registry)
+
+    def compute_command(self, candidates, budgets):
+        self.last_rung = ""
+        noticed = self._noticed(candidates)
+        if not noticed:
+            return None
+        ctx = self.ctx
+        from karpenter_tpu.operator import metrics as m
+        from karpenter_tpu.utils.envknobs import env_float
+
+        min_lead = env_float("KARPENTER_INTERRUPTION_MIN_LEAD", 30.0,
+                             minimum=0.0)
+        # PARTITION by lead, never aggregate: one short-lead notice must
+        # not degrade nodes whose deadlines still leave room for the
+        # proactive replace — the urgent subset drains NOW (most urgent
+        # first wins the round) and the with-lead rest rides the next
+        # poll, still far inside its lead
+        urgent = [c for dl, c in noticed
+                  if ctx.clock.now() + min_lead > dl]
+        if urgent:
+            return self._degrade(urgent)
+        deadline = min(dl for dl, _ in noticed)
+        cands = [c for _, c in noticed]
+        absorbed = self._absorb_probe(cands)
+        if absorbed:
+            # the device row says the SURVIVORS absorb every displaced pod
+            # with zero fresh claims: ship the delete-only drain without
+            # paying the host simulation — the fastest possible path on a
+            # ticking deadline. The probe can only OVER-estimate (f32 fit):
+            # a wrong "absorbed" leaves pods pending post-drain and the
+            # provisioner re-provisions them next round — the reactive
+            # path's behavior, never a wedge or a loss.
+            self._verdict("proactive", "delete-only")
+            ctx.registry.counter(
+                m.INTERRUPTION_PROACTIVE_DRAINS,
+                "interruption-noticed nodes drained proactively "
+                "(replacement launched-and-ready before the drain wave)",
+            ).inc(len(cands))
+            return Command(cands, reason=self.reason)
+        cache = getattr(ctx, "snapshot_cache", None)
+        bundle = (
+            cache.refresh(ctx.provisioner, ctx.cluster, ctx.store,
+                          registry=ctx.registry)
+            if cache is not None else None
+        )
+        inputs = cache.inputs_for(ctx.cluster) if cache is not None else None
+        with obs.span("confirm.simulate", method="interruption",
+                      noticed=len(cands), absorbed=absorbed):
+            sim = simulate_scheduling(
+                ctx.provisioner, ctx.cluster, ctx.store, cands,
+                inputs=inputs, bundle=bundle,
+            )
+        if ctx.clock.now() + min_lead > deadline:
+            # a deadline arrived mid-solve: shipping a replacement wait
+            # now would outlive that capacity — degrade the now-urgent
+            # subset instead of wedging (the rest retries next poll)
+            urgent = [c for dl, c in noticed
+                      if ctx.clock.now() + min_lead > dl]
+            return self._degrade(urgent or cands)
+        if not sim.all_pods_scheduled():
+            self._verdict("reactive", "reactive-fallback")
+            return Command(cands, reason=self.reason)
+        self._verdict("proactive",
+                      "ok" if sim.new_claims else "delete-only")
+        ctx.registry.counter(
+            m.INTERRUPTION_PROACTIVE_DRAINS,
+            "interruption-noticed nodes drained proactively (replacement "
+            "launched-and-ready before the drain wave)",
+        ).inc(len(cands))
+        return Command(cands, replacements=sim.new_claims,
+                       reason=self.reason)
+
+    def _degrade(self, cands):
+        from karpenter_tpu.operator import metrics as m
+
+        self._verdict("degraded", "deadline-degraded")
+        self.ctx.registry.counter(
+            m.INTERRUPTION_DEADLINE_DEGRADATIONS,
+            "interruption notices whose deadline forced the immediate-"
+            "drain degradation (no replacement wait)",
+        ).inc(len(cands))
+        return Command(cands, reason=self.reason)
+
+    def _noticed(self, candidates):
+        """[(deadline, Candidate)] for every live noticed node, soonest
+        first. Candidates the controller's filters excluded
+        (do-not-disrupt, PDB) are rebuilt directly — an interruption
+        ignores voluntary-disruption gates."""
+        ctx = self.ctx
+        if getattr(ctx, "cluster", None) is None:
+            return []
+        by_pid = {c.provider_id: c for c in candidates}
+        out = []
+        view = None
+        for sn in list(ctx.cluster.state_nodes()):
+            if not sn.interruption_pending():
+                continue
+            dl = sn.interruption_deadline
+            c = by_pid.get(sn.provider_id)
+            if c is None:
+                if view is None:
+                    from karpenter_tpu.cloudprovider.types import CatalogView
+
+                    view = CatalogView(ctx.store.list("nodepools"),
+                                       ctx.cloud)
+                c = self._make_candidate(sn, view)
+                if c is None:
+                    continue
+            out.append((dl, c))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def _make_candidate(self, sn, view):
+        from karpenter_tpu.controllers.disruption.types import Candidate
+
+        labels = sn.labels()
+        np_ = view.pool_of(labels)
+        if np_ is None:
+            return None
+        it = (view.instance_type(labels)
+              if getattr(self.ctx, "cloud", None) is not None else None)
+        return Candidate(sn.snapshot(), np_, it, self.ctx.clock)
+
+    def _absorb_probe(self, cands):
+        """One counterfactual row on the cached bundle's dispatch seam:
+        do the SURVIVING nodes absorb every noticed node's pods with zero
+        fresh claims? ``True`` short-circuits the host simulation (a
+        delete-only drain ships immediately — the over-estimate direction
+        degrades to the provisioner rescue, see compute_command);
+        ``False``/``None`` hands the decision to the simulation. Recorded
+        under the ``interruption.dispatch`` capsule seam so an anomalous
+        storm round replays offline. None when the bundle cannot express
+        the query — probe failures must never block an interruption
+        drain."""
+        import numpy as np
+
+        ctx = self.ctx
+        cache = getattr(ctx, "snapshot_cache", None)
+        bundle = cache.current(ctx.cluster) if cache is not None else None
+        if bundle is None:
+            return None
+        try:
+            cols = bundle.columns_for(cands)
+            if cols is None:
+                return None
+            contrib = bundle.contribs_for(cands, cols=cols)
+            if contrib is None:
+                return None
+            need = contrib.sum(axis=0)
+            row = (bundle.base + need)[None, :]
+            with obs.span("interruption.probe", candidates=len(cands)):
+                placed_g, used = bundle.dispatch(
+                    row, [np.asarray(cols, dtype=np.intp)],
+                    seam="interruption.dispatch")
+            G = bundle.snap.G
+            return bool((placed_g[0, :G] >= need).all()
+                        and int(used[0]) == 0)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "interruption absorb probe failed; the confirming "
+                "simulation decides alone", exc_info=True)
+            return None
 
 
 def _consolidatable(candidates):
@@ -296,7 +523,13 @@ def compute_consolidation(ctx, candidates) -> Command | None:
     # the replacement must launch strictly cheaper than the candidates cost
     # now: filter its instance types to the cheaper-than-current set
     # (consolidation.go filterByPrice:210), keeping the comparison price
-    # (spot-only when the whole candidate set is spot)
+    # (spot-only when the whole candidate set is spot). Both sides of the
+    # comparison are risk-discounted EFFECTIVE prices (candidate_prices
+    # reads Candidate.price, which already is), so with λ > 0 a
+    # consolidation only ships when the replacement is cheaper risk
+    # included — a nominally-cheap high-risk offering does not buy churn
+    from karpenter_tpu.cloudprovider.types import effective_price, risk_lambda
+    lam = risk_lambda()  # hoisted: one env read, not one per offering
     priced = []
     for it in replacement.instance_types:
         ofs = it.offerings.available().compatible(replacement.requirements)
@@ -305,7 +538,7 @@ def compute_consolidation(ctx, candidates) -> Command | None:
             ofs = type(ofs)(o for o in ofs if o.capacity_type == wk.CAPACITY_TYPE_SPOT)
         if not ofs:
             continue
-        p = min(o.price for o in ofs)
+        p = min(effective_price(o, lam) for o in ofs)
         if p < current_price:
             priced.append((p, it))
     if not priced:
@@ -364,14 +597,21 @@ def filter_out_same_type(replacement, candidates) -> list:
     options include a type we are deleting, drop every option that is not
     strictly cheaper than the cheapest such overlapping node — otherwise the
     "consolidation" would relaunch one of its own victims, which is just a
-    delete with extra churn.
+    delete with extra churn. All comparisons run on risk-discounted
+    EFFECTIVE prices (Candidate.price and effective_price; nominal at λ=0).
 
     A same-type candidate with UNKNOWN price (delisted offering, price <= 0)
-    cannot anchor the strictly-cheaper comparison, so its type is removed
-    from the options outright (ADVICE.md round 5): we cannot prove a relaunch
-    of that type is cheaper than the node we are deleting, and the
-    conservative stance is to never buy what we can't price — the command
-    degrades toward delete-only rather than risking a same-cost relaunch."""
+    cannot anchor the strictly-cheaper comparison directly. The original
+    ADVICE.md round-5 stance dropped its type from the options outright
+    (delete-only direction). Under λ > 0 that blanket stance narrows (the
+    round-5 gap close): when the delisted candidate's type still has an
+    available, priced offering of the OTHER capacity type whose risk is
+    KNOWN, that offering's effective price anchors the comparison instead
+    — pricing the same-type spot↔on-demand move the old stance forbade.
+    A type with no such risk-known cross-capacity offering — or any
+    λ=0 deployment (the anchor is λ-gated so the risk-blind default is
+    bit-identical to pre-ISSUE-15 behavior) — keeps the conservative
+    delete-only treatment: we still never buy what we can't price."""
     existing_prices: dict = {}
     unknown_types: set = set()
     for c in candidates:
@@ -379,8 +619,11 @@ def filter_out_same_type(replacement, candidates) -> list:
             continue
         p = c.price
         if p <= 0:
-            unknown_types.add(c.instance_type.name)
-            continue
+            anchor = _cross_capacity_anchor(c)
+            if anchor is None:
+                unknown_types.add(c.instance_type.name)
+                continue
+            p = anchor
         prev = existing_prices.get(c.instance_type.name)
         if prev is None or p < prev:
             existing_prices[c.instance_type.name] = p
@@ -397,12 +640,44 @@ def filter_out_same_type(replacement, candidates) -> list:
             max_price = min(max_price, existing_prices[it.name])
     if max_price == float("inf"):
         return options
+    from karpenter_tpu.cloudprovider.types import effective_price, risk_lambda
+
+    lam = risk_lambda()  # hoisted: one env read, not one per offering
     kept = []
     for it in options:
         ofs = it.offerings.available().compatible(replacement.requirements)
-        if ofs and min(o.price for o in ofs) < max_price:
+        if ofs and min(effective_price(o, lam) for o in ofs) < max_price:
             kept.append(it)
     return kept
+
+
+def _cross_capacity_anchor(c) -> float | None:
+    """Effective price anchoring an unpriceable candidate's same-type
+    comparison through the OTHER capacity type: the cheapest available,
+    priced offering of ``c.instance_type`` in a different capacity type
+    whose ``interruption_risk`` is KNOWN (not None). None = no such
+    offering, keep the delete-only stance (filter_out_same_type).
+
+    Gated on λ > 0: the anchor only engages once the operator has opted
+    into risk-discounted economics, so the default λ=0 deployment keeps
+    the pre-ISSUE-15 delete-only behavior EXACTLY (the λ=0 bit-parity
+    acceptance covers behavior, not just the price tensors)."""
+    from karpenter_tpu.cloudprovider.types import effective_price, risk_lambda
+
+    lam = risk_lambda()
+    if lam <= 0.0 or c.instance_type is None:
+        return None
+    ct = getattr(c, "capacity_type", "")
+    best = None
+    for o in c.instance_type.offerings.available():
+        if o.capacity_type == ct or o.price <= 0:
+            continue
+        if o.interruption_risk is None:
+            continue  # unknown risk: cannot vouch for the move
+        p = effective_price(o, lam)
+        if best is None or p < best:
+            best = p
+    return best
 
 
 def _probe_failure(ctx, method_label, site):

@@ -10,11 +10,18 @@ simulation produced, with the resulting action (types.go:103-169).
 from __future__ import annotations
 
 from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider.types import effective_price
 from karpenter_tpu.utils.disruption import disruption_cost
 
 
 class Candidate:
     def __init__(self, state_node, node_pool, instance_type, clock):
+        from karpenter_tpu.cloudprovider.types import risk_lambda
+
+        # λ snapshotted at discovery: candidates live one round, and the
+        # price property is read across thousands of candidates per round
+        # — one env parse per candidate, not one per access
+        self._risk_lambda = risk_lambda()
         self.state_node = state_node
         self.node_pool = node_pool
         self.instance_type = instance_type
@@ -39,13 +46,23 @@ class Candidate:
 
     @property
     def price(self) -> float:
-        """Current offering price for this node's (zone, capacity type)."""
+        """Current EFFECTIVE offering price for this node's (zone,
+        capacity type): risk-discounted per cloudprovider/types.
+        effective_price, so a risky spot node reads as more expensive to
+        keep and consolidation prefers retiring it first — bit-identical
+        to the nominal price at λ=0 (the risk-blind default)."""
+        o = self.current_offering()
+        return (effective_price(o, self._risk_lambda)
+                if o is not None else 0.0)
+
+    def current_offering(self):
+        """The catalog Offering this node runs on, or None (delisted)."""
         if self.instance_type is None:
-            return 0.0
+            return None
         for o in self.instance_type.offerings:
             if o.zone == self.zone and o.capacity_type == self.capacity_type:
-                return o.price
-        return 0.0
+                return o
+        return None
 
     def __repr__(self):
         return f"Candidate({self.name}, cost={self.disruption_cost:.2f})"

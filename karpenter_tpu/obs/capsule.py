@@ -34,6 +34,11 @@ rule proves them jit-unreachable):
   (ops/consolidate.py ``joint_retirement_plan``): identical tensor
   layout, so an anomalous joint round replays through the identical
   chunked program and the A/B table races its device/native pair.
+- ``interruption.dispatch`` — the SAME dispatch again when the
+  ``InterruptionDrain`` method probes whether the survivors absorb a
+  noticed node's pods before its reclaim deadline
+  (controllers/disruption/methods.py): identical row layout, so a
+  storm round's replacement solve replays offline after the storm.
 - ``service.solve`` — service/solver_service.py (tenant-scoped: the
   capsule carries and is filed under the tenant).
 
@@ -104,7 +109,7 @@ OUT_PREFIX = "out//"
 CF_PREFIX = "cf//"
 
 SEAMS = ("solver.invoke", "mesh.solve", "probe.dispatch", "service.solve",
-         "preempt.dispatch", "global.dispatch")
+         "preempt.dispatch", "global.dispatch", "interruption.dispatch")
 
 # knobs from the captured env snapshot that replay re-applies around the
 # mesh rungs: they decide whether/how the snapshot partitions, so a dev
@@ -456,8 +461,10 @@ class _applied_env:
 
 # seams whose capture is the chunked counterfactual-row dispatch (shared
 # replay body `_run_probe`): the per-candidate probe, the preemption
-# counterfactual, and the global joint consolidation ladder
-_ROW_SEAMS = ("probe.dispatch", "preempt.dispatch", "global.dispatch")
+# counterfactual, the global joint consolidation ladder, and the
+# interruption-drain absorb probe
+_ROW_SEAMS = ("probe.dispatch", "preempt.dispatch", "global.dispatch",
+              "interruption.dispatch")
 
 
 def _captured_rung(cap: Capsule) -> str:

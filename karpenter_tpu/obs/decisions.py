@@ -169,6 +169,24 @@ SITES = {
             "too-few-candidates", "no-device", "joint-noop-fenced",
         }),
     },
+    "disrupt.interruption": {
+        # controllers/disruption/methods.py InterruptionDrain: one verdict
+        # per notice-bearing round — the replacement was solved and
+        # launched BEFORE the drain (proactive; delete-only when the
+        # survivors absorb every displaced pod), the replacement solve
+        # could not place the pods so the node drains bare and the
+        # provisioner rescues post-drain (reactive), or the deadline left
+        # no time for a replacement at all and the round degraded to an
+        # immediate drain (degraded). Degradations are cloud-driven (a
+        # short-lead notice) but a fleet whose proactive path silently
+        # dies — every notice degrading — is exactly what this site's
+        # regression tracker exists to catch, so nothing is benign.
+        "rungs": ("proactive", "reactive", "degraded"),
+        "reasons": frozenset({
+            "ok", "delete-only", "reactive-fallback", "deadline-degraded",
+            OTHER_REASON,
+        }),
+    },
     "solver.route": {
         # models/solver.py TPUSolver.solve: which engine ran the kernel
         # (or that no kernel ran at all — the host FFD rung).

@@ -102,6 +102,13 @@ class Gauge(_Metric):
         with self._lock:
             self._values.clear()
 
+    def remove(self, **labels):
+        """Delete ONE label combination — for exporters that reconcile a
+        partial view (e.g. one pool's catalog) and must retire exactly
+        the series they own without clearing the whole family."""
+        with self._lock:
+            self._values.pop(_labels_key(labels), None)
+
     def expose(self) -> list:
         out = self._expose_header("gauge")
         with self._lock:
@@ -238,6 +245,20 @@ TENSORIZE_NEGATIVE_AVAIL = f"{NAMESPACE}_tensorize_negative_avail_total"
 # path, by reason label (waves compiler inexpressibles, spec ineligibility,
 # small-batch cutoff) — a grid regression shows up here as a reason spike
 PROVISIONING_HOST_ROUTED = f"{NAMESPACE}_provisioning_host_routed_pods_total"
+# spot resilience (deploy/README.md "Spot resilience"): interruption
+# notices pulled from the cloud provider (outcome=marked|unknown-node),
+# nodes drained proactively ahead of their notice deadline, notices whose
+# deadline forced the degraded immediate-drain path, and the per-offering
+# interruption-risk signal (labels instance_type/zone/capacity_type,
+# known nonzero risks only — exported by cloudprovider/metrics.py)
+INTERRUPTION_NOTICES = f"{NAMESPACE}_interruption_notices_total"
+INTERRUPTION_PROACTIVE_DRAINS = (
+    f"{NAMESPACE}_interruption_proactive_drains_total"
+)
+INTERRUPTION_DEADLINE_DEGRADATIONS = (
+    f"{NAMESPACE}_interruption_deadline_degradations_total"
+)
+OFFERING_RISK = f"{NAMESPACE}_offering_risk"
 # admission plane (karpenter_tpu/admission): victim pods evicted by a
 # confirmed preemption, and preemption ladder outcomes by outcome label
 # (the per-rung mix also rides karpenter_decision_total{site="admission.*"})

@@ -79,6 +79,16 @@ ISSUE 14 makes the whole disruption round device-bound end to end:
   the store's ``evict_wave`` — see deploy/README.md "Global
   consolidation" for the row schema and knob table.
 
+Spot resilience rides the same machinery with zero new dispatch paths
+(ISSUE 15, deploy/README.md "Spot resilience"): the snapshot's
+``off_price`` tensor carries the risk-discounted EFFECTIVE price
+(``price × (1 + λ·risk)``, cloudprovider/types.effective_price — nominal
+at λ=0), so ``min_price``, ``_type_price_vectors``, and both criteria
+below are risk-aware through the numbers they already read; and the
+``InterruptionDrain`` method's absorb probe is one counterfactual row
+through :meth:`DisruptionSnapshot.dispatch` under the
+``interruption.dispatch`` capture seam.
+
 Snapshot-cache invalidation contract
 ------------------------------------
 

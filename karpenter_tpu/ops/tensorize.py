@@ -278,7 +278,11 @@ class DeviceSnapshot:
     off_zone: np.ndarray  # [T,O] i32 (bit index into zone vocab; -1 = none)
     off_ct: np.ndarray  # [T,O] i32
     off_avail: np.ndarray  # [T,O] bool
-    off_price: np.ndarray  # [T,O] f32
+    off_price: np.ndarray  # [T,O] f32 risk-discounted EFFECTIVE price:
+    # nominal × (1 + λ·risk) per cloudprovider/types.effective_price — the
+    # ONE vector that makes every price consumer (kernel scoring, probe
+    # prefilters, _prefix_criterion's same-type ladder) risk-aware with no
+    # new dispatch path; bit-identical to nominal at λ=0
     g_zone_allowed: np.ndarray  # [G,Vz] bool
     g_ct_allowed: np.ndarray  # [G,Vc] bool
 
@@ -292,6 +296,14 @@ class DeviceSnapshot:
     m_minv: np.ndarray  # [M] i32 required distinct instance types (minValues)
 
     ineligible_pods: list = field(default_factory=list)
+    # [T,O] f32 RESOLVED interruption-risk signal (unknown → the
+    # KARPENTER_SPOT_RISK_DEFAULT prior at build time): NOT a kernel arg —
+    # the kernel only ever sees the effective off_price above. The sidecar
+    # exists so the λ-discount is auditable from the snapshot alone:
+    # price × (1 + λ·off_risk) always reproduces off_price (the parity
+    # suite rides this; /introspect-style diagnostics read the signal
+    # without re-walking the catalog)
+    off_risk: np.ndarray | None = None
 
     @property
     def G(self):
@@ -993,21 +1005,35 @@ _COMPAT_CACHE_MAX = 8192
 
 
 def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
+    from karpenter_tpu.cloudprovider.types import (
+        default_risk,
+        effective_price as _effective_price,
+        risk_lambda,
+    )
+
+    # the risk-discount weight AND the unknown-risk prior are part of the
+    # type-side identity: a λ or prior flip (perf legs, operator reconfig)
+    # must re-price the cached tensors, not serve stale effective prices
+    lam = risk_lambda()
+    prior = default_risk()
     key = (
         tuple(_template_fingerprint(t) for t in templates),
         tuple(
             (
                 t.nodepool_name,
                 # identity + mutable offering state: flipping an offering's
-                # available/price in place (the standard ICE-handling
+                # available/price/risk in place (the standard ICE-handling
                 # pattern) must miss the cache, not serve stale tensors
                 tuple(
-                    (id(it), tuple((o.available, o.price) for o in it.offerings))
+                    (id(it), tuple((o.available, o.price,
+                                    o.interruption_risk)
+                                   for o in it.offerings))
                     for it in instance_types_by_pool.get(t.nodepool_name, ())
                 ),
             )
             for t in templates
         ),
+        (lam, prior),
         frozenset(
             (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
             for reqs in group_reqs
@@ -1103,6 +1129,7 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
     off_ct = np.full((T, O), -1, dtype=np.int32)
     off_avail = np.zeros((T, O), dtype=bool)
     off_price = np.full((T, O), np.inf, dtype=np.float32)
+    off_risk = np.zeros((T, O), dtype=np.float32)
 
     zone_vocab = vocab.get(wk.TOPOLOGY_ZONE_LABEL, {})
     ct_vocab = vocab.get(wk.CAPACITY_TYPE_LABEL, {})
@@ -1124,7 +1151,16 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
             off_zone[t, o] = zone_vocab.get(off.zone, -1)
             off_ct[t, o] = ct_vocab.get(off.capacity_type, -1)
             off_avail[t, o] = off.available
-            off_price[t, o] = off.price
+            # the risk-discounted EFFECTIVE price (identity at λ=0):
+            # provisioning, the probe ladders, and filterByPrice all read
+            # this tensor, so one number makes the whole plane risk-aware
+            off_price[t, o] = _effective_price(off, lam)
+            # the sidecar stores the RESOLVED risk (unknown → the prior),
+            # so recomputing price × (1 + λ·off_risk) always reproduces
+            # off_price — the audit contract the parity suite rides
+            off_risk[t, o] = (off.interruption_risk
+                              if off.interruption_risk is not None
+                              else prior)
 
     cached = dict(
         vocab=vocab, keys=keys, key_index=key_index, W=W,
@@ -1133,7 +1169,8 @@ def _build_type_side(templates, instance_types_by_pool, group_reqs, resources):
         type_refs=type_refs, t_mask=t_mask, t_has=t_has, t_tol=t_tol,
         t_alloc=t_alloc, t_cap=t_cap, t_tmpl=t_tmpl,
         off_zone=off_zone, off_ct=off_ct, off_avail=off_avail,
-        off_price=off_price, zone_vocab=zone_vocab, ct_vocab=ct_vocab,
+        off_price=off_price, off_risk=off_risk,
+        zone_vocab=zone_vocab, ct_vocab=ct_vocab,
         # strong refs to EVERY catalog object (template-filtered ones too):
         # the id()-based cache key is only stable while nothing in the
         # fingerprinted pool can be garbage-collected and its address reused
@@ -1374,6 +1411,7 @@ def _tensorize(pods, templates, instance_types_by_pool, daemon_overhead,
         m_minv=m_minv,
         m_overhead=m_overhead,
         m_limits=m_limits,
+        off_risk=ts["off_risk"],
     )
     # decoder fast-path state: per-group signature keys plus the type-side
     # entry's persistent compat cache. Entries are pure functions of

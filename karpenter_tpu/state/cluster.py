@@ -364,6 +364,24 @@ class Cluster:
         if sn is not None:
             sn.nominate(self.clock.now())
 
+    # -- interruption notices (spot resilience) --------------------------
+    def note_interruption(self, provider_id: str, deadline: float) -> bool:
+        """Mark a StateNode with its provider reclaim deadline (the
+        disruption controller pulls notices from the cloud provider and
+        lands them here). Journals a node-scoped delta — the cached
+        disruption snapshot stays delta-advanceable — and bumps the
+        consolidation generation so the round that must act re-probes.
+        Idempotent per (node, deadline); False when the node is unknown
+        (a notice for capacity we no longer track)."""
+        sn = self._nodes.get(provider_id)
+        if sn is None:
+            return False
+        if sn.interruption_deadline == deadline:
+            return True
+        sn.interruption_deadline = deadline
+        self.mark_unconsolidated(("node", provider_id))
+        return True
+
     # -- deletion marks (cluster.go MarkForDeletion) ---------------------
     def mark_for_deletion(self, *provider_ids):
         for pid in provider_ids:

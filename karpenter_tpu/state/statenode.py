@@ -37,6 +37,11 @@ class StateNode:
         # disruption bookkeeping
         self.marked_for_deletion: bool = False
         self.nominated_until: float = 0.0
+        # spot interruption notice: the provider's reclaim deadline (clock
+        # seconds) or None. Set by Cluster.note_interruption when the
+        # disruption controller pulls a notice; consumed by the
+        # InterruptionDrain method (proactive drain-and-replace)
+        self.interruption_deadline: float | None = None
 
     # -- identity --------------------------------------------------------
     @property
@@ -146,6 +151,19 @@ class StateNode:
             return [t for t in self.node.taints if t.key not in ephemeral and t.key not in startup]
         return []
 
+    # -- interruption (spot resilience) ----------------------------------
+    def interruption_pending(self) -> bool:
+        """A live interruption notice awaits action on this node: the
+        deadline is set and the node is not already leaving. The ONE
+        predicate shared by the disruption controller's round gate, the
+        InterruptionDrain method's prewarm hint, and its candidate
+        discovery — they must never disagree on what counts as noticed."""
+        return (
+            self.interruption_deadline is not None
+            and not self.marked_for_deletion
+            and not self.deleting()
+        )
+
     # -- nomination (statenode.go:392-398) -------------------------------
     def nominate(self, now: float):
         self.nominated_until = now + NOMINATION_WINDOW
@@ -188,6 +206,7 @@ class StateNode:
         out.volume_usage = self.volume_usage.copy()
         out.marked_for_deletion = self.marked_for_deletion
         out.nominated_until = self.nominated_until
+        out.interruption_deadline = self.interruption_deadline
         return out
 
     def __repr__(self):

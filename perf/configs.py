@@ -151,6 +151,56 @@ def config4_consolidation_env(n_nodes=300):
     return env
 
 
+# the spot storm's market surface (ISSUE 15): one 16-cpu shape, four
+# zones whose SPOT offerings anti-correlate price and interruption risk —
+# the suspiciously-cheap zones are the ones the storm reclaims. Prices
+# drift upward on the high-risk zones as the storm progresses
+# (cloudprovider/chaos.py shift_prices), so a risk-blind fleet that
+# launched on the nominal-cheapest capacity ends the storm holding
+# spiked-price nodes it can no longer cheaply leave (spot→spot
+# consolidation is feature-gated off, the realistic default).
+SPOT_PRICE_BY_ZONE = {"zone-1": 0.20, "zone-2": 0.24,
+                      "zone-3": 0.38, "zone-4": 0.40}
+SPOT_RISK_BY_ZONE = {"zone-1": 0.85, "zone-2": 0.55,
+                     "zone-3": 0.04, "zone-4": 0.02}
+
+
+def spot_catalog():
+    return [make_instance_type(
+        "xl", 16, 64,
+        spot_price_by_zone=dict(SPOT_PRICE_BY_ZONE),
+        spot_risk=dict(SPOT_RISK_BY_ZONE),
+    )]
+
+
+def spot_env(n_nodes=1000):
+    """A spot-pinned fleet at full utilization: ``n_nodes`` deployments of
+    3×5-cpu replicas each fill one 16-cpu spot node, so churn comes ONLY
+    from the interruption storm (no consolidation pressure) and the
+    fleet's placement choices are pure price policy — nominal-cheapest at
+    λ=0 vs risk-discounted-cheapest under KARPENTER_SPOT_RISK_LAMBDA.
+    Returns the Environment with disruption enabled and idle."""
+    from karpenter_tpu.api.objects import Deployment
+    from karpenter_tpu.operator import Environment
+
+    env = Environment(instance_types=spot_catalog(), enable_disruption=True)
+    env.disruption.poll_period = float("inf")
+    pool = _pool()
+    pool.spec.disruption.consolidate_after = 0.0
+    pool.spec.disruption.budgets[0].nodes = "100%"
+    env.create("nodepools", pool)
+    for i in range(n_nodes):
+        tpl = _pod(f"s{i}-tpl", 5.0, 10.0,
+                   node_selector={wk.CAPACITY_TYPE_LABEL: "spot"})
+        env.store.create(
+            "deployments",
+            Deployment(metadata=ObjectMeta(name=f"s{i}"), replicas=3,
+                       template=tpl))
+    env.run_until_idle(max_rounds=300)
+    env.disruption.poll_period = 0.0
+    return env
+
+
 def diverse_pods(count: int, seed: int = 42):
     """The reference benchmark's 1/6 constraint mix, faithfully randomized
     (scheduling_benchmark_test.go makeDiversePods:234-248 + the seeded

@@ -49,6 +49,17 @@ Usage:
                                    # gates on (<10s joint wall clock,
                                    # end cost <= the ladder's, exactly
                                    # one confirm per joint command)
+    python -m perf spot            # the ISSUE-15 spot-resilience storm:
+                                   # a seeded 1000-node fleet rides a
+                                   # storm of interruption notices +
+                                   # risk-correlated price shifts twice
+                                   # on the same seed (risk-aware λ vs
+                                   # the risk-blind λ=0 baseline); the
+                                   # row carries both legs and the three
+                                   # acceptance verdicts bench.py --spot
+                                   # gates (end cost < blind, bounded
+                                   # churn, zero pods lost to notices
+                                   # with ≥1 round of lead)
     python -m perf multitenant     # N concurrent synthetic clusters
                                    # (PERF_TENANTS=8) round-robin through
                                    # one solver service: per-tenant
@@ -331,33 +342,17 @@ def run_consolidation_config(n_nodes=None, breakdown=False):
 
 
 def _fleet_cost(env) -> float:
-    """Sum of the fleet's current offering prices (the end-state cost the
-    joint-vs-ladder parity bar compares) — Candidate.price's resolution,
-    applied to every node in the store."""
-    from karpenter_tpu.api import labels as wk
+    """Sum of the fleet's current NOMINAL offering prices (the end-state
+    cost the joint-vs-ladder and spot risk-aware-vs-blind bars compare),
+    resolved through the shared node→offering walk (types.CatalogView)."""
+    from karpenter_tpu.cloudprovider.types import CatalogView
 
-    d = env.disruption
-    pools = {np_.name: np_ for np_ in env.store.list("nodepools")}
-    catalogs: dict = {}
+    view = CatalogView(env.store.list("nodepools"), env.disruption.cloud)
     total = 0.0
     for node in env.store.list("nodes"):
-        pool = pools.get(node.labels.get(wk.NODEPOOL_LABEL, ""))
-        if pool is None:
-            continue
-        if pool.name not in catalogs:
-            catalogs[pool.name] = {
-                it.name: it for it in d.cloud.get_instance_types(pool)}
-        it = catalogs[pool.name].get(
-            node.labels.get(wk.INSTANCE_TYPE_LABEL, ""))
-        if it is None:
-            continue
-        zone = node.labels.get(wk.TOPOLOGY_ZONE_LABEL, "")
-        ct = node.labels.get(
-            wk.CAPACITY_TYPE_LABEL, wk.CAPACITY_TYPE_ON_DEMAND)
-        for o in it.offerings:
-            if o.zone == zone and o.capacity_type == ct:
-                total += o.price
-                break
+        o = view.offering(node.labels)
+        if o is not None:
+            total += o.price
     return total
 
 
@@ -496,6 +491,138 @@ def run_global_consolidation():
             and joint["confirm_count"] == joint["joint_commands"]),
         "dispatch_contract_ok": bool(
             joint["max_dispatches_per_generation"] <= 1),
+    }
+    print(json.dumps(row))
+
+
+def run_spot():
+    """The ISSUE-15 spot-resilience acceptance: a seeded storm of
+    interruption notices + risk-correlated price shifts over a
+    PERF_SPOT_NODES (1000) spot-pinned fleet, run TWICE on the same seed —
+    risk-aware (KARPENTER_SPOT_RISK_LAMBDA=PERF_SPOT_LAMBDA, default 2.0)
+    and risk-blind (λ=0, the pre-ISSUE behavior, bit-identical pricing).
+    One JSON row with both legs and the three acceptance verdicts
+    ``bench.py --spot`` hard-gates at exit 3:
+
+    * ``cost_beats_blind`` — the risk-aware fleet's end-state nominal
+      cost is strictly below the risk-blind baseline's (the storm leaves
+      high-risk spot prices spiked; the blind fleet is holding them).
+    * ``churn_bound_ok`` — the risk-aware leg's node churn stays
+      proportional to its interruption events (creates ≤ 2×notices +
+      2% of the fleet + 8), i.e. the storm never cascades.
+    * ``zero_late_drain_ok`` — zero pods lost to a reclaim whose notice
+      arrived with ≥1 round of lead, on BOTH legs (the proactive
+      drain-and-replace machinery is λ-independent).
+    """
+    import random
+
+    from karpenter_tpu.api import labels as wk  # noqa: F401
+    from karpenter_tpu.cloudprovider.chaos import ChaosCloud
+    from karpenter_tpu.obs import decisions
+    from karpenter_tpu.operator import metrics as m
+
+    n_nodes = int(os.environ.get("PERF_SPOT_NODES", "1000"))
+    rounds = int(os.environ.get("PERF_SPOT_ROUNDS", "10"))
+    rate = float(os.environ.get("PERF_SPOT_RATE", "0.25"))
+    lam = float(os.environ.get("PERF_SPOT_LAMBDA", "2.0"))
+    step = float(os.environ.get("PERF_SPOT_STEP", "30"))
+    seed = int(os.environ.get("PERF_SPOT_SEED", "7"))
+    shift = float(os.environ.get("PERF_SPOT_SHIFT", "1.25"))
+
+    def leg(leg_lam: float) -> dict:
+        prior = os.environ.get("KARPENTER_SPOT_RISK_LAMBDA")
+        os.environ["KARPENTER_SPOT_RISK_LAMBDA"] = str(leg_lam)
+        try:
+            env = C.spot_env(n_nodes)
+            chaos = ChaosCloud(random.Random(seed)).arm(env)
+            pool = env.store.list("nodepools")[0]
+            offerings = [
+                o for it in env.cloud.get_instance_types(pool)
+                for o in it.offerings
+            ]
+            created = env.registry.counter(m.NODECLAIMS_CREATED, "")
+            creates0 = created.total()
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                # the two-minute warning: lead = 2 rounds, so the
+                # proactive path has a full round of slack — pods lost
+                # at these reclaims count against zero_late_drain
+                chaos.notice_storm(rate, lead_s=2.0 * step, early=True)
+                if r % 4 == 3:
+                    # a no-lead notice exercises the degraded rung; its
+                    # losses are the cloud's, not the machinery's. Only
+                    # UN-noticed nodes qualify — re-noticing a with-lead
+                    # node would overwrite its early flag and exempt its
+                    # losses from the zero-late-drain gate
+                    free = [t for t in chaos._node_risks()
+                            if not chaos.has_notice(t[0].provider_id)]
+                    if free:
+                        node, _ = chaos.rng.choice(free)
+                        chaos.inject_notice(
+                            node.provider_id, env.clock.now() + 1.0,
+                            early=False)
+                if r % 2 == 1:
+                    chaos.shift_prices(offerings, factor=shift,
+                                       min_risk=0.5)
+                env.run_until_idle(max_rounds=500)
+                env.clock.step(step)
+                env.run_until_idle(max_rounds=500)
+                chaos.reclaim_expired()
+                env.run_until_idle(max_rounds=500)
+            # storm over: sweep the remaining deadlines and converge
+            for _ in range(4):
+                env.clock.step(step)
+                env.run_until_idle(max_rounds=500)
+                chaos.reclaim_expired()
+                env.run_until_idle(max_rounds=500)
+            elapsed = time.perf_counter() - t0
+            reg = env.registry
+            return {
+                "lambda": leg_lam,
+                "total_ms": round(elapsed * 1000, 2),
+                "end_nodes": len(env.store.list("nodes")),
+                "pods_bound": len(
+                    [p for p in env.store.list("pods") if p.node_name]),
+                "end_cost": round(_fleet_cost(env), 6),
+                "creates": int(created.total() - creates0),
+                "notices": chaos.stats["notices"],
+                "reclaims": chaos.stats["reclaims"],
+                "price_shifts": chaos.stats["price_shifts"],
+                "pods_lost": chaos.stats["pods_lost"],
+                "pods_lost_with_lead": chaos.stats["pods_lost_with_lead"],
+                "proactive_drains": int(reg.counter(
+                    m.INTERRUPTION_PROACTIVE_DRAINS, "").total()),
+                "deadline_degradations": int(reg.counter(
+                    m.INTERRUPTION_DEADLINE_DEGRADATIONS, "").total()),
+            }
+        finally:
+            if prior is None:
+                os.environ.pop("KARPENTER_SPOT_RISK_LAMBDA", None)
+            else:
+                os.environ["KARPENTER_SPOT_RISK_LAMBDA"] = prior
+
+    dec0 = decisions.counts()
+    aware = leg(lam)
+    blind = leg(0.0)
+    churn_bound = int(2 * aware["notices"] + 0.02 * n_nodes + 8)
+    row = {
+        "config": f"spot-{n_nodes}-storm",
+        "nodes": n_nodes,
+        "rounds": rounds,
+        "seed": seed,
+        "lambda": lam,
+        "total_ms": round(aware["total_ms"] + blind["total_ms"], 2),
+        "risk_aware": aware,
+        "risk_blind": blind,
+        # the three hard gates (bench.py --spot)
+        "cost_beats_blind": bool(
+            aware["end_cost"] < blind["end_cost"] - 1e-9),
+        "churn_bound": churn_bound,
+        "churn_bound_ok": bool(aware["creates"] <= churn_bound),
+        "zero_late_drain_ok": bool(
+            aware["pods_lost_with_lead"] == 0
+            and blind["pods_lost_with_lead"] == 0),
+        "rungs": decisions.rung_delta(dec0, decisions.counts()),
     }
     print(json.dumps(row))
 
@@ -1260,6 +1387,9 @@ def main():
         # (no --json toggle: the joint breakdown IS the row's point and
         # is always emitted)
         run_global_consolidation()
+        return
+    if args == ["spot"]:
+        run_spot()
         return
     if args == ["priority"]:
         run_priority(trace=breakdown)

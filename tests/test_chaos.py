@@ -17,7 +17,10 @@ from karpenter_tpu.api import labels as wk
 from karpenter_tpu.api.nodepool import NodePool
 from karpenter_tpu.api.objects import Deployment, ObjectMeta, Pod
 from karpenter_tpu.cloudprovider.catalog import make_instance_type
-from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+# the ONE shared fault injector (seeded ICE / price-flap / interruption
+# notices): the same implementation drives this storm, the spot-resilience
+# suite, and `python -m perf spot` — no drifting copies
+from karpenter_tpu.cloudprovider.chaos import ChaosCloud
 from karpenter_tpu.operator import Environment
 
 GIB = 2**30
@@ -34,29 +37,6 @@ def build_env():
     )
 
 
-class ChaosCloud:
-    """Wraps the kwok provider: a seeded fraction of Create calls ICE."""
-
-    def __init__(self, rng, rate=0.3):
-        self.rng = rng
-        self.rate = rate
-        self.active = True
-        self.ices = 0
-
-    def arm(self, env):
-        inner_create = env.cloud.create
-
-        def create(nc):
-            # the first launch always ICEs (every seed exercises the
-            # terminal-ICE recovery path); later ones by seeded coin
-            if self.active and (self.ices == 0 or self.rng.random() < self.rate):
-                self.ices += 1
-                raise InsufficientCapacityError(f"chaos ICE #{self.ices}")
-            return inner_create(nc)
-
-        env.cloud.create = create
-
-
 # iterations=0 deterministically exercises the forced-flap fallback (no
 # storm draws ever flap); the seeded 12-iteration storms flap naturally
 @pytest.mark.parametrize("seed,iterations",
@@ -69,7 +49,9 @@ class TestChaosConvergence:
         pool.spec.disruption.consolidate_after = 0.0
         pool.spec.disruption.budgets[0].nodes = "100%"
         env.create("nodepools", pool)
-        chaos = ChaosCloud(rng)
+        # the first launch always ICEs (every seed exercises the
+        # terminal-ICE recovery path); later ones by seeded coin
+        chaos = ChaosCloud(rng, ice_rate=0.3, force_first_ice=True)
         chaos.arm(env)
 
         deploys = []
@@ -102,8 +84,7 @@ class TestChaosConvergence:
                 # market turbulence: a random offering ICEs or recovers
                 # (exercises off_avail feasibility + the validation TTL's
                 # fresh-sim type-intersection drop)
-                o = rng.choice(offerings)
-                o.available = not o.available
+                chaos.flap_random_offering(offerings)
                 flaps += 1
             elif action < 0.9:
                 # operator deletes a node out from under the fleet: graceful

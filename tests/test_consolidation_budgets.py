@@ -154,9 +154,15 @@ class TestFilterOutSameType:
         """A same-type candidate whose price is unknown (<= 0, delisted
         offering) cannot anchor the strictly-cheaper comparison — its type
         leaves the option pool outright instead of surviving by default,
-        so an unpriceable node is never relaunched (ADVICE round 5)."""
-        small = make_instance_type("small", 2, 8)
-        nano = make_instance_type("nano", 1, 2)
+        so an unpriceable node is never relaunched (ADVICE round 5).
+        Risk is stripped here so THIS pin covers the risk-unknown branch:
+        with a KNOWN risk signal the cross-capacity anchor prices the
+        move instead (tests/test_spot_resilience.py pins that stance)."""
+        small = make_instance_type("small", 2, 8, spot_risk=None)
+        nano = make_instance_type("nano", 1, 2, spot_risk=None)
+        for it in (small, nano):
+            for o in it.offerings:
+                o.interruption_risk = None
         cands = [
             stub_candidate(0, instance_type=small, price=0.0),  # unknown
             stub_candidate(1, instance_type=nano,
@@ -193,9 +199,13 @@ class TestFilterOutSameType:
 
     def test_unknown_price_only_overlap_degrades_to_delete_only(self):
         """When the ONLY overlap is the unpriceable type, the remaining
-        (non-overlapping) options survive untouched."""
-        small = make_instance_type("small", 2, 8)
-        nano = make_instance_type("nano", 1, 2)
+        (non-overlapping) options survive untouched (risk stripped: this
+        pins the risk-unknown delete-only branch)."""
+        small = make_instance_type("small", 2, 8, spot_risk=None)
+        nano = make_instance_type("nano", 1, 2, spot_risk=None)
+        for it in (small, nano):
+            for o in it.offerings:
+                o.interruption_risk = None
         cands = [stub_candidate(0, instance_type=small, price=-1.0)]
         replacement = SimpleNamespace(
             instance_types=[small, nano], requirements=Requirements()

@@ -860,7 +860,10 @@ class TestLedgerSiteClosed:
         from karpenter_tpu.obs.decisions import SITES
         from karpenter_tpu.ops import consolidate
 
-        src = inspect.getsource(methods)
+        # scope to the GlobalConsolidation class: other methods (e.g.
+        # InterruptionDrain) record onto their OWN sites with their own
+        # enums, pinned by their own suites
+        src = inspect.getsource(methods.GlobalConsolidation)
         produced = set(re.findall(
             r'_verdict\("[a-z]+", "([a-z-]+)"\)', src))
         csrc = inspect.getsource(consolidate)

@@ -628,3 +628,67 @@ class TestGlobalSentinelLeg:
     def test_missing_row_fails_loudly(self, monkeypatch):
         _, problems = self._run(monkeypatch, {})
         assert any("no row produced" in p for p in problems)
+
+
+class TestSpotSentinelLeg:
+    """bench.py's spot-resilience hard gates (`--spot`, ISSUE 15): the
+    risk-aware end cost must strictly beat the risk-blind baseline,
+    churn must stay inside the storm-proportional bound, and zero pods
+    may be lost to reclaims whose notice arrived with ≥1 round of lead.
+    The pair parser regression-compares total_ms against the newest
+    committed PERF_r*.json row of the same config."""
+
+    def _row(self, **overrides):
+        row = {
+            "config": "spot-1000-storm", "total_ms": 120000.0,
+            "risk_aware": {"end_cost": 410.4, "creates": 60,
+                           "pods_lost_with_lead": 0},
+            "risk_blind": {"end_cost": 512.7, "creates": 900,
+                           "pods_lost_with_lead": 0},
+            "churn_bound": 140, "cost_beats_blind": True,
+            "churn_bound_ok": True, "zero_late_drain_ok": True,
+        }
+        row.update(overrides)
+        return {row["config"]: row}
+
+    def _run(self, monkeypatch, rows, baseline=None):
+        import bench
+
+        monkeypatch.setattr(bench, "_fresh_perf_rows",
+                            lambda args, env=None, timeout=900: rows)
+        monkeypatch.setattr(bench, "_perf_baseline_rows",
+                            lambda: baseline or {})
+        return bench._spot_pairs()
+
+    def test_clean_run_pairs_against_baseline(self, monkeypatch):
+        pairs, problems = self._run(
+            monkeypatch, self._row(),
+            baseline={"spot-1000-storm": {"total_ms": 130000.0}})
+        assert problems == []
+        assert pairs == [("spot-1000-storm", 130000.0, 120000.0)]
+
+    def test_cost_not_beating_blind_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(
+            monkeypatch, self._row(cost_beats_blind=False))
+        assert any("did not beat the risk-blind baseline" in p
+                   for p in problems)
+
+    def test_churn_bound_violation_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(
+            monkeypatch, self._row(churn_bound_ok=False))
+        assert any("churn bound" in p for p in problems)
+
+    def test_late_drain_loss_is_a_hard_gate(self, monkeypatch):
+        row = self._row(zero_late_drain_ok=False)
+        row["spot-1000-storm"]["risk_aware"]["pods_lost_with_lead"] = 3
+        _, problems = self._run(monkeypatch, row)
+        assert any("proactive drain" in p and "3 pod(s)" in p
+                   for p in problems)
+
+    def test_missing_row_fails_loudly(self, monkeypatch):
+        _, problems = self._run(monkeypatch, {})
+        assert any("no row produced" in p for p in problems)
+
+    def test_no_baseline_still_gates_without_pairs(self, monkeypatch):
+        pairs, problems = self._run(monkeypatch, self._row())
+        assert problems == [] and pairs == []

@@ -1,0 +1,545 @@
+"""Spot-market resilience (ISSUE 15, deploy/README.md "Spot resilience"):
+the interruption-risk signal and its risk-discounted effective price, the
+proactive drain-and-replace disruption method (notice → replacement
+launched-and-ready → PDB-gated drain), deadline degradation, the λ=0
+bit-parity pin, the same-type risk anchor (the ADVICE round-5 gap close),
+the new ledger site / capsule seam / metric families, and the seeded
+storm convergence (slow-marked, through the same `perf spot` harness
+`bench.py --spot` gates)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import (
+    Deployment,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+)
+from karpenter_tpu.cloudprovider.catalog import make_instance_type, seeded_risk
+from karpenter_tpu.cloudprovider.chaos import ChaosCloud
+from karpenter_tpu.cloudprovider.types import (
+    Offering,
+    effective_price,
+    risk_lambda,
+)
+from karpenter_tpu.obs import decisions
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator import metrics as m
+from karpenter_tpu.scheduling import IN, Requirement, Requirements
+
+GIB = 2**30
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    decisions.reset()
+    yield
+    decisions.reset()
+
+
+def _offering(price, risk, ct="spot", zone="zone-1", available=True):
+    return Offering(
+        requirements=Requirements(
+            Requirement(wk.CAPACITY_TYPE_LABEL, IN, [ct]),
+            Requirement(wk.TOPOLOGY_ZONE_LABEL, IN, [zone]),
+        ),
+        price=price,
+        available=available,
+        interruption_risk=risk,
+    )
+
+
+class TestEffectivePrice:
+    def test_lambda_zero_is_the_identity(self):
+        o = _offering(0.25, 0.9)
+        assert effective_price(o, 0.0) is o.price  # the SAME float object
+
+    def test_unknown_or_zero_risk_is_the_identity(self):
+        assert effective_price(_offering(0.25, None), 3.0) == 0.25
+        assert effective_price(_offering(0.25, 0.0), 3.0) == 0.25
+
+    def test_unknown_risk_prices_at_the_prior(self, monkeypatch):
+        """KARPENTER_SPOT_RISK_DEFAULT: under λ > 0 an unknown risk
+        prices at the operator's prior instead of as known-stable, so
+        unscored capacity is never systematically preferred (the
+        conservative-stance contract). Default prior 0 = unchanged."""
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_DEFAULT", "0.5")
+        assert effective_price(_offering(0.2, None), 2.0) == (
+            pytest.approx(0.2 * 2.0))
+        assert effective_price(_offering(0.2, None), 0.0) == 0.2
+        # a KNOWN zero risk stays the identity regardless of the prior
+        assert effective_price(_offering(0.2, 0.0), 2.0) == 0.2
+
+    def test_formula(self):
+        o = _offering(0.2, 0.5)
+        assert effective_price(o, 2.0) == pytest.approx(0.2 * 2.0)
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "1.5")
+        assert risk_lambda() == 1.5
+        assert effective_price(_offering(1.0, 0.4)) == pytest.approx(1.6)
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "garbage")
+        assert risk_lambda() == 0.0  # shared envknob garbage tolerance
+
+    def test_seeded_risk_deterministic_and_banded(self):
+        from karpenter_tpu.cloudprovider.catalog import (
+            SEEDED_RISK_HI,
+            SEEDED_RISK_LO,
+        )
+
+        a = seeded_risk("c-4x-amd64-linux", "zone-1")
+        assert a == seeded_risk("c-4x-amd64-linux", "zone-1")
+        assert SEEDED_RISK_LO <= a <= SEEDED_RISK_HI
+        assert a != seeded_risk("c-4x-amd64-linux", "zone-2")
+
+    def test_catalog_emits_seeded_spot_risk_and_stable_od(self):
+        it = make_instance_type("small", 2, 8)
+        spot = [o for o in it.offerings if o.capacity_type == "spot"]
+        od = [o for o in it.offerings if o.capacity_type == "on-demand"]
+        assert all(o.interruption_risk == seeded_risk("small", o.zone)
+                   for o in spot)
+        assert all(o.interruption_risk == 0.0 for o in od)
+
+    def test_catalog_risk_overrides(self):
+        it = make_instance_type(
+            "x", 2, 8, spot_risk={"zone-1": 0.7, "zone-2": None},
+            zones=("zone-1", "zone-2"))
+        by_zone = {o.zone: o for o in it.offerings
+                   if o.capacity_type == "spot"}
+        assert by_zone["zone-1"].interruption_risk == 0.7
+        assert by_zone["zone-2"].interruption_risk is None
+        unknown = make_instance_type("y", 2, 8, spot_risk=None)
+        assert all(o.interruption_risk is None for o in unknown.offerings
+                   if o.capacity_type == "spot")
+
+
+class TestTensorizeRiskParity:
+    def _snap(self, catalog):
+        from karpenter_tpu.models.inflight import ClaimTemplate
+        from karpenter_tpu.ops.tensorize import tensorize
+
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pods = [Pod(metadata=ObjectMeta(name=f"p{i}"),
+                    requests={"cpu": 1.0, "memory": 1 * GIB})
+                for i in range(4)]
+        return tensorize(pods, [ClaimTemplate(pool)], {"default": catalog})
+
+    def test_lambda_zero_bit_identical_to_risk_free_catalog(self, monkeypatch):
+        """The λ=0 parity pin: a risk-bearing catalog prices bit-identically
+        to one with the signal stripped — risk-blind runs are unchanged."""
+        monkeypatch.delenv("KARPENTER_SPOT_RISK_LAMBDA", raising=False)
+        risky = [make_instance_type("a", 2, 8), make_instance_type("b", 4, 16)]
+        s1 = self._snap(risky)
+        bare = [make_instance_type("a", 2, 8, spot_risk=None),
+                make_instance_type("b", 4, 16, spot_risk=None)]
+        for it in bare:
+            for o in it.offerings:
+                o.interruption_risk = None
+        s2 = self._snap(bare)
+        assert np.array_equal(s1.off_price, s2.off_price)
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "0")
+        s3 = self._snap(risky)
+        assert np.array_equal(s1.off_price, s3.off_price)
+
+    def test_lambda_discounts_the_price_tensor(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "0")
+        catalog = [make_instance_type("a", 2, 8)]
+        base = self._snap(catalog)
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "2.0")
+        disc = self._snap(catalog)
+        # a λ flip lands in a fresh type-side cache entry (the λ is part
+        # of the key) and every risky offering's price grew by 1 + λ·risk
+        risk = base.off_risk
+        assert risk.shape == base.off_price.shape
+        expect = base.off_price * (1.0 + 2.0 * risk)
+        assert np.allclose(disc.off_price, expect, rtol=1e-6)
+        assert (risk > 0).any()  # spot offerings carried the signal
+
+
+def build_env(catalog=None, ttl=None):
+    env = Environment(
+        instance_types=catalog or [make_instance_type("xl", 16, 64)],
+        enable_disruption=True,
+        validation_ttl=ttl,
+    )
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    pool.spec.disruption.consolidate_after = 0.0
+    pool.spec.disruption.budgets[0].nodes = "100%"
+    env.create("nodepools", pool)
+    return env
+
+
+def deploy_fleet(env, n=2, replicas=3, selector=None):
+    for i in range(n):
+        tpl = Pod(metadata=ObjectMeta(name=f"d{i}", labels={"app": f"d{i}"}),
+                  requests={"cpu": 5.0, "memory": 10 * GIB},
+                  node_selector=dict(selector or {}))
+        env.store.create(
+            "deployments",
+            Deployment(metadata=ObjectMeta(name=f"d{i}"), replicas=replicas,
+                       template=tpl))
+    env.run_until_idle(max_rounds=300)
+
+
+class TestInterruptionDrain:
+    def test_notice_proactive_replace_then_drain_ordering(self):
+        """The tentpole ordering contract: the replacement is
+        launched-and-ready BEFORE the drain wave evicts the noticed
+        node's first pod (the orchestration queue holds the claim
+        deletion until every replacement is Initialized)."""
+        env = build_env()
+        deploy_fleet(env)
+        victim = env.store.list("nodes")[0]
+        claims0 = {c.name for c in env.store.list("nodeclaims")}
+        chaos = ChaosCloud(random.Random(3)).arm(env)
+        chaos.inject_notice(victim.provider_id, env.clock.now() + 300.0)
+
+        seen = {}
+        inner_wave = env.store.evict_wave
+
+        def spying_wave(pods):
+            victims = [p for p in pods
+                       if p.node_name == victim.metadata.name]
+            if victims and "at_first_evict" not in seen:
+                fresh = [c for c in env.store.list("nodeclaims")
+                         if c.name not in claims0]
+                seen["at_first_evict"] = (
+                    len(fresh) > 0 and all(c.initialized for c in fresh))
+            return inner_wave(pods)
+
+        env.store.evict_wave = spying_wave
+        env.run_until_idle(max_rounds=400)
+        nodes = [n.metadata.name for n in env.store.list("nodes")]
+        assert victim.metadata.name not in nodes, "noticed node not drained"
+        assert seen.get("at_first_evict") is True, (
+            "drain wave shipped before the replacement was ready")
+        # workload preserved, replacement live
+        pods = [p for p in env.store.list("pods")
+                if p.metadata.deletion_timestamp is None]
+        assert all(p.node_name for p in pods)
+        assert env.registry.counter(
+            m.INTERRUPTION_PROACTIVE_DRAINS).total() >= 1
+        assert decisions.counts().get(
+            ("disrupt.interruption", "proactive", "ok"), 0) >= 1
+        # no pod ever lost: the reclaim finds the node already gone
+        env.clock.step(400.0)
+        env.run_until_idle(max_rounds=200)
+        chaos.reclaim_expired()
+        assert chaos.stats["pods_lost"] == 0
+
+    def test_short_lead_degrades_to_immediate_drain(self):
+        env = build_env()
+        deploy_fleet(env)
+        victim = env.store.list("nodes")[0]
+        claims0 = {c.name for c in env.store.list("nodeclaims")}
+        chaos = ChaosCloud(random.Random(5)).arm(env)
+        # deadline inside KARPENTER_INTERRUPTION_MIN_LEAD (30 s): no time
+        # to launch-and-wait — drain NOW
+        chaos.inject_notice(victim.provider_id, env.clock.now() + 5.0,
+                            early=False)
+        env.run_until_idle(max_rounds=400)
+        assert decisions.counts().get(
+            ("disrupt.interruption", "degraded", "deadline-degraded"),
+            0) >= 1
+        assert env.registry.counter(
+            m.INTERRUPTION_DEADLINE_DEGRADATIONS).total() >= 1
+        assert victim.metadata.name not in [
+            n.metadata.name for n in env.store.list("nodes")]
+        # degraded = no replacement launched WITH the command; the
+        # provisioner re-provisions the displaced pods afterwards
+        pods = [p for p in env.store.list("pods")
+                if p.metadata.deletion_timestamp is None]
+        assert all(p.node_name for p in pods)
+        assert {c.name for c in env.store.list("nodeclaims")} != claims0
+
+    def test_short_lead_notice_degrades_only_its_own_node(self):
+        """One no-lead notice in a wave must NOT drag a with-lead node
+        onto the degraded rung: the urgent subset drains immediately,
+        the with-lead node still gets its proactive replace on the next
+        poll (the partition contract)."""
+        env = build_env()
+        deploy_fleet(env, n=3)
+        nodes = env.store.list("nodes")
+        chaos = ChaosCloud(random.Random(23)).arm(env)
+        chaos.inject_notice(nodes[0].provider_id, env.clock.now() + 2.0,
+                            early=False)
+        chaos.inject_notice(nodes[1].provider_id, env.clock.now() + 600.0)
+        env.run_until_idle(max_rounds=400)
+        counts = decisions.counts()
+        assert counts.get(
+            ("disrupt.interruption", "degraded", "deadline-degraded"),
+            0) >= 1
+        assert counts.get(
+            ("disrupt.interruption", "proactive", "ok"), 0) >= 1
+        # exactly one node degraded; the other was proactively replaced
+        assert env.registry.counter(
+            m.INTERRUPTION_DEADLINE_DEGRADATIONS).total() == 1
+        assert env.registry.counter(
+            m.INTERRUPTION_PROACTIVE_DRAINS).total() == 1
+        live = [n.metadata.name for n in env.store.list("nodes")]
+        assert nodes[0].metadata.name not in live
+        assert nodes[1].metadata.name not in live
+
+    def test_deadline_arriving_mid_solve_degrades(self, monkeypatch):
+        """A notice whose deadline the replacement solve outruns degrades
+        gracefully to immediate-drain instead of wedging the round."""
+        from karpenter_tpu.controllers.disruption import methods as mm
+
+        env = build_env()
+        deploy_fleet(env)
+        victim = env.store.list("nodes")[0]
+        chaos = ChaosCloud(random.Random(7)).arm(env)
+        chaos.inject_notice(victim.provider_id, env.clock.now() + 60.0,
+                            early=False)
+
+        real_sim = mm.simulate_scheduling
+
+        def slow_sim(*a, **kw):
+            env.clock.step(120.0)  # the solve outlives the deadline
+            return real_sim(*a, **kw)
+
+        monkeypatch.setattr(mm, "simulate_scheduling", slow_sim)
+        env.run_until_idle(max_rounds=400)
+        assert decisions.counts().get(
+            ("disrupt.interruption", "degraded", "deadline-degraded"),
+            0) >= 1
+        assert victim.metadata.name not in [
+            n.metadata.name for n in env.store.list("nodes")]
+
+    def test_pdb_blocked_drain_under_deadline(self):
+        """A PDB that forbids every eviction: the proactive replace still
+        ships, the drain wave blocks, the deadline kills the node (pods
+        lost — the CLOUD's doing), and the ring converges instead of
+        wedging. The node was PDB-filtered out of the candidate list, so
+        this also covers the notices-ignore-voluntary-filters path."""
+        env = build_env()
+        deploy_fleet(env, n=1, replicas=3)
+        env.create("pdbs", PodDisruptionBudget(
+            metadata=ObjectMeta(name="block"),
+            selector=LabelSelector(match_labels={"app": "d0"}),
+            min_available="100%",
+        ))
+        env.run_until_idle(max_rounds=100)
+        victim = env.store.list("nodes")[0]
+        chaos = ChaosCloud(random.Random(11)).arm(env)
+        chaos.inject_notice(victim.provider_id, env.clock.now() + 120.0,
+                            early=True)
+        env.run_until_idle(max_rounds=400)
+        # the command shipped (proactive) but the node is still here:
+        # every eviction 429'd against the PDB
+        assert decisions.counts().get(
+            ("disrupt.interruption", "proactive", "ok"), 0) >= 1
+        assert victim.metadata.name in [
+            n.metadata.name for n in env.store.list("nodes")]
+        # the deadline: the capacity vanishes with its pods
+        env.clock.step(150.0)
+        env.run_until_idle(max_rounds=200)
+        chaos.reclaim_expired()
+        assert chaos.stats["pods_lost"] > 0
+        assert chaos.stats["pods_lost_with_lead"] > 0
+        # ...and the ring still converges to a clean fixpoint
+        for _ in range(4):
+            env.clock.step(30.0)
+            env.run_until_idle(max_rounds=300)
+        pods = [p for p in env.store.list("pods")
+                if p.metadata.deletion_timestamp is None]
+        assert len(pods) == 3 and all(p.node_name for p in pods)
+
+    def test_absorb_probe_records_interruption_seam(self, monkeypatch,
+                                                    tmp_path):
+        """The replacement solve rides the cached bundle as one
+        counterfactual row on the probe/dispatch seam, captured under
+        ``interruption.dispatch`` for offline replay."""
+        from karpenter_tpu.obs import capsule
+
+        assert "interruption.dispatch" in capsule.SEAMS
+        assert "interruption.dispatch" in capsule._ROW_SEAMS
+        monkeypatch.setenv("KARPENTER_CAPSULE", "1")
+        monkeypatch.setenv("KARPENTER_TRACE_DIR", str(tmp_path))
+        env = build_env()
+        # a second, underutilized deployment keeps consolidation
+        # candidates alive so the round's prewarm builds a bundle the
+        # absorb probe can ride
+        deploy_fleet(env, n=3, replicas=2)
+        victim = env.store.list("nodes")[0]
+        chaos = ChaosCloud(random.Random(13)).arm(env)
+        chaos.inject_notice(victim.provider_id, env.clock.now() + 300.0)
+        seams = []
+        real_capture = capsule.record_capture
+
+        def spy(seam, *a, **kw):
+            seams.append(seam)
+            return real_capture(seam, *a, **kw)
+
+        monkeypatch.setattr(capsule, "record_capture", spy)
+        env.run_until_idle(max_rounds=400)
+        assert "interruption.dispatch" in seams
+
+    def test_metric_families_exported(self):
+        env = build_env()
+        deploy_fleet(env, n=1)
+        victim = env.store.list("nodes")[0]
+        chaos = ChaosCloud(random.Random(17)).arm(env)
+        chaos.inject_notice(victim.provider_id, env.clock.now() + 1.0,
+                            early=False)
+        env.run_until_idle(max_rounds=300)
+        chaos.inject_notice(
+            env.store.list("nodes")[0].provider_id,
+            env.clock.now() + 300.0)
+        env.run_until_idle(max_rounds=300)
+        body = env.registry.expose()
+        for fam in (m.INTERRUPTION_NOTICES, m.INTERRUPTION_PROACTIVE_DRAINS,
+                    m.INTERRUPTION_DEADLINE_DEGRADATIONS, m.OFFERING_RISK):
+            assert fam in body, f"{fam} never exported"
+
+    def test_unknown_node_notice_counts_and_is_ignored(self):
+        env = build_env()
+        deploy_fleet(env, n=1)
+        chaos = ChaosCloud(random.Random(19)).arm(env)
+        chaos.inject_notice("kwok://no-such-node", env.clock.now() + 60.0)
+        env.run_until_idle(max_rounds=100)
+        assert env.registry.counter(m.INTERRUPTION_NOTICES).value(
+            outcome="unknown-node") == 1
+
+    def test_producer_reasons_within_site_enum(self):
+        """Every reason the InterruptionDrain producer can record is a
+        member of the site's closed enum (the decision-ledger pin)."""
+        produced = {"ok", "delete-only", "reactive-fallback",
+                    "deadline-degraded"}
+        assert produced <= decisions.SITES["disrupt.interruption"]["reasons"]
+        assert decisions.SITES["disrupt.interruption"]["rungs"] == (
+            "proactive", "reactive", "degraded")
+
+
+class TestSameTypeRiskAnchor:
+    """The ADVICE round-5 gap close: under λ > 0, an unpriceable
+    same-type candidate whose type carries a KNOWN-risk cross-capacity
+    offering anchors the comparison through that offering's effective
+    price; unknown risk — or the λ=0 risk-blind default — keeps the
+    delete-only stance (all three pinned)."""
+
+    def _candidate(self, it, ct="spot", zone="zone-1"):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(
+            instance_type=it, capacity_type=ct, zone=zone, price=0.0)
+
+    def test_known_risk_cross_capacity_offering_anchors(self, monkeypatch):
+        from karpenter_tpu.controllers.disruption.methods import (
+            filter_out_same_type,
+        )
+        from types import SimpleNamespace
+
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "0.1")
+        # candidate's current price is unknown (stubbed delisted); big's
+        # ON-DEMAND offering is priced with KNOWN risk 0.0 → it anchors
+        # the same-type comparison at its effective price, so big's
+        # cheaper SPOT relaunch is now a PRICED move (the old stance
+        # dropped big outright and only cheap would have survived)
+        big = make_instance_type("big", 8, 32)
+        cheap = make_instance_type("cheap", 1, 2)
+        od_price = min(o.price for o in big.offerings
+                       if o.capacity_type == "on-demand")
+        spot_price = min(o.price for o in big.offerings
+                         if o.capacity_type == "spot")
+        assert spot_price < od_price  # the move the anchor prices
+        cands = [self._candidate(big)]
+        replacement = SimpleNamespace(
+            instance_types=[big, cheap], requirements=Requirements())
+        kept = filter_out_same_type(replacement, cands)
+        assert [it.name for it in kept] == ["big", "cheap"]
+
+    def test_lambda_zero_keeps_the_pre_pr_delete_only_stance(self,
+                                                             monkeypatch):
+        """The anchor is λ-gated: at the risk-blind default the round-5
+        delete-only behavior is EXACTLY pre-ISSUE-15, even on a catalog
+        carrying known risk signals (the λ=0 bit-parity acceptance)."""
+        from karpenter_tpu.controllers.disruption.methods import (
+            _cross_capacity_anchor,
+            filter_out_same_type,
+        )
+        from types import SimpleNamespace
+
+        monkeypatch.delenv("KARPENTER_SPOT_RISK_LAMBDA", raising=False)
+        big = make_instance_type("big", 8, 32)  # seeded risk present
+        cheap = make_instance_type("cheap", 1, 2)
+        cands = [self._candidate(big)]
+        assert _cross_capacity_anchor(cands[0]) is None
+        replacement = SimpleNamespace(
+            instance_types=[big, cheap], requirements=Requirements())
+        # big unpriceable -> dropped outright, exactly the old stance
+        assert [it.name for it in filter_out_same_type(replacement, cands)
+                ] == ["cheap"]
+
+    def test_unknown_risk_keeps_delete_only(self, monkeypatch):
+        from karpenter_tpu.controllers.disruption.methods import (
+            filter_out_same_type,
+        )
+        from types import SimpleNamespace
+
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "0.1")
+        big = make_instance_type("big", 8, 32, spot_risk=None)
+        for o in big.offerings:
+            o.interruption_risk = None  # NO risk signal anywhere
+        cheap = make_instance_type("cheap", 1, 2)
+        cands = [self._candidate(big)]
+        replacement = SimpleNamespace(
+            instance_types=[big, cheap], requirements=Requirements())
+        kept = filter_out_same_type(replacement, cands)
+        # big is unpriceable AND unanchorable → dropped outright; cheap
+        # survives only through the no-anchor path (max_price stays inf)
+        assert [it.name for it in kept] == ["cheap"]
+        # and with big the ONLY option, delete-only:
+        replacement = SimpleNamespace(
+            instance_types=[big], requirements=Requirements())
+        assert filter_out_same_type(replacement, cands) == []
+
+    def test_anchor_ignores_unpriced_and_same_capacity(self, monkeypatch):
+        from karpenter_tpu.controllers.disruption.methods import (
+            _cross_capacity_anchor,
+        )
+
+        monkeypatch.setenv("KARPENTER_SPOT_RISK_LAMBDA", "0.1")
+        it = make_instance_type("t", 2, 8)
+        c = self._candidate(it, ct="spot", zone="zone-1")
+        anchor = _cross_capacity_anchor(c)
+        od = [o for o in it.offerings if o.capacity_type == "on-demand"]
+        assert anchor == pytest.approx(min(o.price for o in od))
+        for o in it.offerings:
+            if o.capacity_type == "on-demand":
+                o.available = False
+        assert _cross_capacity_anchor(c) is None
+
+
+@pytest.mark.slow
+class TestSeededStormConvergence:
+    def test_mini_storm_holds_the_acceptance_gates(self, monkeypatch,
+                                                   capsys):
+        """The `perf spot` harness at miniature scale: same storm code
+        path bench.py --spot runs at 1000 nodes — risk-aware end cost
+        strictly beats risk-blind, churn bounded, zero pods lost to
+        lead-bearing notices, workload preserved on both legs."""
+        import json
+
+        from perf.run import run_spot
+
+        monkeypatch.setenv("PERF_SPOT_NODES", "24")
+        monkeypatch.setenv("PERF_SPOT_ROUNDS", "6")
+        monkeypatch.setenv("PERF_SPOT_SEED", "7")
+        run_spot()
+        row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert row["cost_beats_blind"] is True
+        assert row["churn_bound_ok"] is True
+        assert row["zero_late_drain_ok"] is True
+        for leg in (row["risk_aware"], row["risk_blind"]):
+            assert leg["pods_bound"] == 24 * 3
+            assert leg["pods_lost_with_lead"] == 0
+        # the blind leg actually rode the storm (otherwise the cost gate
+        # proves nothing)
+        assert row["risk_blind"]["notices"] > row["risk_aware"]["notices"]
