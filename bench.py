@@ -1090,19 +1090,29 @@ def main():
     # graftlint preflight: an unsuppressed static-analysis finding fails in
     # milliseconds here instead of after minutes of ladder attempts — the
     # same tier-1 gate tests/test_static_analysis.py enforces. stdlib-only,
-    # so it cannot wedge on the tunnel the way a jax import can.
-    from karpenter_tpu.analysis import preflight
+    # so it cannot wedge on the tunnel the way a jax import can. Full rule
+    # set (GL1xx-GL5xx) through the machine-readable report, honoring the
+    # committed baseline (empty: the tree is clean and must stay so).
+    from karpenter_tpu.analysis import preflight_report
 
     # anchored on the script, not the cwd: `python /path/to/bench.py` from
     # anywhere must analyze the real tree, not silently scan nothing
-    pkg_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "karpenter_tpu")
-    problems = preflight([pkg_dir])
-    if problems:
-        for line in problems:
-            print(f"bench: {line}", file=sys.stderr)
+    here = os.path.dirname(os.path.abspath(__file__))
+    report = preflight_report(
+        [os.path.join(here, "karpenter_tpu")],
+        baseline_path=os.path.join(here, "graftlint-baseline.txt"))
+    if not report["ok"]:
+        print(json.dumps({k: report[k] for k in
+                          ("findings", "baselined", "suppressed")},
+                         indent=2), file=sys.stderr)
         print("bench: graftlint preflight failed — fix or suppress (with "
               "justification) before benching", file=sys.stderr)
+        sys.exit(2)
+    census = report["census"]
+    if census["producers"] < census["site_count"]:
+        print(f"bench: GL502 census regression — {census['producers']} "
+              f"checked producers < {census['site_count']} registry sites",
+              file=sys.stderr)
         sys.exit(2)
 
     attempts = []

@@ -27,6 +27,8 @@ import sys
 import threading
 import time
 
+from karpenter_tpu.utils.envknobs import env_str
+
 GIB = 2**30
 
 
@@ -146,8 +148,6 @@ def main(argv=None) -> int:
                          "split); also KARPENTER_SOLVER_TARGET")
     args = ap.parse_args(argv)
 
-    import os
-
     from karpenter_tpu.operator import Environment
     from karpenter_tpu.operator.logging import make_logger
     from karpenter_tpu.operator.options import Options
@@ -155,7 +155,7 @@ def main(argv=None) -> int:
 
     options = Options.from_env()
     solver = None
-    target = args.solver or os.environ.get("KARPENTER_SOLVER_TARGET")
+    target = args.solver or env_str("KARPENTER_SOLVER_TARGET")
     if target:
         from karpenter_tpu.service import RemoteSolver
 
@@ -163,7 +163,7 @@ def main(argv=None) -> int:
         # service's streaming delta protocol (session mode): one full
         # snapshot, then per-round deltas + per-tenant SLO on the server
         solver = RemoteSolver(
-            target, tenant=os.environ.get("KARPENTER_SOLVER_TENANT") or None)
+            target, tenant=env_str("KARPENTER_SOLVER_TENANT") or None)
     env = Environment(
         clock=Clock(),  # wall-clock: budgets/TTLs run in real time
         sync=False,  # production batching window (1s idle / 10s max)

@@ -27,8 +27,6 @@ KARPENTER_PREEMPT_CONFIRMS (4) confirming simulations per preemptor.
 
 from __future__ import annotations
 
-import os
-
 from karpenter_tpu import obs
 from karpenter_tpu.admission import preempt as _preempt
 from karpenter_tpu.admission.fork import (
@@ -50,19 +48,18 @@ from karpenter_tpu.api import labels as wk
 from karpenter_tpu.models.scheduler import SchedulerResults
 from karpenter_tpu.models.solver import HostSolver, TPUSolver
 from karpenter_tpu.obs import decisions
+from karpenter_tpu.utils.envknobs import env_bool as _env_bool
 from karpenter_tpu.utils.envknobs import env_int as _env_int
 
 __all__ = ["AdmissionPlane"]
 
 
 def _enabled() -> bool:
-    return os.environ.get("KARPENTER_ADMISSION", "1").strip().lower() not in (
-        "0", "false", "off", "no")
+    return _env_bool("KARPENTER_ADMISSION", True)
 
 
 def _preempt_enabled() -> bool:
-    return os.environ.get("KARPENTER_PREEMPTION", "1").strip().lower() not in (
-        "0", "false", "off", "no")
+    return _env_bool("KARPENTER_PREEMPTION", True)
 
 
 class _State:

@@ -37,6 +37,7 @@ from karpenter_tpu.ops.tensorize import (
     kernel_args,
 )
 from karpenter_tpu.utils import resources as resutil
+from karpenter_tpu.utils.envknobs import env_bool, env_int, env_str
 
 
 class Solver:
@@ -143,6 +144,8 @@ def _batched_solve_kernel(max_bins: int, level_bits: int = 20,
     reason the probe's do: solve_step's host-side reads cannot run on a
     tracer."""
     key = (max_bins, level_bits, max_minv, "vmap")
+    # graftlint: disable=GL501 -- "vmap" entries pin use_pallas=False, so
+    # the pallas knob (reachable through solve_step) cannot affect them
     cached = _PACKED_KERNELS.get(key)
     if cached is not None:
         return cached
@@ -198,19 +201,13 @@ NATIVE_CUTOFF_PODS = 192
 def _native_cutoff() -> int:
     """The routing master switch: 0 disables ALL engine routing (tests pin
     this to keep the XLA path under test)."""
-    import os
-
-    return int(os.environ.get("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS))
+    return env_int("KARPENTER_NATIVE_CUTOFF", NATIVE_CUTOFF_PODS)
 
 
 def _exact_skip_enabled() -> bool:
     """KARPENTER_DECODE_EXACT_SKIP: the decoder's multi-group exact-skip
     A/B kill switch (resolved per call — decode is host-side)."""
-    import os
-
-    return os.environ.get(
-        "KARPENTER_DECODE_EXACT_SKIP", "1"
-    ).strip().lower() not in ("0", "false", "off", "no")
+    return env_bool("KARPENTER_DECODE_EXACT_SKIP", True)
 
 
 # memoized: is the jax "device" an actual accelerator? On an install whose
@@ -223,12 +220,10 @@ _ACCEL_BACKEND: bool | None = None
 
 
 def _accelerated_backend() -> bool:
-    import os
-
     # KARPENTER_ASSUME_ACCELERATOR overrides the probe (1/0): tests use it
     # to pin the work-gate contract on CPU-only boxes, operators can use it
     # to force either stance when the backend probe misleads
-    v = os.environ.get("KARPENTER_ASSUME_ACCELERATOR")
+    v = env_str("KARPENTER_ASSUME_ACCELERATOR")
     if v is not None:
         return v.strip().lower() in ("1", "true", "yes", "on")
     global _ACCEL_BACKEND
@@ -334,9 +329,7 @@ class TPUSolver(Solver):
         has_topology = bool(getattr(topology, "has_groups", topology is not None and not isinstance(topology, NullTopology)))
         host_cutoff = 0
         if _native_cutoff() > 0:
-            import os
-
-            host_cutoff = int(os.environ.get("KARPENTER_HOST_CUTOFF", HOST_CUTOFF_PODS))
+            host_cutoff = env_int("KARPENTER_HOST_CUTOFF", HOST_CUTOFF_PODS)
         if not templates or 0 < len(pods) <= host_cutoff:
             res = self.host.solve(
                 pods,
@@ -752,8 +745,6 @@ class TPUSolver(Solver):
         Set KARPENTER_PROFILE_DIR to capture a JAX profiler trace of each
         kernel dispatch (the pprof analog, operator.go:174-183; view with
         TensorBoard's profile plugin)."""
-        import os
-
         import jax
 
         # small batches route to the C++ engine: below the crossover the
@@ -761,7 +752,7 @@ class TPUSolver(Solver):
         # saves (the reference's stance that small batches are cheap,
         # batcher.go:52). Same tensors, same decode — only the kernel swaps.
         cutoff = _native_cutoff()
-        min_work = int(os.environ.get("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK))
+        min_work = env_int("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK)
         total = int(np.asarray(args["g_count"]).sum())
         # REAL counts, not the bucket-padded axes: padded groups have count
         # 0 and padded types zero allocatable, so routing flips at the
@@ -797,7 +788,7 @@ class TPUSolver(Solver):
                         "native engine failed on a small batch; "
                         "falling back to the device kernel", exc_info=True)
         self._last_engine = "device"
-        profile_dir = os.environ.get("KARPENTER_PROFILE_DIR")
+        profile_dir = env_str("KARPENTER_PROFILE_DIR")
         if profile_dir:
             with jax.profiler.trace(profile_dir):
                 return self._invoke_inner(args, key, max_bins)
@@ -860,8 +851,6 @@ class TPUSolver(Solver):
         native engine, the mesh-sharded path, and profiled runs are
         synchronous, so they defer the whole _invoke until (and unless) the
         result is actually needed."""
-        import os
-
         from karpenter_tpu.ops.kernels import pallas_enabled
 
         # speculate only when the doubled family's jit wrapper is already
@@ -873,7 +862,7 @@ class TPUSolver(Solver):
             warm
             and self._last_engine == "device"
             and self._maybe_mesh() is None
-            and not os.environ.get("KARPENTER_PROFILE_DIR")
+            and not env_str("KARPENTER_PROFILE_DIR")
         ):
             try:
                 # async dispatch, no block: only the host-side launch cost
@@ -909,7 +898,11 @@ class TPUSolver(Solver):
         row_keys = getattr(snap, "row_keys", None)
         pkey = None
         if persist is not None and row_keys is not None:
-            pkey = (m, tuple(row_keys[g] for g in gset))
+            # the exact-skip knob steers the entry's tsel/exactness arm
+            # below, and the type-side key does NOT pin it — it must ride
+            # the fingerprint or a knob flip would serve stale entries
+            pkey = (m, tuple(row_keys[g] for g in gset),
+                    _exact_skip_enabled())
             hit = persist.get(pkey)
             if hit is not None:
                 return hit

@@ -17,6 +17,7 @@ import threading
 import numpy as np
 
 from karpenter_tpu.ops.tensorize import UNCAPPED
+from karpenter_tpu.utils.envknobs import env_str
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "kernel.cpp")
@@ -32,7 +33,7 @@ _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
 
 
 def _so_path() -> str:
-    cache = os.environ.get("KARPENTER_NATIVE_CACHE", _HERE)
+    cache = env_str("KARPENTER_NATIVE_CACHE", _HERE)
     return os.path.join(cache, "libkarpenter_kernel.so")
 
 

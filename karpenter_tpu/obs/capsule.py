@@ -65,6 +65,15 @@ count on ``karpenter_capsule_writes_total{seam,why}`` and join the
 in-process index served by ``/introspect`` and rendered by
 ``python -m karpenter_tpu.obs report``. See deploy/README.md
 ("Replay capsules").
+
+Seam coverage is a static contract, not a convention: graftlint's GL503
+(analysis/contracts.py) flags any function that dispatches through the
+shared device primitives without a reachable ``record_capture``, and
+validates literal seam names against ``SEAMS`` — so a new dispatch path
+cannot silently opt out of replay, and a typo'd seam name fails the
+tier-1 gate (rule table: deploy/README.md § Static analysis). This
+module itself is exempt (the replay half re-executes dispatches and must
+not capture its own replays).
 """
 
 from __future__ import annotations
@@ -136,15 +145,13 @@ def capture_enabled() -> bool:
     """KARPENTER_CAPSULE=0 disables capture entirely; anything else (incl.
     unset) keeps the cheap reference-capture on — writes still gate on an
     anomaly unless :func:`force_all`."""
-    return os.environ.get("KARPENTER_CAPSULE", "").strip().lower() not in (
-        "0", "false", "off", "no",
-    )
+    return envknobs.env_bool("KARPENTER_CAPSULE", True)
 
 
 def force_all() -> bool:
     """KARPENTER_CAPSULE=1: write a capsule for every recorded round, not
     only anomalous ones (the opt-in knob)."""
-    return os.environ.get("KARPENTER_CAPSULE", "").strip().lower() in (
+    return (envknobs.env_str("KARPENTER_CAPSULE", "") or "").strip().lower() in (
         "1", "true", "on", "yes", "all",
     )
 
@@ -432,31 +439,14 @@ def load(path: str) -> Capsule:
 _OUT_KEYS = ("assign", "assign_e", "used", "tmpl", "F")
 
 
-class _applied_env:
+class _applied_env(envknobs.applied_env):
     """Temporarily apply the capture-time values of selected env knobs
-    (mesh partition/repair) so replay reproduces the captured plan."""
+    (mesh partition/repair) so replay reproduces the captured plan — the
+    save/apply/restore machinery lives with the other env-knob semantics
+    in utils/envknobs.py (the one module allowed to touch os.environ)."""
 
     def __init__(self, cap: Capsule, names=_REPLAY_ENV):
-        self._names = names
-        self._cap_env = cap.meta.get("env") or {}
-        self._saved: dict = {}
-
-    def __enter__(self):
-        for n in self._names:
-            self._saved[n] = os.environ.get(n)
-            if n in self._cap_env:
-                os.environ[n] = self._cap_env[n]
-            else:
-                os.environ.pop(n, None)
-        return self
-
-    def __exit__(self, et, ev, tb):
-        for n, v in self._saved.items():
-            if v is None:
-                os.environ.pop(n, None)
-            else:
-                os.environ[n] = v
-        return False
+        super().__init__(cap.meta.get("env") or {}, names)
 
 
 # seams whose capture is the chunked counterfactual-row dispatch (shared

@@ -30,7 +30,13 @@ Reasons are drawn from a CLOSED enum per site (``SITES[site]["reasons"]``)
 so the ``karpenter_decision_total{site,rung,reason}`` label cardinality is
 bounded: an unknown reason clamps to ``"other"`` instead of minting a new
 series (``canonical_reason``). Unknown sites/rungs raise — they are code
-constants, and a typo must fail tests, not mint a series.
+constants, and a typo must fail tests, not mint a series. The static half
+of that contract is graftlint's GL502 (analysis/contracts.py): every
+``record_decision`` call site in the package — literal, wrapper-routed,
+or riding a carrier like ``LAST_RUN['plan_refusal']`` — is resolved
+against ``SITES`` at lint time, so adding a producer reason without
+registering it here fails the tier-1 gate before it can clamp at runtime
+(rule table: deploy/README.md § Static analysis).
 
 Every record also:
 

@@ -44,9 +44,10 @@ deploy/README.md ("Device-plane & SLO telemetry").
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import deque
+
+from karpenter_tpu.utils.envknobs import env_int
 
 __all__ = [
     "CompileLedger",
@@ -91,10 +92,7 @@ _STATS_LOCK = threading.Lock()
 
 
 def _env_steady_after() -> int:
-    try:
-        return max(int(os.environ.get("KARPENTER_COMPILE_STEADY_AFTER", "16")), 1)
-    except ValueError:
-        return 16
+    return env_int("KARPENTER_COMPILE_STEADY_AFTER", 16, minimum=1)
 
 
 def _resolve_registry(registry):

@@ -70,6 +70,8 @@ import tempfile
 import threading
 import time
 
+from karpenter_tpu.utils import envknobs
+
 __all__ = [
     "Span",
     "Trace",
@@ -443,28 +445,23 @@ class Tracer:
 # ---------------------------------------------------------------------------
 
 def _env_enabled() -> bool:
-    return os.environ.get("KARPENTER_TRACE", "1").strip().lower() not in (
-        "0", "false", "off", "no",
-    )
+    return envknobs.env_bool("KARPENTER_TRACE", True)
 
 
 def _env_dump_all() -> bool:
-    return os.environ.get("KARPENTER_TRACE_DUMP", "").strip().lower() in (
+    return (envknobs.env_str("KARPENTER_TRACE_DUMP", "") or "").strip().lower() in (
         "1", "all", "true", "yes", "on",
     )
 
 
 def _env_dir() -> str:
-    return os.environ.get("KARPENTER_TRACE_DIR") or os.path.join(
+    return envknobs.env_str("KARPENTER_TRACE_DIR") or os.path.join(
         tempfile.gettempdir(), "karpenter-traces"
     )
 
 
 def _env_capacity() -> int:
-    try:
-        return max(int(os.environ.get("KARPENTER_TRACE_RING", "32")), 1)
-    except ValueError:
-        return 32
+    return envknobs.env_int("KARPENTER_TRACE_RING", 32, minimum=1)
 
 
 def _build_recorder():

@@ -167,7 +167,7 @@ NOP = NopLogger()
 def make_logger(level: str | None = None, sink=None, clock=None) -> Logger:
     """Root logger honoring Options.log_level / KARPENTER_LOG_LEVEL."""
     if level is None:
-        import os
+        from karpenter_tpu.utils.envknobs import env_str
 
-        level = os.environ.get("KARPENTER_LOG_LEVEL", "info")
+        level = env_str("KARPENTER_LOG_LEVEL", "info")
     return Logger(level=level, sink=sink, clock=clock)

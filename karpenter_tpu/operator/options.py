@@ -10,12 +10,13 @@ consumed by consolidation.go:214).
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
+
+from karpenter_tpu.utils.envknobs import env_str
 
 
 def _env(name: str, default, cast=str):
-    raw = os.environ.get(f"KARPENTER_{name}")
+    raw = env_str(f"KARPENTER_{name}")
     if raw is None:
         return default
     if cast is bool:

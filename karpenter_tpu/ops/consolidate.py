@@ -835,13 +835,12 @@ class DisruptionSnapshot:
         work floor — a probe row's parallelism is G×T, and below the floor
         the accelerator (or its CPU emulation) can't amortize dispatch and
         compile."""
-        import os
-
         from karpenter_tpu.models.solver import DEVICE_MIN_WORK, _native_cutoff
+        from karpenter_tpu.utils.envknobs import env_int
 
         if _native_cutoff() <= 0:
             return False
-        min_work = int(os.environ.get("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK))
+        min_work = env_int("KARPENTER_DEVICE_MIN_WORK", DEVICE_MIN_WORK)
         if self.snap.G * self.snap.T >= min_work:
             return False
         try:

@@ -547,13 +547,13 @@ def pallas_enabled() -> bool:
     """Opt-in (KARPENTER_PALLAS=1) AND a TPU backend: Mosaic only compiles
     for TPU — every other platform (cpu, gpu, metal, future plugins) takes
     the jnp path. The image's plugin platform reports as "axon"/"tpu"."""
-    import os
+    from karpenter_tpu.utils.envknobs import env_str
 
     # graftlint: disable=GL103 -- the freeze-at-trace hazard is the
     # documented contract: callers that cache jitted wrappers resolve this
     # HOST-side and key their cache on it (models/solver.py _kernel);
     # solve_step only falls back here on the eager path
-    if os.environ.get("KARPENTER_PALLAS") != "1":
+    if env_str("KARPENTER_PALLAS") != "1":
         return False
     backend = jax.default_backend()
     return "axon" in backend or "tpu" in backend

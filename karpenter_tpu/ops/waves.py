@@ -814,11 +814,11 @@ def compile_topology(groups: list, topology, vectorized: bool | None = None) -> 
         return WavesPlan([DeviceGroup(list(g)) for g in groups], [])
 
     if vectorized is None:
-        import os
+        from karpenter_tpu.utils.envknobs import env_str
 
-        vectorized = os.environ.get(
-            "KARPENTER_WAVES_SEQUENTIAL", ""
-        ).strip().lower() not in ("1", "true", "yes", "on")
+        # inverse opt-in: setting the knob selects the SEQUENTIAL oracle
+        vectorized = (env_str("KARPENTER_WAVES_SEQUENTIAL", "") or "") \
+            .strip().lower() not in ("1", "true", "yes", "on")
     cls = _VecCompiler if vectorized else _Compiler
     # the sequential-oracle path is one of the slow edges the flight
     # recorder exists to attribute: the span's `vectorized` attr says

@@ -82,7 +82,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 import threading
 import time
 from dataclasses import dataclass
@@ -92,6 +91,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from karpenter_tpu.utils.envknobs import env_bool, env_int
 from karpenter_tpu import obs
 from karpenter_tpu.obs import devplane
 from karpenter_tpu.ops import kernels
@@ -304,9 +304,7 @@ def plan_shards(args: dict, n_shards: int, max_bins: int | None = None
     outright (A/B against the replicated program). Every refusal records
     its actual cause in ``LAST_RUN["plan_refusal"]`` — a leaked
     kill-switch in CI must not surface as a coincidental blocker name."""
-    if os.environ.get("KARPENTER_SHARD_PARTITION", "1").strip().lower() in (
-        "0", "false", "off", "no",
-    ):
+    if not env_bool("KARPENTER_SHARD_PARTITION", True):
         LAST_RUN["plan_refusal"] = "partition-disabled"
         return None
     if n_shards < 2:
@@ -388,11 +386,7 @@ def plan_shards(args: dict, n_shards: int, max_bins: int | None = None
 
 
 def _repair_bound() -> int:
-    try:
-        return max(int(os.environ.get("KARPENTER_SHARD_REPAIR_MAX",
-                                      SHARD_REPAIR_MAX)), 0)
-    except ValueError:
-        return SHARD_REPAIR_MAX
+    return env_int("KARPENTER_SHARD_REPAIR_MAX", SHARD_REPAIR_MAX, minimum=0)
 
 
 def _in_flight(out: dict) -> bool:

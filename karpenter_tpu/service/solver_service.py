@@ -57,10 +57,11 @@ from __future__ import annotations
 
 import io
 import json
-import os
 from collections import OrderedDict
 
 import numpy as np
+
+from karpenter_tpu.utils.envknobs import env_str
 
 _METHOD = "/karpenter.Solver/Solve"
 _METHOD_REGISTER = "/karpenter.Solver/Register"
@@ -88,7 +89,7 @@ def _env_codec() -> str | None:
     zstandard."""
     from karpenter_tpu.service.session import env_bool
 
-    v = os.environ.get("KARPENTER_SOLVER_COMPRESS", "").strip().lower()
+    v = (env_str("KARPENTER_SOLVER_COMPRESS", "") or "").strip().lower()
     if not env_bool("KARPENTER_SOLVER_COMPRESS", False):
         return None
     if v == "zstd":
@@ -153,7 +154,7 @@ def _unpack(blob: bytes) -> tuple:
 def _env_latency_slo() -> float | None:
     """KARPENTER_SOLVER_SLO_MS: per-request latency objective in ms
     (unset = error-only SLO)."""
-    v = os.environ.get("KARPENTER_SOLVER_SLO_MS", "").strip()
+    v = (env_str("KARPENTER_SOLVER_SLO_MS", "") or "").strip()
     if not v:
         return None
     try:
@@ -990,7 +991,7 @@ def main(argv=None) -> int:
 
         metrics_server = serve_metrics(
             _metrics.REGISTRY, args.metrics_port,
-            host=os.environ.get("KARPENTER_METRICS_BIND", ""),
+            host=env_str("KARPENTER_METRICS_BIND", ""),
         )
         print(f"solver service: metrics on :{args.metrics_port} "
               f"(/metrics /healthz /slo /introspect)", flush=True)

@@ -1,4 +1,4 @@
-"""The ONE env-knob parser trio (int/float/bool).
+"""The ONE env-knob accessor surface (int/float/bool/str + apply).
 
 Originally grown in service/session.py so the service plane's knobs could
 not drift in empty-string/garbage/clamp behavior; hoisted here when the
@@ -6,13 +6,23 @@ decision ledger (obs/decisions.py) needed the same semantics from a layer
 that must not import the service plane (service → models → obs would
 cycle). service/session.py re-exports these names, so every existing
 importer keeps working.
+
+This module is the ONLY place in the package allowed to touch
+``os.environ`` directly: graftlint's GL501 (analysis/contracts.py) flags
+any read elsewhere, so a new knob cannot bypass the shared parse/clamp
+semantics — or escape the cache-fingerprint coverage check — by going
+straight to the environment. ``env_str`` is the raw accessor for string/
+enum/tri-state knobs whose call sites keep their own value tests;
+``applied_env`` is the save/apply/restore half (the replay capsule
+re-applies captured knobs around offline replays through it).
 """
 
 from __future__ import annotations
 
 import os
 
-__all__ = ["env_int", "env_float", "env_bool", "snapshot"]
+__all__ = ["env_int", "env_float", "env_bool", "env_str", "snapshot",
+           "applied_env"]
 
 
 def env_int(name: str, default: int, minimum: int | None = None) -> int:
@@ -41,6 +51,49 @@ def env_bool(name: str, default: bool) -> bool:
     if not v:
         return default
     return v not in ("0", "false", "off", "no")
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    """Raw passthrough: the knob's exact string, or ``default`` when
+    unset. For enum/tri-state/path knobs whose call sites own the value
+    test (KARPENTER_PALLAS's exact-"1" opt-in, the ASSUME_ACCELERATOR
+    tri-state, TRACE_DIR/PROFILE_DIR paths) — the point is routing the
+    READ through this module, not normalizing the value."""
+    # graftlint: disable=GL103 -- freeze-at-trace is the documented contract
+    # of the one jit-reachable caller (kernels.pallas_enabled, which carries
+    # its own GL103 justification): callers caching jitted wrappers resolve
+    # the knob HOST-side and key their cache on it
+    return os.environ.get(name, default)
+
+
+class applied_env:
+    """Temporarily apply ``mapping``'s values for ``names`` (a name absent
+    from the mapping is UNSET, not left alone), restoring the previous
+    environment on exit. The replay capsule (obs/capsule.py) rides this to
+    reproduce capture-time routing/partition knobs around an offline
+    replay; tests use it for knob pinning without os.environ surgery."""
+
+    def __init__(self, mapping: dict, names):
+        self._names = tuple(names)
+        self._mapping = dict(mapping or {})
+        self._saved: dict = {}
+
+    def __enter__(self):
+        for n in self._names:
+            self._saved[n] = os.environ.get(n)
+            if n in self._mapping:
+                os.environ[n] = self._mapping[n]
+            else:
+                os.environ.pop(n, None)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        for n, v in self._saved.items():
+            if v is None:
+                os.environ.pop(n, None)
+            else:
+                os.environ[n] = v
+        return False
 
 
 def snapshot(prefix: str = "KARPENTER_") -> dict:
