@@ -684,6 +684,51 @@ def _global_pairs():
     return pairs, problems
 
 
+def _global_xl_pairs():
+    """(sentinel pairs, hard-gate problems) for the 10k-node LP-rung
+    sentinel (`python -m perf global-xl`, deploy/README.md "LP
+    relaxation rung"). Baseline-gated like the multitenant leg — no
+    committed ``-global-xl`` row, no fresh multi-minute run. When it
+    runs, two verdicts hard-gate: the relax leg must ship its joint
+    round (``relax_completed``) and the ladder subprocess must NOT
+    finish inside the timeout (``ladder_completed`` false) — a ladder
+    that completes first means the shape no longer demonstrates the LP
+    rung's asymptotic edge and the row needs re-tuning, loudly. The
+    relax round wall clock regression-compares against the committed
+    row."""
+    base = {cfg: r for cfg, r in _perf_baseline_rows().items()
+            if cfg.endswith("-global-xl")}
+    if not base:
+        return [], []
+    fresh = _fresh_perf_rows(["global-xl"], timeout=3600)
+    problems, pairs = [], []
+    row = next((r for r in fresh.values()
+                if r.get("config", "").endswith("-global-xl")), None)
+    if row is None:
+        problems.append(
+            "global-xl: no row produced — the LP-rung sentinel was "
+            "never evaluated")
+        return pairs, problems
+    cfg = row["config"]
+    if not row.get("relax_completed"):
+        problems.append(
+            f"global-xl: {cfg} relax leg shipped no joint command "
+            f"(relax stats: {(row.get('relax') or {}).get('relax')}) — "
+            "the LP rung failed the fleet it exists for")
+    if row.get("ladder_completed"):
+        problems.append(
+            f"global-xl: {cfg} ladder leg finished inside the timeout "
+            f"({(row.get('ladder') or {}).get('round_ms')}ms) — the "
+            "sentinel shape no longer separates the solvers")
+    b = base.get(cfg)
+    if (b is not None and isinstance((b.get("relax") or {}), dict)
+            and "round_ms" in (b.get("relax") or {})
+            and "round_ms" in (row.get("relax") or {})):
+        pairs.append((f"{cfg}:round", float(b["relax"]["round_ms"]),
+                      float(row["relax"]["round_ms"])))
+    return pairs, problems
+
+
 def _spot_pairs():
     """(sentinel pairs, hard-gate problems) for the spot-resilience leg
     (`--spot`): one fresh `python -m perf spot` run must hold the
@@ -981,6 +1026,17 @@ def sentinel(record: dict, consolidation: bool = False,
                   "(KARPENTER_BENCH_SENTINEL=0 to disable):",
                   file=sys.stderr)
             for p in g_problems:
+                print(f"bench:   {p}", file=sys.stderr)
+            return 3
+        # the 10k-node LP-rung sentinel rides the same flag,
+        # baseline-gated (no committed -global-xl row, no fresh run)
+        x_pairs, x_problems = _global_xl_pairs()
+        pairs.extend(x_pairs)
+        if x_problems:
+            print("bench: global-xl LP-rung gate failed "
+                  "(KARPENTER_BENCH_SENTINEL=0 to disable):",
+                  file=sys.stderr)
+            for p in x_problems:
                 print(f"bench:   {p}", file=sys.stderr)
             return 3
     if multitenant:

@@ -965,7 +965,20 @@ class GlobalConsolidation(Method):
                         selected=len(plan.selected), dropped=plan.dropped)
             self._verdict("ladder", "confirm-mismatch")
             return None
-        self._verdict("joint")
+        if getattr(plan, "solver", "ladder") == "relax":
+            # the LP relaxation rung selected the set (ops/relax.py):
+            # relax = rounded at the LP bound, relax-rounded = the
+            # window shed candidates below it (both closed enums the
+            # GL502 census pins; deploy/README.md "LP relaxation rung")
+            self._verdict("joint",
+                          "relax" if plan.dropped == 0 else "relax-rounded")
+        elif getattr(plan, "relax_fallback", False):
+            # the relax rung attempted and declined, the FFD ladder
+            # shipped — a command all the same, but the descent is
+            # visible (RELAX_STATS carries the cause)
+            self._verdict("joint", "relax-fallback")
+        else:
+            self._verdict("joint")
         return cmd
 
     def _confirm(self, selected):

@@ -14,6 +14,13 @@ Every kernel dispatch also records a replay capture (exact tensor inputs +
 outputs, engine/rung, static params) onto the open round trace; anomalous
 rounds serialize it as a replay capsule replayable bit-identically offline
 — :mod:`karpenter_tpu.obs.capsule` and deploy/README.md "Replay capsules".
+
+Bin-count estimation is additionally steered by an LP relaxation floor
+(:mod:`karpenter_tpu.ops.relax` ``lp_bin_floor``, deploy/README.md
+"LP relaxation rung"): a weak-duality certified lower bound on the bins any
+integral packing needs, computed by the same device-resident PDHG kernel
+family that serves the joint-consolidation rung. Solves the floor steered
+record the ``relax`` rung on the ``solver.route`` ledger.
 """
 
 from __future__ import annotations
@@ -576,6 +583,7 @@ class TPUSolver(Solver):
         M = len(snap.templates)
         total_pods = int(snap.g_count.sum())
         floor = None  # the demand lower bound (the quality account's floor)
+        lp_led = False  # the LP relaxation floor steered this solve
         if max_bins:
             B = max_bins
         else:
@@ -616,6 +624,18 @@ class TPUSolver(Solver):
                 cls_lb = np.ceil(cnt.sum(axis=0) / np.maximum(cap_c, 1)).max()
                 cap_lb = max(cap_lb, int(cls_lb))
             est = max(est, min(cap_lb, total_pods))
+            # LP relaxation floor (ops/relax.py lp_bin_floor —
+            # deploy/README.md "LP relaxation rung"): a weak-duality
+            # certified bin lower bound over the SAME demand/capacity/
+            # compat tensors, valid whether or not the iteration
+            # converged. A raise tightens both the bin-axis sizing
+            # below and the solve-quality account's floor; the solve it
+            # steers records the solver.route "relax" rung.
+            from karpenter_tpu.ops.relax import lp_bin_floor
+
+            lp = lp_bin_floor(snap, est)
+            if lp > est:
+                est, lp_led = lp, True
             floor = est
             # 1.5x FFD headroom: the doubling re-run below catches a miss
             B = min(max(total_pods, 1), max((3 * est) // 2, 64), 4096)
@@ -735,6 +755,12 @@ class TPUSolver(Solver):
                 # read as drift.
                 decisions.record_quality(len(claims), floor,
                                          family=f"{Gp}x{Tp}")
+            if lp_led and claims and not retry:
+                # The LP floor raised the estimate and the solve it
+                # sized completed whole: credit the relax rung so the
+                # route ledger distinguishes LP-steered solves from
+                # plain kernel routing.
+                self._route = ("relax", "ok")
             return claims, retry, ecommits
 
     def _invoke(self, args, key, max_bins):

@@ -41,6 +41,13 @@ rule proves them jit-unreachable):
   storm round's replacement solve replays offline after the storm.
 - ``service.solve`` — service/solver_service.py (tenant-scoped: the
   capsule carries and is filed under the tenant).
+- ``relax.dispatch`` — ops/relax.py ``joint_relax_plan`` (the LP
+  relaxation rung, deploy/README.md "LP relaxation rung"): the padded
+  LP tensors plus the STANDARD counterfactual-row sidecars on one
+  capture, so its A/B ladder races the device LP+window decision
+  (``relax``), the FFD prefix ladder over the same rows (``ladder``),
+  and the host greedy oracle (``host``) — all graded on the retirement
+  prefix each would pick.
 
 Replay (``python -m karpenter_tpu.obs replay <capsule>``) re-executes the
 capture offline and asserts bit-parity against the captured outputs:
@@ -118,7 +125,8 @@ OUT_PREFIX = "out//"
 CF_PREFIX = "cf//"
 
 SEAMS = ("solver.invoke", "mesh.solve", "probe.dispatch", "service.solve",
-         "preempt.dispatch", "global.dispatch", "interruption.dispatch")
+         "preempt.dispatch", "global.dispatch", "interruption.dispatch",
+         "relax.dispatch")
 
 # knobs from the captured env snapshot that replay re-applies around the
 # mesh rungs: they decide whether/how the snapshot partitions, so a dev
@@ -460,6 +468,8 @@ _ROW_SEAMS = ("probe.dispatch", "preempt.dispatch", "global.dispatch",
 def _captured_rung(cap: Capsule) -> str:
     """The replayable rung the capture actually ran."""
     engine = cap.engine
+    if cap.seam == "relax.dispatch":
+        return "relax"
     if cap.seam in _ROW_SEAMS:
         return "native" if engine == "native" else "device"
     if cap.seam == "mesh.solve":
@@ -599,6 +609,47 @@ def _run_probe(cap: Capsule, engine: str) -> dict:
             shared, Gp, Ep, e_avail, max_minv, g_count_k, e_zero_cols,
             e_free=e_free)
     return {"placed_g": placed_g, "used": used}
+
+
+# ---------------------------------------------------------------------------
+# the relax.dispatch seam's A/B ladder (ops/relax.py — deploy/README.md
+# "LP relaxation rung"): the LP+window device decision, the FFD prefix
+# ladder over the SAME counterfactual-row sidecars, the host-FFD greedy
+# oracle. All three rungs emit {"k_sel"} — the retirement prefix each
+# would pick — so parity_of grades them against the captured device
+# selection directly.
+# ---------------------------------------------------------------------------
+
+
+def _run_relax(cap: Capsule) -> dict:
+    from karpenter_tpu.ops.relax import replay_joint
+
+    return replay_joint(cap)
+
+
+def _run_relax_ladder(cap: Capsule) -> dict:
+    """The FFD prefix ladder's verdict on the captured round: dispatch
+    the counterfactual rows (``_run_probe`` verbatim — the capture keeps
+    the standard row sidecars alongside the LP tensors), then apply the
+    shared prefix criterion (coverage + the price gate bits the capture
+    pinned) and report the LARGEST feasible prefix."""
+    out = _run_probe(cap, "device")
+    placed_g = np.asarray(out["placed_g"])
+    used = np.asarray(out["used"])
+    required = np.asarray(cap.sidecar("rx_required"))
+    gate = np.asarray(cap.sidecar("rx_claim_gate")).astype(bool)
+    G = int(cap.static("rx_g"))
+    feasible = (placed_g[:, :G] >= required[:, :G]).all(axis=1)
+    feasible &= (np.asarray(used).reshape(-1) == 0) | gate
+    ks = np.flatnonzero(feasible) + 1  # row i <-> prefix k=i+1
+    ks = ks[ks >= 2]
+    return {"k_sel": np.int64(ks.max()) if ks.size else np.int64(0)}
+
+
+def _run_relax_host(cap: Capsule) -> dict:
+    from karpenter_tpu.ops.relax import replay_host_round
+
+    return replay_host_round(cap)
 
 
 # ---------------------------------------------------------------------------
@@ -756,9 +807,16 @@ def _run_host_ffd(cap: Capsule) -> dict:
 
 _SOLVE_RUNGS = ("partitioned", "replicated", "xla", "native", "host")
 _PROBE_RUNGS = ("device", "native")
+_RELAX_RUNGS = ("relax", "ladder", "host")
 
 
 def _execute(cap: Capsule, rung: str) -> dict:
+    if cap.seam == "relax.dispatch":
+        return {
+            "relax": _run_relax,
+            "ladder": _run_relax_ladder,
+            "host": _run_relax_host,
+        }[rung](cap)
     if cap.seam in _ROW_SEAMS:
         return _run_probe(cap, rung)
     return {
@@ -833,7 +891,12 @@ def ab_compare(cap: Capsule) -> list:
     parity vs the captured outputs, node count, wall clock, and the
     decision diff vs the captured rung. Ineligible/failed rungs report
     why instead of silently vanishing (the no-silent-caps stance)."""
-    rungs = _PROBE_RUNGS if cap.seam in _ROW_SEAMS else _SOLVE_RUNGS
+    if cap.seam == "relax.dispatch":
+        rungs: tuple = _RELAX_RUNGS
+    elif cap.seam in _ROW_SEAMS:
+        rungs = _PROBE_RUNGS
+    else:
+        rungs = _SOLVE_RUNGS
     rows = []
     for rung in rungs:
         try:

@@ -18,7 +18,8 @@ site                    rungs (best first)                 recorded by
 ``snapshot.advance``    delta, rebuild                     ``ops/consolidate.py SnapshotCache``
 ``probe.confirm``       definitive, gallop, sequential     ``controllers/disruption/methods.py``
 ``consolidate.global``  joint, ladder, sequential          ``controllers/disruption/methods.py``
-``solver.route``        mesh, native, xla, service, host   ``models/solver.py TPUSolver.solve``
+``solver.route``        relax, mesh, native, xla,          ``models/solver.py TPUSolver.solve``
+                        service, host
 ``session.sync``        delta, resync                      ``service/solver_service.py`` (both ends)
 ``decode.recheck``      skip, full                         ``models/solver.py _compat_entry``
 ``admission.tier``      cascade, single                    ``admission/plane.py solve_round``
@@ -163,12 +164,19 @@ SITES = {
         # no-retirement on a mid-transition snapshot and the controller
         # closed the round without running the MultiNode/SingleNode
         # probes (ISSUE-14 short-circuit) — workload-driven, benign.
+        # relax / relax-rounded = the LP relaxation rung (ops/relax.py)
+        # selected the shipped set (exactly at the LP bound / with the
+        # rounding window shedding below it); relax-fallback = relax
+        # attempted and declined, the FFD ladder shipped the round
+        # (RELAX_STATS pins the cause). All three ship a command at the
+        # best rung, so like "ok" they stay armed rather than benign.
         "rungs": ("joint", "ladder", "sequential"),
         "reasons": frozenset({
             "ok", "no-retirement", "non-definitive", "confirm-mismatch",
             "repair-bound", "topology-plan", "inexpressible",
             "probe-error", "no-device", "disabled", "too-few-candidates",
-            "joint-noop-fenced", OTHER_REASON,
+            "joint-noop-fenced", "relax", "relax-rounded",
+            "relax-fallback", OTHER_REASON,
         }),
         "benign": frozenset({
             "no-retirement", "non-definitive", "topology-plan", "disabled",
@@ -195,8 +203,12 @@ SITES = {
     },
     "solver.route": {
         # models/solver.py TPUSolver.solve: which engine ran the kernel
-        # (or that no kernel ran at all — the host FFD rung).
-        "rungs": ("mesh", "native", "xla", "service", "host"),
+        # (or that no kernel ran at all — the host FFD rung). relax =
+        # the LP relaxation floor (ops/relax.py lp_bin_floor) tightened
+        # the bin estimate that steered the completed solve — the rung
+        # outranks the engines because the relaxation certificate, not
+        # engine routing, decided the solve's shape.
+        "rungs": ("relax", "mesh", "native", "xla", "service", "host"),
         "reasons": frozenset({
             "ok", "small-batch", "work-floor", "cpu-backend", "no-templates",
             "no-eligible", "no-device-groups", "remote-fallback",

@@ -1432,6 +1432,22 @@ def _prefix_criterion(bundle, candidates, cum, placed_g, used):
         required = cum + np.where(claimable[:G], base, 0)[None, :]
         base_exempt_ok = True
     feasible = (placed_g[:, :G] >= required).all(axis=1)
+    prefix_known, claim_ok = _prefix_price_ok(bundle, candidates)
+    feasible &= (used == 0) | (prefix_known & claim_ok)
+    return feasible, base_exempt_ok
+
+
+def _prefix_price_ok(bundle, candidates):
+    """The price half of the shared criterion — filterByPrice AND the
+    same-type anti-churn cap modeled per prefix (the docstring of
+    :func:`_prefix_criterion` owns the full argument). ONE copy shared
+    by the FFD prefix ladder and the LP relaxation rung
+    (``ops/relax.py joint_relax_plan``), so a claim-bearing relax prefix
+    can never ship under a price stance the ladder would refuse.
+    Returns ``(prefix_known[N], claim_ok[N])``: whether every price in
+    the prefix is known, and whether some offering passes both price
+    gates for that prefix."""
+    N = len(candidates)
     prices = np.array(
         [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64
     )
@@ -1463,8 +1479,7 @@ def _prefix_criterion(bundle, candidates, cum, placed_g, used):
         ).any(axis=1)
     else:
         claim_ok = np.zeros(N, dtype=bool)
-    feasible &= (used == 0) | (prefix_known & claim_ok)
-    return feasible, base_exempt_ok
+    return prefix_known, claim_ok
 
 
 def _type_price_vectors(snap):
@@ -1530,6 +1545,9 @@ GLOBAL_STATS = {
     "formulate_ms": 0.0,
     "solve_ms": 0.0,
     "round_repair_ms": 0.0,
+    # LP relaxation rung wall clock (ops/relax.py PDHG solve + device
+    # rounding window) — deploy/README.md "LP relaxation rung"
+    "relax_ms": 0.0,
     "repair_drops": 0,
     # the round's shared snapshot acquisition (build or delta-advance),
     # hoisted out of formulate_ms by the controller's prewarm — ISSUE-14
@@ -1557,7 +1575,8 @@ class JointPlan:
                  definitive=False, displacement=(), overflow=None,
                  k_device=0, dropped=0, timings=None, viable=True,
                  reason="ok", prefix_feasible=None, single_mask=None,
-                 generation=None, transient=False):
+                 generation=None, transient=False, solver="ladder",
+                 relax_fallback=False):
         self._candidates = list(candidates)
         self.selected_idx = list(selected_idx)
         self.delete_only = delete_only
@@ -1583,6 +1602,13 @@ class JointPlan:
         self.single_mask = single_mask
         self.generation = generation
         self.transient = transient
+        # which rung selected the set: "relax" (the LP relaxation rung,
+        # ops/relax.py — ledger reason relax/relax-rounded) or "ladder"
+        # (the FFD prefix ladder); relax_fallback marks a ladder round
+        # the relax rung first attempted and declined (ledger reason
+        # relax-fallback when the ladder then ships)
+        self.solver = solver
+        self.relax_fallback = relax_fallback
 
     @property
     def selected(self):
@@ -1668,7 +1694,15 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
     (no bundle, invisible candidates, unmapped pods — the caller records
     the ``sequential`` rung), else a :class:`JointPlan`; non-``viable``
     plans name their fallback cause (``topology-plan``,
-    ``no-retirement``, ``repair-bound``)."""
+    ``no-retirement``, ``repair-bound``).
+
+    On settled snapshots the LP relaxation rung (``ops/relax.py
+    joint_relax_plan`` — deploy/README.md "LP relaxation rung") runs
+    FIRST: a device-resident PDHG solve of the fractional retirement
+    program whose bound seeds a bounded device rounding window, with
+    this ladder demoted to rounding oracle and fallback. A shipped
+    relax plan carries ``solver="relax"``; every relax decline falls
+    through to the ladder below with ``relax_fallback`` marked."""
     t0 = time.perf_counter()
     bundle = _bundle_for(
         provisioner, cluster, store, candidates, cache, registry,
@@ -1695,6 +1729,30 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
     col_arr = np.asarray(cols, dtype=np.intp)
     e_zero_cols = [col_arr[: k + 1] for k in range(N)]
     transient = bool(int(bundle.base.sum())) or bool(bundle.deleting_pods)
+
+    # LP relaxation fast path (ops/relax.py, deploy/README.md "LP
+    # relaxation rung"): on settled snapshots the fractional retirement
+    # program picks the prefix in O(iters) device work instead of N
+    # counterfactual rows, with the FFD machinery demoted to rounding
+    # oracle. Mid-transition rounds skip it outright — they resolve
+    # no-retirement almost surely and the noop fence needs the single
+    # rows only the FFD dispatch carries. EVERY non-ship outcome falls
+    # through to the ladder below (the fallback matrix), so the shipped
+    # end state can never be worse than the ladder's.
+    relax_fb = False
+    if not transient and N >= 2:
+        from karpenter_tpu.ops import relax as _relax
+
+        if _relax.relax_enabled():
+            rt = {"formulate_ms": (time.perf_counter() - t0) * 1000.0}
+            with obs.span("global.relax", candidates=N):
+                rplan, _cause = _relax.joint_relax_plan(
+                    bundle, candidates, col_arr, contrib, cum, rt)
+            if rplan is not None:
+                _account(rt, 0, 0)
+                return rplan
+            relax_fb = True
+
     singles = (want_singles or transient) and N >= 2
     if singles:
         # the per-candidate single rows ride the SAME dispatch: row 0 is
@@ -1730,7 +1788,8 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
         "solve_ms": (t2 - t1) * 1000.0,
     }
     seed_kw = dict(prefix_feasible=feasible, single_mask=single_mask,
-                   generation=bundle.generation, transient=transient)
+                   generation=bundle.generation, transient=transient,
+                   relax_fallback=relax_fb)
     if not definitive:
         # a non-definitive ladder (claimability too large to prove, with
         # pending/drain pods riding the rows) UNDER-estimates k; the
@@ -1786,7 +1845,8 @@ def _account(timings, rows, dropped):
     GLOBAL_STATS["plans"] += 1
     GLOBAL_STATS["rows"] += rows
     GLOBAL_STATS["repair_drops"] += dropped
-    for key in ("formulate_ms", "solve_ms", "round_repair_ms"):
+    for key in ("formulate_ms", "solve_ms", "round_repair_ms",
+                "relax_ms"):
         GLOBAL_STATS[key] += timings.get(key, 0.0)
 
 

@@ -1,0 +1,919 @@
+"""LP-relaxed global assignment rung — the device-resident convex solver
+(deploy/README.md "LP relaxation rung").
+
+Both combinatorial hot loops — provisioning bin-packing and the joint
+consolidation retirement search — are relaxations of ONE assignment
+program: pods of group ``g`` land on capacity columns (surviving nodes,
+fresh bins) subject to per-resource capacity and compatibility, and the
+integral machinery (the FFD kernel ladder, the prefix criterion) answers
+a question the LP answers fractionally in a handful of matrix iterations.
+This module solves that LP on device with a diagonally-preconditioned
+primal-dual (PDHG / Chambolle-Pock) iteration compiled as ONE executable
+per shape family: a ``jax.lax.while_loop`` whose body runs a fixed block
+of ``lax.fori_loop`` steps and a residual check — zero host syncs inside
+the iteration (the GL504 stance holds structurally: there is no Python
+loop around the dispatch at all), termination decided on device from the
+primal feasibility residual.
+
+Two entry points ride the one iteration scheme:
+
+* :func:`joint_relax_plan` — the global-consolidation fast path
+  (``ops/consolidate.py joint_retirement_plan``): retirement fractions
+  ``y[N]`` over the disruption-cost-ordered candidates (a monotone
+  prefix chain, so the LP optimum IS a fractional prefix), assignment
+  ``x[G, E+1]`` of displaced+pending pods onto survivor columns plus ONE
+  claim-envelope column, objective = maximize retirements with an
+  earlier-candidate tie-break. The converged objective upper-bounds any
+  integral prefix, so ``k_ub = round(sum(y))`` seeds a bounded
+  device-side rounding window (one vmapped dispatch scores W candidate
+  prefixes) that replaces the host repair loop; the FFD machinery is
+  demoted to ROUNDING ORACLE — exactly one exact-arithmetic
+  ``_greedy_displace`` materializes the chosen prefix's displacement
+  plan (bit-identical to the ladder's rounding, the parity pin), and
+  the shared price criterion gates any claim-bearing prefix. Every
+  non-ship outcome hands the round to the FFD ladder with its cause
+  pinned in ``RELAX_STATS["last_fallback"]`` (the fallback matrix:
+  ``inexpressible`` / ``iteration-cap`` / ``non-convergence`` /
+  ``price-gate`` / ``lp-no-retirement``).
+
+* :func:`lp_bin_floor` — the provisioning rung (``models/solver.py``):
+  the same program without retirement variables (min total bins s.t.
+  demand/capacity/compat), whose DUALS are projected to a feasible
+  point of the dual cone after the iteration budget — weak duality then
+  certifies ``ceil(dual objective)`` as a valid bin floor REGARDLESS of
+  convergence, tightening the solver's per-resource estimate (bin-axis
+  sizing and the solve-quality account's floor).
+
+Knobs (all through ``utils/envknobs.py``; folded into the kernel cache
+fingerprints below — GL501 enforces):
+
+``KARPENTER_RELAX``           enable/kill-switch. Unset = auto (on only
+                              when the jax backend is a real accelerator
+                              — on CPU the LP iteration is an emulation
+                              that loses to the native FFD engine);
+                              ``1`` forces on, ``0`` kills.
+``KARPENTER_RELAX_MAX_ITERS`` iteration cap (default 384).
+``KARPENTER_RELAX_TOL``       relative feasibility tolerance (5e-3).
+``KARPENTER_RELAX_RHO``       primal/dual step balance (default 1.0).
+
+Replay: every joint relax decision records the ``relax.dispatch``
+capsule seam (obs/capsule.py) carrying the LP tensors AND the standard
+counterfactual-row sidecars, so ``obs replay`` re-runs the relax rung
+bit-identically and ``obs replay --ab`` races relax vs the FFD ladder
+vs host-FFD on the same capture.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs import devplane
+from karpenter_tpu.utils.envknobs import env_float, env_int, env_str
+
+__all__ = [
+    "relax_enabled",
+    "joint_relax_plan",
+    "lp_bin_floor",
+    "RELAX_STATS",
+    "replay_joint",
+    "replay_host_round",
+]
+
+# rounding window width: how many candidate prefixes below the LP bound
+# the ONE vmapped rounding dispatch scores (the bounded device-side pass
+# that replaces the KARPENTER_GLOBAL_REPAIR_MAX host loop)
+ROUND_WINDOW = 8
+# exact-materialization attempts: at most this many window prefixes get
+# the host oracle pass before the round falls back to the ladder
+ROUND_ATTEMPTS = 4
+# PDHG steps between on-device residual checks (inner fori_loop length)
+CHECK_EVERY = 16
+# claim-column objective penalty: prefer delete-only fractional optima
+# (mirrors the ladder's preference — a claim only ships price-gated)
+CLAIM_PENALTY = 1e-3
+# earlier-candidate tie-break weight spread (keeps the optimum a prefix
+# of the disruption-cost order among equal-cardinality solutions)
+PREFIX_TIEBREAK = 1e-3
+
+RELAX_STATS = {
+    "attempts": 0,
+    "ships": 0,
+    "fallbacks": 0,
+    "rounded_drops": 0,
+    "kernel_ms": 0.0,
+    "iters": 0,
+    "last_fallback": "",
+    "last_viol": 0.0,
+    "last_k_ub": 0,
+    "last_iters": 0,
+    "floor_calls": 0,
+    "floor_raises": 0,
+}
+
+
+# ---------------------------------------------------------------------------
+# knobs (utils/envknobs.py — the one os.environ surface; every reader
+# below is folded into the kernel cache keys, the GL501 contract)
+# ---------------------------------------------------------------------------
+
+
+def relax_enabled() -> bool:
+    """Tri-state enable: KARPENTER_RELAX=1 forces the rung on, =0 kills
+    it, unset/empty defers to the backend probe (the LP iteration only
+    beats the native FFD engine when the matmuls are an accelerator's)."""
+    v = (env_str("KARPENTER_RELAX") or "").strip().lower()
+    if v:
+        return v not in ("0", "false", "off", "no")
+    from karpenter_tpu.models.solver import _accelerated_backend
+
+    return _accelerated_backend()
+
+
+def _relax_max_iters() -> int:
+    return env_int("KARPENTER_RELAX_MAX_ITERS", 384, minimum=1)
+
+
+def _relax_tol() -> float:
+    return env_float("KARPENTER_RELAX_TOL", 5e-3, minimum=0.0)
+
+
+def _relax_rho() -> float:
+    return max(env_float("KARPENTER_RELAX_RHO", 1.0), 1e-6)
+
+
+def _relax_round_windows() -> int:
+    """KARPENTER_RELAX_ROUND_WINDOWS: how many W-prefix windows the
+    rounding descent may scan below the LP bound before handing the
+    round to the ladder (the LP relaxation gap can exceed one window)."""
+    return env_int("KARPENTER_RELAX_ROUND_WINDOWS", 4, minimum=1)
+
+
+def _fallback(cause: str) -> None:
+    RELAX_STATS["fallbacks"] += 1
+    RELAX_STATS["last_fallback"] = cause
+
+
+# ---------------------------------------------------------------------------
+# the PDHG joint kernel — one executable per (Gp, Ec, Np, R) shape family
+# ---------------------------------------------------------------------------
+
+# compiled kernel caches; the knob readers IN the key are the GL501
+# fingerprint contract — a knob flip can never serve a stale executable
+_JOINT_KERNELS: dict = {}
+_ROUND_KERNELS: dict = {}
+_FLOOR_KERNELS: dict = {}
+
+
+def _pow2(n: int, lo: int = 8) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _joint_kernel(Gp, Ec, Np, R, max_iters, tol, rho):
+    """Jitted PDHG over the joint consolidation LP.
+
+    Variables: ``x[Gp,Ec]`` (pods of group g on column e; the claim
+    envelope rides as an ordinary column), ``y[Np]`` retirement
+    fractions. Constraints (dual in parens): demand coverage per group
+    (``q``), per-column per-resource capacity with the retired column's
+    capacity scaling away as ``y`` rises (``p``), and the monotone
+    prefix chain ``y[c+1] <= y[c]`` (``m``). Diagonal preconditioning
+    (Pock-Chambolle, alpha=1) with ``rho`` balancing the primal/dual
+    steps; over-relaxed dual extrapolation; residual-based termination
+    checked every CHECK_EVERY steps on device."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def run(d, capR, compat, contrib, base_req, w, colcand, candidx,
+            nmask, gmask, c_x):
+        # --- preconditioners (tensors, traced once per family) ---
+        xub = (base_req + contrib.sum(0)) * gmask  # [Gp] max demand
+        col_x = (1.0 + d.sum(1))[:, None] * compat  # [Gp,Ec]
+        tau_x = jnp.where(col_x > 0, rho / jnp.maximum(col_x, 1e-9), 0.0)
+        cand_res = capR.sum(1)[candidx]  # [Np] retired column mass
+        col_y = contrib.sum(1) + cand_res + 2.0
+        tau_y = jnp.where(nmask > 0, rho / jnp.maximum(col_y, 1e-9), 0.0)
+        row_q = compat.sum(1) + contrib.sum(0)
+        sig_q = jnp.where(row_q > 0, 1.0 / (rho * jnp.maximum(row_q, 1e-9)),
+                          0.0)
+        iscand = (colcand < Np).astype(d.dtype)  # [Ec]
+        row_p = (compat * 1.0).T @ d + capR * iscand[:, None]
+        sig_p = jnp.where(row_p > 0, 1.0 / (rho * jnp.maximum(row_p, 1e-9)),
+                          0.0)
+        sig_m = 1.0 / (rho * 2.0)
+        mpair = nmask[1:] * nmask[:-1]  # [Np-1] real adjacent pairs
+        # claim column carries a small objective penalty so delete-only
+        # optima win ties (colcand == Np marks non-candidate columns; the
+        # claim column is flagged by its capacity living past E — the
+        # caller passes it via c_x directly)
+        c_y = -w
+
+        def kt_mono(m):
+            return (jnp.concatenate([jnp.zeros(1, d.dtype), m])
+                    - jnp.concatenate([m, jnp.zeros(1, d.dtype)]))
+
+        def one(state, c_x):
+            x, y, q, p, m = state
+            ktx = -q[:, None] + d @ p.T
+            p_res = (capR * p).sum(1)  # [Ec]
+            kty = contrib @ q + p_res[candidx] + kt_mono(m)
+            xn = jnp.clip((x - tau_x * (c_x + ktx)) * compat,
+                          0.0, xub[:, None])
+            yn = jnp.clip(y - tau_y * (c_y + kty), 0.0, 1.0) * nmask
+            xb, yb = 2.0 * xn - x, 2.0 * yn - y
+            yb_ext = jnp.concatenate([yb, jnp.zeros(1, d.dtype)])
+            y_col = yb_ext[colcand]  # [Ec]
+            r_q = (base_req + yb @ contrib - xb.sum(1)) * gmask
+            qn = jnp.maximum(q + sig_q * r_q, 0.0)
+            r_p = xb.T @ d + capR * y_col[:, None] - capR
+            pn = jnp.maximum(p + sig_p * r_p, 0.0)
+            r_m = (yb[1:] - yb[:-1]) * mpair
+            mn = jnp.maximum(m + sig_m * r_m, 0.0)
+            return xn, yn, qn, pn, mn
+
+        def viol_of(x, y):
+            y_ext = jnp.concatenate([y, jnp.zeros(1, d.dtype)])
+            y_col = y_ext[colcand]
+            v_q = ((base_req + y @ contrib - x.sum(1)) * gmask
+                   / (1.0 + xub)).max()
+            v_p = ((x.T @ d + capR * y_col[:, None] - capR)
+                   / (1.0 + capR)).max()
+            v_m = ((y[1:] - y[:-1]) * mpair).max()
+            return jnp.maximum(jnp.maximum(v_q, v_p), v_m)
+
+        def cond(carry):
+            _, _, _, _, _, it, done, _ = carry
+            return jnp.logical_and(~done, it < max_iters)
+
+        def body(carry):
+            x, y, q, p, m, it, _, _ = carry
+            y0 = y
+            state = lax.fori_loop(
+                0, CHECK_EVERY, lambda _, s: one(s, c_x), (x, y, q, p, m))
+            x, y, q, p, m = state
+            viol = viol_of(x, y)
+            dy = jnp.abs(y - y0).max()
+            done = jnp.logical_and(viol <= tol, dy <= tol)
+            return x, y, q, p, m, it + CHECK_EVERY, done, viol
+
+        z = jnp.zeros
+        x0 = z((Gp, Ec), d.dtype)
+        carry = (x0, z(Np, d.dtype), z(Gp, d.dtype), z((Ec, R), d.dtype),
+                 z(Np - 1, d.dtype), jnp.int32(0), jnp.bool_(False),
+                 jnp.asarray(jnp.inf, d.dtype))
+        x, y, q, p, m, it, done, viol = lax.while_loop(cond, body, carry)
+        return {"y": y, "q": q, "iters": it, "converged": done,
+                "viol": viol, "k_frac": y.sum()}
+
+    return jax.jit(run)
+
+
+def _get_joint_kernel(Gp, Ec, Np, R):
+    key = (Gp, Ec, Np, R, _relax_max_iters(), _relax_tol(), _relax_rho())
+    fn = _JOINT_KERNELS.get(key)
+    if fn is None:
+        fn = _joint_kernel(Gp, Ec, Np, R, key[4], key[5], key[6])
+        _JOINT_KERNELS[key] = fn
+    return fn, key
+
+
+def _round_kernel(Gp, Ec, R, W, claim_idx):
+    """Jitted window-rounding pass: for each of W candidate prefixes
+    (their required demands and survivor masks), greedily place every
+    group (pre-ordered by demand, the _greedy_displace order) into the
+    fullest-fitting columns via a full-length ``lax.top_k`` descent —
+    the same floor/stable-tie arithmetic as the host oracle, in f32.
+    Returns per-window unplaced totals and claim-column usage; the ONE
+    winning prefix is then materialized exactly by the host oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fill(req, surv, d, compat):
+        # surv carries the capacity rows directly ([Ec,R] cap * mask,
+        # built by the caller) so one tensor is both mask and budget
+        resid0 = surv
+
+        def place(carry, inp):
+            resid, bad, claim = carry
+            d_g, n_g, cm_g = inp
+            pos = d_g > 0
+            anypos = pos.any()
+            n_eff = jnp.where(anypos, n_g, 0.0)
+            safe_d = jnp.where(pos, d_g, 1.0)
+            ratio = jnp.where(pos[None, :], resid / safe_d[None, :],
+                              jnp.inf)
+            caps = jnp.floor(ratio.min(1) + 1e-6)
+            # RAW caps rank the descent (the host oracle's sort order) —
+            # clamping at n_g here would forge ties and pick different
+            # columns than _greedy_displace; the cumulative clip below
+            # already bounds the takes
+            caps = jnp.where(cm_g > 0, jnp.maximum(caps, 0.0), 0.0)
+            # survivors-first, claim as LAST resort (the _greedy_displace
+            # stance): the fresh envelope is the emptiest column and a
+            # flat caps descent would grab it first, branding prefixes
+            # claim-bearing — and price-gated — that the survivors could
+            # absorb outright
+            surv_caps = caps.at[claim_idx].set(0.0)
+            vals, idx = lax.top_k(surv_caps, Ec)
+            cume = jnp.concatenate(
+                [jnp.zeros(1, d.dtype), jnp.cumsum(vals)[:-1]])
+            take_s = jnp.clip(n_eff - cume, 0.0, vals)
+            takes = jnp.zeros(Ec, d.dtype).at[idx].set(take_s)
+            left = jnp.maximum(n_eff - takes.sum(), 0.0)
+            c_take = jnp.minimum(left, caps[claim_idx])
+            takes = takes.at[claim_idx].add(c_take)
+            resid = resid - takes[:, None] * d_g[None, :]
+            return (resid, bad + jnp.maximum(left - c_take, 0.0),
+                    claim + c_take), None
+
+        (resid, bad, claim), _ = lax.scan(
+            place, (resid0, jnp.asarray(0.0, d.dtype),
+                    jnp.asarray(0.0, d.dtype)),
+            (d, req, compat))
+        return bad, claim
+
+    def run(req_w, surv_w, d, compat):
+        return jax.vmap(lambda r, s: fill(r, s, d, compat))(req_w, surv_w)
+
+    return jax.jit(run)
+
+
+def _get_round_kernel(Gp, Ec, R, claim_idx):
+    key = (Gp, Ec, R, ROUND_WINDOW, claim_idx,
+           _relax_max_iters(), _relax_tol(), _relax_rho())
+    fn = _ROUND_KERNELS.get(key)
+    if fn is None:
+        fn = _round_kernel(Gp, Ec, R, ROUND_WINDOW, claim_idx)
+        _ROUND_KERNELS[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# joint consolidation entry (called from ops/consolidate.py
+# joint_retirement_plan; returns (JointPlan | None, fallback cause))
+# ---------------------------------------------------------------------------
+
+
+def _joint_tensors(bundle, col_arr, contrib, base_req, claim_compat):
+    """Host assembly of the LP tensors (padded to the pow-2 family).
+    Columns 0..E-1 are the existing-node rows (dead rows zero-capacity),
+    column E is the claim envelope; padding columns are zero."""
+    snap, esnap = bundle.snap, bundle.esnap
+    G, E, R = snap.G, esnap.E, len(snap.resources)
+    N = len(col_arr)
+    Gp = _pow2(G)
+    Ec = _pow2(E + 1)
+    Np = _pow2(max(N, 2), lo=2)
+    f32 = np.float32
+
+    d = np.zeros((Gp, R), f32)
+    d[:G] = snap.g_demand[:G]
+    live = np.asarray(esnap.live, dtype=bool)
+    capR = np.zeros((Ec, R), f32)
+    capR[:E] = np.maximum(np.asarray(esnap.e_avail, f32), 0.0)
+    capR[:E][~live] = 0.0
+    if snap.T:
+        alloc_eff = snap.t_alloc - snap.m_overhead[snap.t_tmpl]
+        capR[E] = np.maximum(alloc_eff.max(axis=0), 0.0)
+    # per-resource equilibration: raw units span ~10 orders (cpu cores
+    # vs memory BYTES), which would crush the Pock-Chambolle diagonal
+    # steps to ~1e-11 and stall the iteration at the origin (a stalled y
+    # reads as dy=0 and fakes convergence). Scaling d and capR by the
+    # same per-resource factor is a pure change of units — every
+    # constraint, ratio, and floor(resid/d) downstream is invariant.
+    rscale = 1.0 / np.maximum(np.maximum(capR.max(0), d.max(0)), 1e-12)
+    d *= rscale[None, :]
+    capR *= rscale[None, :]
+    compat = np.zeros((Gp, Ec), f32)
+    compat[:G, :E] = np.asarray(esnap.ge_ok, bool)[:G, :E] & live[None, :]
+    compat[:G, E] = claim_compat[:G]
+    contrib_p = np.zeros((Np, Gp), f32)
+    contrib_p[:N, :G] = contrib[:, :G]
+    base_p = np.zeros(Gp, f32)
+    base_p[:G] = base_req[:G]
+    w = np.zeros(Np, f32)
+    if N > 1:
+        w[:N] = 1.0 + PREFIX_TIEBREAK * (N - 1 - np.arange(N)) / (N - 1)
+    else:
+        w[:N] = 1.0
+    # colcand[e] = candidate index retiring column e (Np = none);
+    # candidx[c] = column of candidate c (padding points at a dead slot)
+    colcand = np.full(Ec, Np, np.int32)
+    colcand[col_arr] = np.arange(N, dtype=np.int32)
+    candidx = np.full(Np, Ec - 1, np.int32)
+    candidx[:N] = col_arr.astype(np.int32)
+    nmask = np.zeros(Np, f32)
+    nmask[:N] = 1.0
+    gmask = np.zeros(Gp, f32)
+    gmask[:G] = 1.0
+    # claim column's objective penalty (delete-only preference): the
+    # claim sits at column E, a per-instance position INSIDE the padded
+    # shape family, so it rides a tensor rather than a baked constant
+    c_x = np.zeros((Gp, Ec), f32)
+    c_x[:G, E] = CLAIM_PENALTY
+    return dict(d=d, capR=capR, compat=compat, contrib=contrib_p,
+                base_req=base_p, w=w, colcand=colcand, candidx=candidx,
+                nmask=nmask, gmask=gmask, c_x=c_x), (Gp, Ec, Np, R)
+
+
+def joint_relax_plan(bundle, candidates, col_arr, contrib, cum,
+                     timings):
+    """The relax fast path of ``joint_retirement_plan``: solve the
+    fractional retirement LP, round through the device window, price-gate
+    and exactly materialize the winning prefix with the FFD oracle.
+    Returns ``(JointPlan, None)`` on a shipped plan or ``(None, cause)``
+    when the round falls to the ladder (``cause`` is also pinned in
+    ``RELAX_STATS['last_fallback']``; the ledger verdict for a ladder
+    round that relax first declined is ``relax-fallback``)."""
+    from karpenter_tpu.ops import consolidate as _cons
+
+    RELAX_STATS["attempts"] += 1
+    snap = bundle.snap
+    G, N = snap.G, len(candidates)
+    base = bundle.base
+    claimable = bundle.claimable_groups()
+    if claimable is None:
+        if int(base.sum()):
+            # claim accounting can't mirror the simulation (claimability
+            # too large to prove with pending pods riding the demand):
+            # the LP would not be definitive — the ladder's gallop is
+            # the recovery machinery, exactly the non-definitive stance
+            _fallback("inexpressible")
+            return None, "inexpressible"
+        base_req = np.zeros(G, dtype=np.float64)
+        claim_compat = np.ones(G, dtype=bool) if snap.T else np.zeros(
+            G, dtype=bool)
+    else:
+        base_req = np.where(claimable[:G], base[:G], 0).astype(np.float64)
+        claim_compat = np.asarray(claimable[:G], dtype=bool)
+
+    t0 = time.perf_counter()
+    tensors, (Gp, Ec, Np, R) = _joint_tensors(
+        bundle, col_arr, contrib, base_req, claim_compat)
+    fn, key = _get_joint_kernel(Gp, Ec, Np, R)
+    with obs.span("relax.solve", family=f"{Gp}x{Ec}", n=N):
+        out = fn(tensors["d"], tensors["capR"], tensors["compat"],
+                 tensors["contrib"], tensors["base_req"], tensors["w"],
+                 tensors["colcand"], tensors["candidx"],
+                 tensors["nmask"], tensors["gmask"], tensors["c_x"])
+        out = {k: np.asarray(v) for k, v in out.items()}
+    secs = time.perf_counter() - t0
+    devplane.record_dispatch("relax.kernel", key, secs)
+    devplane.record_padding("relax.grid", G * (bundle.esnap.E + 1) * N,
+                            Gp * Ec * Np)
+    RELAX_STATS["kernel_ms"] += secs * 1000.0
+    iters = int(out["iters"])
+    RELAX_STATS["iters"] += iters
+    RELAX_STATS["last_iters"] = iters
+    RELAX_STATS["last_viol"] = float(out["viol"])
+    timings["relax_ms"] = timings.get("relax_ms", 0.0) + secs * 1000.0
+
+    if not bool(out["converged"]):
+        # the while_loop only exits converged or capped; a capped exit
+        # leaves the fractional point uncertified (sum(y) is no bound)
+        _fallback("iteration-cap")
+        return None, "iteration-cap"
+    k_ub = int(min(N, np.floor(float(out["k_frac"]) + 0.5)))
+    RELAX_STATS["last_k_ub"] = k_ub
+    if k_ub < 2:
+        _fallback("lp-no-retirement")
+        return None, "lp-no-retirement"
+
+    # --- bounded device rounding descent: vmapped dispatches score W
+    # prefixes per window below the LP bound, up to
+    # KARPENTER_RELAX_ROUND_WINDOWS windows deep (the LP bound can
+    # overshoot the integral optimum by more than one window's reach on
+    # wide fleets). Replaces the host repair loop.
+    n_windows = _relax_round_windows()
+    live = np.asarray(bundle.esnap.live, dtype=bool)
+    E = bundle.esnap.E
+    # the host oracle's group order (raw-unit demand sum, the
+    # _greedy_displace sort) — NOT the equilibrated tensors' order,
+    # which can disagree and fail a prefix the oracle would round
+    order = np.argsort(
+        -np.asarray(snap.g_demand, np.float64)[:G].sum(1), kind="stable")
+    order_p = np.concatenate(
+        [order, np.arange(G, Gp)]).astype(np.intp)
+    d_ord = tensors["d"][order_p]
+    compat_ord = tensors["compat"][order_p]
+    base_cap = tensors["capR"]
+    rfn = _get_round_kernel(Gp, Ec, R, E)
+    # price criterion for claim-bearing prefixes — the SAME ladder the
+    # FFD path applies (ops/consolidate.py _prefix_price_ok)
+    prefix_known, claim_ok = _cons._prefix_price_ok(bundle, candidates)
+    price_blocked = False
+    attempts = 0
+    chosen = None
+    k_dev = 0  # first flag-passing window k — the DEVICE decision the
+    #            capsule records (host materialization below may descend
+    #            further; the shipped k rides the capture as a static)
+    # one prefix of headroom above the bound: the iteration terminates
+    # on primal residual + movement, not duality gap, so the fractional
+    # point can sit up to ~one unit shy of the true optimum — the flag
+    # row rejects the extra prefix when the bound was already tight
+    k_lo = int(min(N, k_ub + 1))
+    for _w in range(n_windows):
+        if chosen is not None or k_lo < 2 or attempts >= ROUND_ATTEMPTS:
+            break
+        ks = [k for k in range(k_lo, max(1, k_lo - ROUND_WINDOW), -1)]
+        req_w = np.zeros((ROUND_WINDOW, Gp), np.float32)
+        surv_w = np.zeros((ROUND_WINDOW, Ec), np.float32)
+        for i, k in enumerate(ks):
+            req = base_req.copy()
+            req[:G] += contrib[:k, :G].sum(axis=0)
+            req_w[i, :Gp] = np.concatenate(
+                [req[order], np.zeros(Gp - G)]).astype(np.float32)
+            mask = np.ones(Ec, np.float32)
+            mask[col_arr[:k]] = 0.0
+            surv_w[i] = mask
+        # surv rows carry the capacity budget directly (cap * mask)
+        surv_w = surv_w[:, :, None] * base_cap[None, :, :]
+        t1 = time.perf_counter()
+        with obs.span("relax.round", window=len(ks)):
+            bad, claim = rfn(req_w, surv_w, d_ord, compat_ord)
+            bad = np.asarray(bad)
+            claim = np.asarray(claim)
+        secs = time.perf_counter() - t1
+        devplane.record_dispatch("relax.kernel", ("round",) + key, secs)
+        RELAX_STATS["kernel_ms"] += secs * 1000.0
+        timings["relax_ms"] += secs * 1000.0
+        for i, k in enumerate(ks):
+            if k < 2 or bad[i] > 0.5:
+                continue
+            claim_used = bool(claim[i] > 0.5)
+            if claim_used and not (prefix_known[k - 1]
+                                   and claim_ok[k - 1]):
+                price_blocked = True
+                continue
+            if k_dev == 0:
+                k_dev = k
+            if attempts >= ROUND_ATTEMPTS:
+                break
+            attempts += 1
+            surv = live.copy()
+            surv[col_arr[:k]] = False
+            required = base_req.copy()
+            required[:G] += contrib[:k, :G].sum(axis=0)
+            plan = _cons._greedy_displace(
+                bundle, surv, required, allow_claim=claim_used)
+            if plan is not None:
+                chosen = (k, plan, claim_used)
+                break
+        k_lo = ks[-1] - 1
+    cause = None
+    if chosen is None:
+        cause = "price-gate" if price_blocked else "non-convergence"
+        _fallback(cause)
+    _capture_joint(bundle, candidates, col_arr, contrib, cum, base_req,
+                   tensors, out, key, k_dev,
+                   0 if chosen is None else chosen[0],
+                   prefix_known, claim_ok, order)
+    if chosen is None:
+        return None, cause
+    k_final, (placements, overflow), _ = chosen
+    dropped = max(k_ub - k_final, 0)
+    RELAX_STATS["ships"] += 1
+    RELAX_STATS["rounded_drops"] += dropped
+    prefix_feasible = np.zeros(N, dtype=bool)
+    prefix_feasible[:k_final] = True
+    plan = _cons.JointPlan(
+        candidates,
+        selected_idx=range(k_final),
+        delete_only=not overflow,
+        definitive=True,
+        displacement=placements,
+        overflow=overflow,
+        k_device=k_ub,
+        dropped=dropped,
+        timings=timings,
+        prefix_feasible=prefix_feasible,
+        single_mask=None,
+        generation=bundle.generation,
+        transient=False,
+        solver="relax",
+    )
+    return plan, None
+
+
+def _capture_joint(bundle, candidates, col_arr, contrib, cum, base_req,
+                   tensors, out, key, k_dev, k_shipped,
+                   prefix_known, claim_ok, order):
+    """Record the ``relax.dispatch`` capsule seam: the LP tensors (the
+    relax rung's replay inputs) merged with the standard
+    counterfactual-row sidecars and shared snapshot args, so the A/B
+    table can race the relax rung against the FFD ladder (``_run_probe``
+    verbatim) and the host-FFD oracle on ONE capture. The captured
+    ``k_sel`` output is the DEVICE window's selection — the first
+    flag-passing, price-gated prefix — which replays bit-identically
+    from the tensors alone; the host-materialized prefix the round
+    actually shipped (which may descend further on ``_greedy_displace``
+    refusals, and depends on live bundle state) rides as the
+    ``k_shipped`` static."""
+    from karpenter_tpu.obs import capsule as _capsule
+
+    if not _capsule.capture_enabled():
+        return
+    G, N = bundle.snap.G, len(candidates)
+    shared, (Gp_probe, Ep_probe) = bundle._shared_args()
+    g_count_k = bundle.base[None, :] + cum
+    lens = np.array([k + 1 for k in range(N)], dtype=np.int64)
+    idx = np.concatenate(
+        [col_arr[: k + 1] for k in range(N)]).astype(np.int64) if N else (
+            np.zeros(0, dtype=np.int64))
+    required = np.repeat(base_req[None, :G], N, axis=0)
+    required += np.cumsum(contrib[:, :G], axis=0)
+    inputs = dict(shared)
+    cf = _capsule.CF_PREFIX
+    inputs[cf + "g_count_rows"] = np.asarray(g_count_k)
+    inputs[cf + "e_avail"] = np.asarray(bundle.esnap.e_avail)
+    inputs[cf + "e_zero_idx"] = idx
+    inputs[cf + "e_zero_len"] = lens
+    for name in ("d", "capR", "compat", "contrib", "base_req", "w",
+                 "colcand", "candidx", "nmask", "gmask", "c_x"):
+        inputs[cf + "rx_" + name] = tensors[name]
+    inputs[cf + "rx_required"] = required
+    inputs[cf + "rx_col_arr"] = col_arr.astype(np.int64)
+    # the host oracle's group order (raw-unit demand) — equilibrated
+    # tensors can't reproduce it, so it rides the capture
+    inputs[cf + "rx_order"] = np.asarray(order, np.int64)
+    inputs[cf + "rx_claim_gate"] = (
+        np.asarray(prefix_known, bool) & np.asarray(claim_ok, bool))
+    _capsule.record_capture(
+        "relax.dispatch", inputs,
+        {"y": np.asarray(out["y"]), "k_sel": np.int64(k_dev)},
+        engine="relax", max_minv=bundle.max_minv,
+        Gp=Gp_probe, Ep=Ep_probe, k_shipped=int(k_shipped),
+        rx_shape=list(key[:4]), rx_iters=key[4], rx_tol=key[5],
+        rx_rho=key[6], rx_windows=_relax_round_windows(),
+        rx_n=N, rx_g=G, rx_e=bundle.esnap.E)
+
+
+# ---------------------------------------------------------------------------
+# capsule replay entries (obs/capsule.py "relax.dispatch" seam)
+# ---------------------------------------------------------------------------
+
+
+def replay_joint(cap) -> dict:
+    """Re-run the captured LP + rounding decision bit-identically: the
+    same kernel family, the same knob values (from the capture statics,
+    not the live environment), the same price-gate bits."""
+    Gp, Ec, Np, R = (int(v) for v in cap.static("rx_shape"))
+    iters = int(cap.static("rx_iters"))
+    tol = float(cap.static("rx_tol"))
+    rho = float(cap.static("rx_rho"))
+    N = int(cap.static("rx_n"))
+    G = int(cap.static("rx_g"))
+    E = int(cap.static("rx_e"))
+    key = (Gp, Ec, Np, R, iters, tol, rho)
+    fn = _JOINT_KERNELS.get(key)
+    if fn is None:
+        fn = _joint_kernel(Gp, Ec, Np, R, iters, tol, rho)
+        _JOINT_KERNELS[key] = fn
+    t = {n: np.asarray(cap.sidecar("rx_" + n))
+         for n in ("d", "capR", "compat", "contrib", "base_req", "w",
+                   "colcand", "candidx", "nmask", "gmask", "c_x")}
+    out = fn(t["d"], t["capR"], t["compat"], t["contrib"], t["base_req"],
+             t["w"], t["colcand"], t["candidx"], t["nmask"], t["gmask"],
+             t["c_x"])
+    out = {k: np.asarray(v) for k, v in out.items()}
+    k_sel = 0
+    if bool(out["converged"]):
+        k_ub = int(min(N, np.floor(float(out["k_frac"]) + 0.5)))
+        if k_ub >= 2:
+            n_windows = int(cap.static("rx_windows", 1))
+            col_arr = np.asarray(cap.sidecar("rx_col_arr"))
+            claim_gate = np.asarray(cap.sidecar("rx_claim_gate"))
+            rk = _ROUND_KERNELS.get((Gp, Ec, R, ROUND_WINDOW, E,
+                                     iters, tol, rho))
+            if rk is None:
+                rk = _round_kernel(Gp, Ec, R, ROUND_WINDOW, E)
+                _ROUND_KERNELS[(Gp, Ec, R, ROUND_WINDOW, E,
+                                iters, tol, rho)] = rk
+            order = np.asarray(cap.sidecar("rx_order"))
+            order_p = np.concatenate([order, np.arange(G, Gp)]).astype(
+                np.intp)
+            req_all = np.asarray(cap.sidecar("rx_required"))
+            k_lo = int(min(N, k_ub + 1))  # the same one-prefix headroom
+            for _w in range(n_windows):
+                if k_sel or k_lo < 2:
+                    break
+                ks = [k for k in
+                      range(k_lo, max(1, k_lo - ROUND_WINDOW), -1)]
+                req_w = np.zeros((ROUND_WINDOW, Gp), np.float32)
+                surv_w = np.zeros((ROUND_WINDOW, Ec), np.float32)
+                for i, k in enumerate(ks):
+                    req_w[i, :G] = req_all[k - 1][order]
+                    mask = np.ones(Ec, np.float32)
+                    mask[col_arr[:k]] = 0.0
+                    surv_w[i] = mask
+                surv_w = surv_w[:, :, None] * t["capR"][None, :, :]
+                bad, claim = rk(req_w, surv_w, t["d"][order_p],
+                                t["compat"][order_p])
+                bad, claim = np.asarray(bad), np.asarray(claim)
+                for i, k in enumerate(ks):
+                    if k < 2 or bad[i] > 0.5:
+                        continue
+                    if claim[i] > 0.5 and not claim_gate[k - 1]:
+                        continue
+                    k_sel = k
+                    break
+                k_lo = ks[-1] - 1
+    return {"y": np.asarray(out["y"]), "k_sel": np.int64(k_sel)}
+
+
+def replay_host_round(cap) -> dict:
+    """The host-FFD oracle leg of the A/B table: pure-numpy greedy
+    prefix descent over the captured LP tensors — largest prefix whose
+    displaced pods place integrally (f64, the _greedy_displace
+    arithmetic), price-gated identically."""
+    N = int(cap.static("rx_n"))
+    G = int(cap.static("rx_g"))
+    d = np.asarray(cap.sidecar("rx_d"), dtype=np.float64)[:G]
+    capR = np.asarray(cap.sidecar("rx_capR"), dtype=np.float64)
+    compat = np.asarray(cap.sidecar("rx_compat")).astype(bool)[:G]
+    col_arr = np.asarray(cap.sidecar("rx_col_arr"))
+    req_all = np.asarray(cap.sidecar("rx_required"), dtype=np.float64)
+    claim_gate = np.asarray(cap.sidecar("rx_claim_gate"))
+    E = int(cap.static("rx_e"))
+    order = np.asarray(cap.sidecar("rx_order"))
+    k_sel = 0
+    for k in range(N, 1, -1):
+        resid = capR.copy()
+        resid[col_arr[:k]] = 0.0
+        required = req_all[k - 1]
+        ok = True
+        claim_used = False
+        for g in order:
+            n = float(required[g])
+            if n <= 0:
+                continue
+            dg = d[g]
+            pos = dg > 0
+            if not pos.any():
+                continue
+            rows = np.flatnonzero(compat[g])
+            # survivors-first, claim as last resort — the same tiering
+            # as _greedy_displace and the device window kernel
+            surv_rows = rows[rows < E]
+            caps = np.floor(
+                (resid[np.ix_(surv_rows, np.flatnonzero(pos))]
+                 / dg[pos][None, :]).min(axis=1) + 1e-9)
+            for j in np.argsort(-caps, kind="stable"):
+                if n <= 0:
+                    break
+                take = min(n, caps[j])
+                if take <= 0:
+                    break
+                resid[surv_rows[j]] -= take * dg
+                n -= take
+            if n > 0 and (rows >= E).any():
+                e = int(rows[rows >= E][0])
+                ccap = float(np.floor(
+                    (resid[e][pos] / dg[pos]).min() + 1e-9))
+                take = min(n, ccap)
+                if take > 0:
+                    claim_used = True
+                    resid[e] -= take * dg
+                    n -= take
+            if n > 0:
+                ok = False
+                break
+        if ok and claim_used and not claim_gate[k - 1]:
+            ok = False
+        if ok:
+            k_sel = k
+            break
+    return {"k_sel": np.int64(k_sel)}
+
+
+# ---------------------------------------------------------------------------
+# provisioning bin floor (models/solver.py _run_and_decode)
+# ---------------------------------------------------------------------------
+
+
+def _floor_kernel(Gp, Tp, R, max_iters, tol, rho):
+    """PDHG over the provisioning LP (min total fractional bins), with a
+    dual projection AFTER the iteration budget: scale the capacity duals
+    into the bin constraint's cone, price every group at its cheapest
+    compatible type, and weak duality certifies the resulting objective
+    as a bin-count lower bound whether or not the primal converged."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def run(d, n, alloc, compat):
+        # vars x[Gp,Tp] (pods of g on type t), b[Tp] (fractional bins)
+        col_x = (1.0 + d.sum(1))[:, None] * compat
+        tau_x = jnp.where(col_x > 0, rho / jnp.maximum(col_x, 1e-9), 0.0)
+        col_b = alloc.sum(1)
+        tau_b = jnp.where(col_b > 0, rho / jnp.maximum(col_b, 1e-9), 0.0)
+        row_q = compat.sum(1)
+        sig_q = jnp.where(row_q > 0, 1.0 / (rho * jnp.maximum(row_q, 1e-9)),
+                          0.0)
+        row_p = compat.T @ d + alloc
+        sig_p = jnp.where(row_p > 0, 1.0 / (rho * jnp.maximum(row_p, 1e-9)),
+                          0.0)
+        n_tot = n.sum()
+
+        def one(state):
+            x, b, q, p = state
+            ktx = -q[:, None] + d @ p.T
+            ktb = 1.0 - (alloc * p).sum(1)
+            xn = jnp.clip((x - tau_x * ktx) * compat, 0.0, n[:, None])
+            bn = jnp.clip(b - tau_b * ktb, 0.0, n_tot)
+            xb, bb = 2.0 * xn - x, 2.0 * bn - b
+            qn = jnp.maximum(q + sig_q * (n - xb.sum(1)), 0.0)
+            r_p = xb.T @ d - bb[:, None] * alloc
+            pn = jnp.maximum(p + sig_p * r_p, 0.0)
+            return xn, bn, qn, pn
+
+        def cond(carry):
+            _, _, _, _, it, done = carry
+            return jnp.logical_and(~done, it < max_iters)
+
+        def body(carry):
+            x, b, q, p, it, _ = carry
+            b0 = b.sum()
+            x, b, q, p = lax.fori_loop(
+                0, CHECK_EVERY, lambda _, s: one(s), (x, b, q, p))
+            done = jnp.abs(b.sum() - b0) <= tol * (1.0 + b0)
+            return x, b, q, p, it + CHECK_EVERY, done
+
+        z = jnp.zeros
+        x, b, q, p, it, _ = lax.while_loop(
+            cond, body,
+            (z((Gp, Tp), d.dtype), z(Tp, d.dtype), z(Gp, d.dtype),
+             z((Tp, R), d.dtype), jnp.int32(0), jnp.bool_(False)))
+        # dual projection — valid regardless of convergence: scale each
+        # type's capacity duals into the b-constraint cone, price groups
+        # at their cheapest compatible type
+        scale = jnp.maximum((alloc * p).sum(1), 1.0)
+        p_hat = p / scale[:, None]
+        cost = d @ p_hat.T  # [Gp,Tp]
+        cost = jnp.where(compat > 0, cost, jnp.inf)
+        q_hat = cost.min(1)
+        q_hat = jnp.where(jnp.isfinite(q_hat), q_hat, 0.0)
+        return {"lb": (n * q_hat).sum(), "iters": it}
+
+    return jax.jit(run)
+
+
+def lp_bin_floor(snap, est: int) -> int:
+    """A certified bin-count lower bound for one provisioning solve, or
+    ``est`` unchanged when the rung is off/inapplicable. Called from
+    ``models/solver.py _run_and_decode`` to tighten the bin-axis
+    estimate; a raise is recorded as the ``solver.route`` ``relax`` rung
+    when the solve completes (deploy/README.md "LP relaxation rung")."""
+    if not relax_enabled():
+        return est
+    G, T = snap.G, snap.T
+    R = len(snap.resources)
+    if G < 2 or T < 1 or G * T > (1 << 18):
+        return est
+    from karpenter_tpu.ops.consolidate import _group_type_compat
+
+    RELAX_STATS["floor_calls"] += 1
+    t0 = time.perf_counter()
+    compat = _group_type_compat(snap)  # [G,T]
+    Gp, Tp = _pow2(G, lo=2), _pow2(T, lo=2)
+    f32 = np.float32
+    d = np.zeros((Gp, R), f32)
+    d[:G] = snap.g_demand[:G]
+    n = np.zeros(Gp, f32)
+    n[:G] = snap.g_count[:G]
+    alloc = np.zeros((Tp, R), f32)
+    alloc[:T] = np.maximum(
+        snap.t_alloc - snap.m_overhead[snap.t_tmpl], 0.0)
+    # per-resource equilibration (same stance as _joint_tensors): the LP
+    # is unit-invariant, the diagonal step sizes are not
+    rscale = 1.0 / np.maximum(np.maximum(alloc.max(0), d.max(0)), 1e-12)
+    d *= rscale[None, :]
+    alloc *= rscale[None, :]
+    cm = np.zeros((Gp, Tp), f32)
+    cm[:G, :T] = compat
+    # relax_enabled() in the key: GL501 — every knob read on this path
+    # (including the enable gate above) fingerprints the cache entry
+    key = (Gp, Tp, R, relax_enabled(),
+           _relax_max_iters(), _relax_tol(), _relax_rho())
+    fn = _FLOOR_KERNELS.get(key)
+    if fn is None:
+        fn = _floor_kernel(Gp, Tp, R, key[4], key[5], key[6])
+        _FLOOR_KERNELS[key] = fn
+    out = fn(d, n, alloc, cm)
+    lb = float(np.asarray(out["lb"]))
+    secs = time.perf_counter() - t0
+    devplane.record_dispatch("relax.kernel", ("floor",) + key, secs)
+    devplane.record_padding("relax.grid", G * T, Gp * Tp)
+    RELAX_STATS["kernel_ms"] += secs * 1000.0
+    floor = int(np.ceil(lb - 1e-6))
+    if floor > est:
+        RELAX_STATS["floor_raises"] += 1
+        return floor
+    return est

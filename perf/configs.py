@@ -151,6 +151,49 @@ def config4_consolidation_env(n_nodes=300):
     return env
 
 
+def config4_xl_env(n_nodes=10000, n_groups=128):
+    """The XL sentinel shape (deploy/README.md "LP relaxation rung"): the
+    config-4 utilization drop at ``n_nodes`` nodes but only ``n_groups``
+    pod GROUPS — many replicas per deployment instead of one deployment
+    per node. The shape matters: the FFD prefix ladder's joint dispatch
+    scales with the CANDIDATE count (one counterfactual row per prefix,
+    O(N·G·E)) while the LP relaxation rung scales with the group count
+    (O(iters·G·E)), so this fleet is exactly where the ladder times out
+    and the LP rung completes."""
+    from karpenter_tpu.api.objects import Deployment
+    from karpenter_tpu.operator import Environment
+    from karpenter_tpu.operator.options import Options
+
+    catalog = [make_instance_type("xl", 16, 64)]
+    env = Environment(
+        instance_types=catalog,
+        enable_disruption=True,
+        options=Options.from_env(
+            feature_gates={"spot_to_spot_consolidation": True}),
+    )
+    env.disruption.poll_period = float("inf")
+    pool = _pool()
+    pool.spec.disruption.consolidate_after = 0.0
+    pool.spec.disruption.budgets[0].nodes = "100%"
+    env.create("nodepools", pool)
+    per_group = max(n_nodes // n_groups, 1)
+    deploys = [
+        Deployment(metadata=ObjectMeta(name=f"d{i}"),
+                   replicas=3 * per_group,
+                   template=_pod(f"d{i}-tpl", 5.0, 10.0))
+        for i in range(n_groups)
+    ]
+    for d in deploys:
+        env.store.create("deployments", d)
+    env.run_until_idle(max_rounds=400)
+    # drop to 1/3 utilization: every deployment sheds 2/3 of its replicas
+    for d in deploys:
+        d.replicas = per_group
+        env.store.update("deployments", d)
+    env.run_until_idle(max_rounds=400)
+    return env
+
+
 # the spot storm's market surface (ISSUE 15): one 16-cpu shape, four
 # zones whose SPOT offerings anti-correlate price and interruption risk —
 # the suspiciously-cheap zones are the ones the storm reclaims. Prices

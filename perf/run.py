@@ -398,13 +398,24 @@ def run_global_consolidation():
     # ISSUE-14 wall gate: <5 s (was 10 s pre-short-circuit)
     budget_ms = float(os.environ.get("PERF_GLOBAL_BUDGET_MS", "5000"))
 
+    # PERF_GLOBAL_RELAX=1: force the LP relaxation rung on for the joint
+    # leg (deploy/README.md "LP relaxation rung") — off it defers to the
+    # backend probe, which keeps the CPU-container baseline on the ladder
+    relax_forced = os.environ.get("PERF_GLOBAL_RELAX", "") == "1"
+
     def leg(enabled: bool) -> dict:
+        from karpenter_tpu.ops.relax import RELAX_STATS
+
         prior = os.environ.get("KARPENTER_GLOBAL_CONSOLIDATION")
+        prior_rx = os.environ.get("KARPENTER_RELAX")
         os.environ["KARPENTER_GLOBAL_CONSOLIDATION"] = (
             "1" if enabled else "0")
+        if relax_forced and enabled:
+            os.environ["KARPENTER_RELAX"] = "1"
         try:
             env = C.config4_consolidation_env(n_nodes)
             g0 = dict(GLOBAL_STATS)
+            rx0 = dict(RELAX_STATS)
             t0 = dict(_term.STATS)
             b0 = dict(_binder.STATS)
             q0 = dict(_oq.STATS)
@@ -430,7 +441,8 @@ def run_global_consolidation():
                     **{
                         k: round(GLOBAL_STATS[k] - g0[k], 2)
                         for k in ("formulate_ms", "solve_ms",
-                                  "round_repair_ms", "bundle_ms")
+                                  "round_repair_ms", "bundle_ms",
+                                  "relax_ms")
                     },
                     # the post-command wave (ISSUE 14): the PDB-checked
                     # eviction wave, the binder's displaced-pod passes,
@@ -451,31 +463,47 @@ def run_global_consolidation():
                 # carries the short-circuit's joint-noop-fenced verdicts
                 # (rounds closed off the one dispatch), reported
                 # separately as fenced_rounds.
-                key = ("consolidate.global", "joint", "ok")
-                out["joint_commands"] = int(
-                    dec1.get(key, 0) - dec0.get(key, 0))
+                # (the LP relaxation rung splits the verdict by solver:
+                # relax / relax-rounded for LP-shipped plans,
+                # relax-fallback for ladder plans the LP first declined
+                # — all pay the same one-confirm contract)
+                out["joint_commands"] = int(sum(
+                    dec1.get(k, 0) - dec0.get(k, 0)
+                    for k in (("consolidate.global", "joint", r)
+                              for r in ("ok", "relax", "relax-rounded",
+                                        "relax-fallback"))))
                 fkey = ("consolidate.global", "joint", "joint-noop-fenced")
                 out["fenced_rounds"] = int(
                     dec1.get(fkey, 0) - dec0.get(fkey, 0))
                 out["max_dispatches_per_generation"] = (
                     _cons.max_dispatches_per_generation())
+                out["relax"] = {
+                    k: round(RELAX_STATS[k] - rx0[k], 2)
+                    for k in ("attempts", "ships", "fallbacks",
+                              "kernel_ms")}
             return out
         finally:
             if prior is None:
                 os.environ.pop("KARPENTER_GLOBAL_CONSOLIDATION", None)
             else:
                 os.environ["KARPENTER_GLOBAL_CONSOLIDATION"] = prior
+            if relax_forced and enabled:
+                if prior_rx is None:
+                    os.environ.pop("KARPENTER_RELAX", None)
+                else:
+                    os.environ["KARPENTER_RELAX"] = prior_rx
 
     joint = leg(True)
     ladder = leg(False)
     row = {
         "config": f"4-consolidation-{n_nodes}-global",
         "nodes": n_nodes,
+        "relax_forced": relax_forced,
         **{k: joint[k] for k in (
             "total_ms", "rounds", "end_nodes", "pods_bound", "end_cost",
             "confirm_count", "joint_commands", "fenced_rounds",
             "breakdown", "repair_drops", "max_dispatches_per_generation",
-            "rungs")},
+            "rungs", "relax")},
         "ladder": {k: ladder[k] for k in (
             "total_ms", "rounds", "end_nodes", "pods_bound", "end_cost")},
         # the acceptance verdicts (bench.py --consolidation): <budget
@@ -491,6 +519,105 @@ def run_global_consolidation():
             and joint["confirm_count"] == joint["joint_commands"]),
         "dispatch_contract_ok": bool(
             joint["max_dispatches_per_generation"] <= 1),
+    }
+    print(json.dumps(row))
+
+
+def _xl_one_round(n_nodes: int, n_groups: int) -> dict:
+    """ONE global-consolidation command computation over the XL fleet
+    (build + single compute, no convergence loop): the sentinel measures
+    the ROUND cost where the two solvers diverge asymptotically, not the
+    drain/rebind machinery both share."""
+    from karpenter_tpu.controllers.disruption.helpers import (
+        build_disruption_budgets,
+        get_candidates,
+    )
+    from karpenter_tpu.controllers.disruption.methods import (
+        GlobalConsolidation,
+    )
+    from karpenter_tpu.ops.relax import RELAX_STATS
+
+    env = C.config4_xl_env(n_nodes, n_groups)
+    d = env.disruption
+    method = next(m for m in d.methods
+                  if isinstance(m, GlobalConsolidation))
+    candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                                queue=d.queue)
+    budgets = build_disruption_budgets(d.cluster, d.store, d.clock)
+    rx0 = dict(RELAX_STATS)
+    t0 = time.perf_counter()
+    cmd = method.compute_command(candidates, budgets)
+    round_ms = (time.perf_counter() - t0) * 1000.0
+    return {
+        "nodes": len(env.store.list("nodes")),
+        "candidates": len(candidates),
+        "round_ms": round(round_ms, 2),
+        "command_size": len(cmd.candidates) if cmd else 0,
+        "relax": {k: round(RELAX_STATS[k] - rx0[k], 2)
+                  for k in ("attempts", "ships", "fallbacks", "kernel_ms",
+                            "last_k_ub")},
+    }
+
+
+def run_global_xl():
+    """The 10k-node LP-rung sentinel (deploy/README.md "LP relaxation
+    rung"): ONE joint round over a PERF_GLOBAL_XL_NODES (10000) fleet of
+    PERF_GLOBAL_XL_GROUPS (128) pod groups. The relax leg runs in
+    process (KARPENTER_RELAX=1); the ladder leg runs the SAME round in a
+    subprocess under PERF_GLOBAL_XL_TIMEOUT_S (600) — at this shape its
+    joint dispatch is O(candidates · groups · nodes) and is EXPECTED to
+    time out, which is the row's point: ``relax_completed`` with
+    ``ladder_completed`` false is the acceptance verdict bench.py gates
+    (a ladder that finishes first would instead flag the LP rung as
+    pointless here)."""
+    import subprocess
+
+    n_nodes = int(os.environ.get("PERF_GLOBAL_XL_NODES", "10000"))
+    n_groups = int(os.environ.get("PERF_GLOBAL_XL_GROUPS", "128"))
+    timeout_s = float(os.environ.get("PERF_GLOBAL_XL_TIMEOUT_S", "600"))
+
+    prior = {k: os.environ.get(k) for k in
+             ("KARPENTER_GLOBAL_CONSOLIDATION", "KARPENTER_RELAX")}
+    os.environ["KARPENTER_GLOBAL_CONSOLIDATION"] = "1"
+    os.environ["KARPENTER_RELAX"] = "1"
+    try:
+        relax_leg = _xl_one_round(n_nodes, n_groups)
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    child = (
+        "import json, os\n"
+        "os.environ['KARPENTER_GLOBAL_CONSOLIDATION'] = '1'\n"
+        "os.environ['KARPENTER_RELAX'] = '0'\n"
+        f"from perf.run import _xl_one_round\n"
+        f"print(json.dumps(_xl_one_round({n_nodes}, {n_groups})))\n"
+    )
+    ladder_leg: dict = {"completed": False, "timeout_s": timeout_s}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child], capture_output=True,
+            text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        if proc.returncode == 0:
+            ladder_leg = {"completed": True,
+                          **json.loads(proc.stdout.strip().splitlines()[-1])}
+        else:
+            ladder_leg["error"] = (proc.stderr or "")[-500:]
+    except subprocess.TimeoutExpired:
+        pass
+
+    row = {
+        "config": f"4-consolidation-{n_nodes}x{n_groups}-global-xl",
+        "nodes": n_nodes,
+        "groups": n_groups,
+        "relax": relax_leg,
+        "ladder": ladder_leg,
+        "relax_completed": bool(relax_leg["relax"]["ships"] >= 1),
+        "ladder_completed": bool(ladder_leg.get("completed")),
     }
     print(json.dumps(row))
 
@@ -1387,6 +1514,11 @@ def main():
         # (no --json toggle: the joint breakdown IS the row's point and
         # is always emitted)
         run_global_consolidation()
+        return
+    if args in (["global", "--xl"], ["global-xl"]):
+        # the 10k-node LP-rung sentinel (one round, ladder in a
+        # timeout-guarded subprocess)
+        run_global_xl()
         return
     if args == ["spot"]:
         run_spot()

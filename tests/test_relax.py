@@ -1,0 +1,463 @@
+"""LP relaxation rung (ISSUE 17, ops/relax.py): the device-resident PDHG
+solver for provisioning + joint consolidation, with the FFD machinery
+demoted to rounding oracle.
+
+The suite pins (1) the fuzz bar — 200 seeded synthetic fleets through
+``joint_relax_plan``: every shipped end state is integrally feasible
+(placements re-validated against residual capacity) and retires at least
+as many nodes as the integral FFD oracle's best prefix; (2) the fallback
+matrix — non-convergence, inexpressible claim accounting, iteration cap,
+price gate, and no-retirement optima each hand the round to the ladder
+with the right ``RELAX_STATS['last_fallback']`` cause; (3) the
+``lp_bin_floor`` weak-duality certificate (floor never exceeds the FFD
+oracle's bin count); (4) the ``relax.dispatch`` capsule seam — replay
+bit-parity and the three-rung ``--ab`` race; (5) the ledger closure —
+``consolidate.global`` verdicts ``relax`` / ``relax-rounded`` /
+``relax-fallback``; (6) GL501 — every relax knob fingerprints the kernel
+caches.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.ops import consolidate as cons
+from karpenter_tpu.ops import relax
+
+FUZZ_SEEDS = 200
+
+
+# ---------------------------------------------------------------------------
+# synthetic fleets: a self-contained bundle double exercising the exact
+# attribute surface joint_relax_plan + _greedy_displace touch
+# ---------------------------------------------------------------------------
+
+
+def _mk_bundle(rng, G=4, E=12, N=8, fill_lo=0.15, fill_hi=0.65):
+    """A seeded delete-only fleet: E uniform nodes partially packed with
+    pods of G sized groups, the N least-loaded nodes as retirement
+    candidates in disruption-cost order. Claims are fenced off
+    (``claimable_groups`` all-False) so the LP, the window kernel, and
+    the oracle all answer the same pure-retirement question."""
+    cap = np.tile(np.array([16.0, 64.0]), (E, 1))
+    demand = np.stack(
+        [rng.uniform(1.0, 5.0, G), rng.uniform(2.0, 12.0, G)], axis=1)
+    counts = np.zeros((E, G), np.int64)
+    for e in range(E):
+        budget = cap[e] * rng.uniform(fill_lo, fill_hi)
+        for _ in range(12):
+            g = int(rng.integers(G))
+            if np.all(demand[g] <= budget):
+                counts[e, g] += 1
+                budget = budget - demand[g]
+    e_avail = cap - counts @ demand
+    nodes = [SimpleNamespace(state_node=SimpleNamespace(provider_id=f"n{e}"))
+             for e in range(E)]
+    snap = SimpleNamespace(
+        G=G, T=1, resources=("cpu", "mem"), g_demand=demand,
+        t_alloc=np.array([[16.0, 64.0]]),
+        m_overhead=np.array([[0.0, 0.0]]),
+        t_tmpl=np.zeros(1, np.intp))
+    esnap = SimpleNamespace(
+        E=E, e_avail=e_avail, live=np.ones(E, bool),
+        ge_ok=np.ones((G, E), bool), nodes=nodes)
+    order = np.argsort(counts.sum(1), kind="stable")
+    col_arr = order[:N].astype(np.int64)
+    contrib = counts[col_arr].astype(np.float64)
+    cum = np.cumsum(contrib, axis=0)
+    bundle = SimpleNamespace(
+        snap=snap, esnap=esnap, base=np.zeros(G, np.int64),
+        claimable_groups=lambda: np.zeros(G, bool),
+        generation=1, max_minv=0,
+        type_price_vectors=lambda: (np.zeros(0, np.float64), {}))
+    candidates = [
+        SimpleNamespace(price=1.0, instance_type=SimpleNamespace(name="xl"))
+        for _ in range(N)]
+    return bundle, candidates, col_arr, contrib, cum
+
+
+def _oracle_k(bundle, col_arr, contrib):
+    """The integral FFD ladder's answer: the largest prefix whose
+    displaced pods the exact host oracle places without a claim."""
+    G = bundle.snap.G
+    live = np.asarray(bundle.esnap.live, bool)
+    for k in range(len(col_arr), 1, -1):
+        surv = live.copy()
+        surv[col_arr[:k]] = False
+        required = contrib[:k, :G].sum(axis=0)
+        if cons._greedy_displace(bundle, surv, required,
+                                 allow_claim=False) is not None:
+            return k
+    return 0
+
+
+def _end_state_feasible(bundle, col_arr, contrib, plan):
+    """Re-validate a shipped plan from first principles: every displaced
+    pod lands on a named live survivor with residual capacity to spare,
+    and every retired node's pods are fully covered."""
+    k = len(plan.selected_idx)
+    demand = np.asarray(bundle.snap.g_demand, np.float64)
+    resid = np.maximum(np.asarray(bundle.esnap.e_avail, np.float64), 0.0)
+    resid[col_arr[:k]] = 0.0
+    placed = np.zeros(bundle.snap.G, np.float64)
+    for pid, g, cnt in plan.displacement:
+        e = int(pid[1:])
+        assert e not in set(col_arr[:k].tolist()), "landed on a retiree"
+        resid[e] -= cnt * demand[g]
+        placed[g] += cnt
+    required = contrib[:k, : bundle.snap.G].sum(axis=0)
+    return (resid >= -1e-6).all() and np.allclose(placed, required)
+
+
+FALLBACK_CAUSES = {"inexpressible", "iteration-cap", "non-convergence",
+                   "price-gate", "lp-no-retirement"}
+
+
+class TestRelaxFuzz:
+    def test_seeded_fleets_feasible_and_dominate_oracle(self, monkeypatch):
+        """ISSUE 17 satellite: 200 seeded snapshots — relax end states
+        integrally feasible, node count never worse than the integral
+        FFD oracle, every non-ship a pinned fallback cause."""
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        ships = fallbacks = 0
+        for seed in range(FUZZ_SEEDS):
+            rng = np.random.default_rng(seed)
+            bundle, cands, col_arr, contrib, cum = _mk_bundle(rng)
+            plan, cause = relax.joint_relax_plan(
+                bundle, cands, col_arr, contrib, cum, {})
+            if plan is None:
+                assert cause in FALLBACK_CAUSES, (seed, cause)
+                assert relax.RELAX_STATS["last_fallback"] == cause
+                fallbacks += 1
+                continue
+            ships += 1
+            assert cause is None
+            assert plan.solver == "relax" and plan.viable
+            assert plan.delete_only and not plan.overflow
+            k = len(plan.selected_idx)
+            assert list(plan.selected_idx) == list(range(k)), (
+                "selection must be a prefix of the disruption-cost order")
+            assert _end_state_feasible(bundle, col_arr, contrib, plan), seed
+            k_oracle = _oracle_k(bundle, col_arr, contrib)
+            assert k >= k_oracle, (
+                f"seed {seed}: relax retired {k} < oracle {k_oracle}")
+        # the generator leaves real slack: the rung must ship the clear
+        # majority of rounds or the fast path is decorative
+        assert ships >= int(FUZZ_SEEDS * 0.6), (ships, fallbacks)
+
+    def test_stats_account_every_round(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        before = dict(relax.RELAX_STATS)
+        rng = np.random.default_rng(3)
+        bundle, cands, col_arr, contrib, cum = _mk_bundle(rng)
+        plan, _ = relax.joint_relax_plan(
+            bundle, cands, col_arr, contrib, cum, {})
+        after = relax.RELAX_STATS
+        assert after["attempts"] == before["attempts"] + 1
+        delta = (after["ships"] - before["ships"]) + (
+            after["fallbacks"] - before["fallbacks"])
+        assert delta == 1, "every attempt ships or pins a fallback"
+        assert after["kernel_ms"] > before["kernel_ms"]
+        assert after["last_iters"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the fallback matrix: every non-ship cause, forced deterministically
+# ---------------------------------------------------------------------------
+
+
+class TestFallbackMatrix:
+    def test_inexpressible_claim_accounting(self, monkeypatch):
+        """Unprovable claimability with pending pods riding the demand:
+        the LP declines before assembling a single tensor."""
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        bundle = SimpleNamespace(
+            base=np.ones(1, np.int64), snap=SimpleNamespace(G=1),
+            claimable_groups=lambda: None)
+        plan, cause = relax.joint_relax_plan(
+            bundle, [object(), object()], None, None, None, {})
+        assert plan is None and cause == "inexpressible"
+        assert relax.RELAX_STATS["last_fallback"] == "inexpressible"
+
+    def test_iteration_cap(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        monkeypatch.setenv("KARPENTER_RELAX_MAX_ITERS", "16")
+        monkeypatch.setenv("KARPENTER_RELAX_TOL", "1e-12")
+        rng = np.random.default_rng(0)
+        bundle, cands, col_arr, contrib, cum = _mk_bundle(rng)
+        plan, cause = relax.joint_relax_plan(
+            bundle, cands, col_arr, contrib, cum, {})
+        assert plan is None and cause == "iteration-cap"
+        assert relax.RELAX_STATS["last_fallback"] == "iteration-cap"
+        assert relax.RELAX_STATS["last_iters"] == 16
+
+    def test_lp_no_retirement(self, monkeypatch):
+        """A zero-slack fleet: the LP's optimum keeps every node — the
+        rung declines rather than rounding a sub-2 prefix."""
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        rng = np.random.default_rng(1)
+        bundle, cands, col_arr, contrib, cum = _mk_bundle(rng)
+        bundle.esnap.e_avail = np.zeros_like(bundle.esnap.e_avail)
+        bundle.snap.T = 0
+        plan, cause = relax.joint_relax_plan(
+            bundle, cands, col_arr, contrib, cum, {})
+        assert plan is None and cause == "lp-no-retirement"
+        assert relax.RELAX_STATS["last_k_ub"] < 2
+
+    def test_price_gate(self, monkeypatch):
+        """Every feasible prefix needs the fresh claim, and an unknown
+        candidate price fails the shared criterion: the round falls to
+        the ladder as price-gate, before any host materialization."""
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        G, E, N = 1, 4, 2
+        demand = np.array([[4.0, 16.0]])
+        e_avail = np.zeros((E, 2))
+        e_avail[0] = e_avail[1] = [8.0, 32.0]  # candidates: 2 pods free
+        nodes = [SimpleNamespace(
+            state_node=SimpleNamespace(provider_id=f"n{e}"))
+            for e in range(E)]
+        snap = SimpleNamespace(
+            G=G, T=1, resources=("cpu", "mem"), g_demand=demand,
+            t_alloc=np.array([[16.0, 64.0]]),
+            m_overhead=np.array([[0.0, 0.0]]),
+            t_tmpl=np.zeros(1, np.intp))
+        esnap = SimpleNamespace(
+            E=E, e_avail=e_avail, live=np.ones(E, bool),
+            ge_ok=np.ones((G, E), bool), nodes=nodes)
+        col_arr = np.array([0, 1], np.int64)
+        contrib = np.array([[2.0], [2.0]])
+        bundle = SimpleNamespace(
+            snap=snap, esnap=esnap, base=np.zeros(G, np.int64),
+            claimable_groups=lambda: np.ones(G, bool),
+            generation=1, max_minv=0,
+            type_price_vectors=lambda: (np.array([1.0]), {"xl": 0}))
+        cands = [SimpleNamespace(  # price unknown -> prefix_known False
+            price=0.0, instance_type=SimpleNamespace(name="xl"))
+            for _ in range(N)]
+        plan, cause = relax.joint_relax_plan(
+            bundle, cands, col_arr, contrib, np.cumsum(contrib, 0), {})
+        assert plan is None and cause == "price-gate"
+        assert relax.RELAX_STATS["last_fallback"] == "price-gate"
+
+    def test_non_convergence_when_oracle_refuses(self, monkeypatch):
+        """Every window prefix the flags accept must still materialize
+        through the exact oracle; blanket refusal pins non-convergence."""
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        monkeypatch.setattr(cons, "_greedy_displace",
+                            lambda *a, **k: None)
+        rng = np.random.default_rng(2)
+        bundle, cands, col_arr, contrib, cum = _mk_bundle(rng)
+        plan, cause = relax.joint_relax_plan(
+            bundle, cands, col_arr, contrib, cum, {})
+        assert plan is None and cause == "non-convergence"
+        assert relax.RELAX_STATS["last_fallback"] == "non-convergence"
+
+
+# ---------------------------------------------------------------------------
+# provisioning bin floor: weak-duality certificate vs the FFD oracle
+# ---------------------------------------------------------------------------
+
+
+def _ffd_bins(demand, counts, alloc_eff):
+    """Plain first-fit-decreasing over one node shape: the integral
+    oracle the certified floor must never exceed."""
+    bins: list = []
+    order = np.argsort(-demand.sum(1), kind="stable")
+    for g in order:
+        for _ in range(int(counts[g])):
+            d = demand[g]
+            for i, b in enumerate(bins):
+                if np.all(d <= b + 1e-9):
+                    bins[i] = b - d
+                    break
+            else:
+                bins.append(alloc_eff - d)
+    return len(bins)
+
+
+class TestBinFloor:
+    def _snap(self, rng, G=5):
+        demand = np.stack(
+            [rng.uniform(1.0, 6.0, G), rng.uniform(2.0, 16.0, G)], axis=1)
+        return SimpleNamespace(
+            G=G, T=1, resources=("cpu", "mem"), g_demand=demand,
+            g_count=rng.integers(1, 7, G).astype(np.int64),
+            t_alloc=np.array([[16.0, 64.0]]),
+            m_overhead=np.array([[0.0, 0.0]]),
+            t_tmpl=np.zeros(1, np.intp))
+
+    def test_floor_never_exceeds_ffd_oracle(self, monkeypatch):
+        """Weak duality: the projected-dual bound is a true lower bound,
+        so it can never exceed ANY integral packing's bin count — the
+        FFD oracle's included. 50 seeded workloads."""
+        monkeypatch.setenv("KARPENTER_RELAX", "1")
+        monkeypatch.setattr(
+            cons, "_group_type_compat",
+            lambda snap, gsel=None: np.ones((snap.G, snap.T), bool))
+        for seed in range(50):
+            rng = np.random.default_rng(1000 + seed)
+            snap = self._snap(rng)
+            floor = relax.lp_bin_floor(snap, 0)
+            bins = _ffd_bins(snap.g_demand, snap.g_count,
+                             snap.t_alloc[0])
+            assert 0 <= floor <= bins, (seed, floor, bins)
+
+    def test_floor_tightens_loose_estimates(self, monkeypatch):
+        """On a single-resource-dominant workload the LP floor equals
+        the fractional packing bound — strictly above an estimate of 0."""
+        monkeypatch.setenv("KARPENTER_RELAX", "1")
+        monkeypatch.setattr(
+            cons, "_group_type_compat",
+            lambda snap, gsel=None: np.ones((snap.G, snap.T), bool))
+        snap = SimpleNamespace(
+            G=2, T=1, resources=("cpu", "mem"),
+            g_demand=np.array([[8.0, 8.0], [8.0, 8.0]]),
+            g_count=np.array([4, 4], np.int64),
+            t_alloc=np.array([[16.0, 64.0]]),
+            m_overhead=np.array([[0.0, 0.0]]),
+            t_tmpl=np.zeros(1, np.intp))
+        # 8 pods x 8cpu on 16cpu nodes: fractional floor = 4 bins
+        floor = relax.lp_bin_floor(snap, 0)
+        assert floor == 4
+        assert relax.lp_bin_floor(snap, 7) == 7  # never lowers est
+
+    def test_kill_switch_passthrough(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_RELAX", "0")
+        calls0 = relax.RELAX_STATS["floor_calls"]
+        snap = SimpleNamespace(G=4, T=1, resources=("cpu", "mem"))
+        assert relax.lp_bin_floor(snap, 5) == 5
+        assert relax.RELAX_STATS["floor_calls"] == calls0
+
+
+# ---------------------------------------------------------------------------
+# GL501: every relax knob fingerprints the kernel caches
+# ---------------------------------------------------------------------------
+
+
+class TestKnobFingerprints:
+    def test_joint_kernel_key_carries_knobs(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_RELAX_RHO", "1.0")
+        _, k1 = relax._get_joint_kernel(8, 16, 8, 2)
+        monkeypatch.setenv("KARPENTER_RELAX_RHO", "2.0")
+        _, k2 = relax._get_joint_kernel(8, 16, 8, 2)
+        assert k1 != k2
+        monkeypatch.setenv("KARPENTER_RELAX_MAX_ITERS", "64")
+        _, k3 = relax._get_joint_kernel(8, 16, 8, 2)
+        assert k3 != k2
+        monkeypatch.setenv("KARPENTER_RELAX_TOL", "1e-2")
+        _, k4 = relax._get_joint_kernel(8, 16, 8, 2)
+        assert k4 != k3
+
+    def test_window_knob_bounds_descent(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_RELAX_ROUND_WINDOWS", "3")
+        assert relax._relax_round_windows() == 3
+        monkeypatch.setenv("KARPENTER_RELAX_ROUND_WINDOWS", "0")
+        assert relax._relax_round_windows() == 1  # clamped to >= 1
+
+    def test_tri_state_enable(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_RELAX", "1")
+        assert relax.relax_enabled()
+        monkeypatch.setenv("KARPENTER_RELAX", "0")
+        assert not relax.relax_enabled()
+
+
+# ---------------------------------------------------------------------------
+# integration: the real joint path — ledger verdicts + capsule seam
+# ---------------------------------------------------------------------------
+
+
+def _real_env(n=8):
+    from tests.test_global_consolidation import build_env
+
+    return build_env(n)
+
+
+def _compute(env):
+    from tests.test_global_consolidation import compute_global
+
+    return compute_global(env)
+
+
+class TestRelaxLedger:
+    def test_relax_ship_records_verdict(self, monkeypatch):
+        from karpenter_tpu.obs import decisions
+
+        monkeypatch.setenv("KARPENTER_RELAX", "1")
+        env = _real_env(8)
+        c0 = decisions.counts()
+        cmd, method = _compute(env)
+        assert cmd is not None and len(cmd.candidates) >= 2
+        assert method.last_plan.solver == "relax"
+        c1 = decisions.counts()
+        shipped = sum(
+            c1.get(("consolidate.global", "joint", r), 0)
+            - c0.get(("consolidate.global", "joint", r), 0)
+            for r in ("relax", "relax-rounded"))
+        assert shipped == 1
+
+    def test_relax_fallback_records_verdict(self, monkeypatch):
+        from karpenter_tpu.obs import decisions
+
+        monkeypatch.setenv("KARPENTER_RELAX", "1")
+        monkeypatch.setenv("KARPENTER_RELAX_MAX_ITERS", "16")
+        monkeypatch.setenv("KARPENTER_RELAX_TOL", "1e-12")
+        env = _real_env(8)
+        c0 = decisions.counts()
+        cmd, method = _compute(env)
+        assert cmd is not None, "the ladder still ships the round"
+        assert method.last_plan.solver == "ladder"
+        assert method.last_plan.relax_fallback
+        c1 = decisions.counts()
+        key = ("consolidate.global", "joint", "relax-fallback")
+        assert c1.get(key, 0) - c0.get(key, 0) == 1
+
+
+class TestRelaxCapsule:
+    def test_relax_seam_replays_and_races_three_rungs(
+            self, tmp_path, monkeypatch):
+        """The relax.dispatch capture replays bit-identically and the
+        --ab table races relax vs the FFD ladder vs host-FFD on the ONE
+        capture, all three agreeing on this clean uniform fleet."""
+        from karpenter_tpu.obs import capsule
+
+        from karpenter_tpu.controllers.disruption.helpers import (
+            get_candidates,
+        )
+
+        monkeypatch.setenv("KARPENTER_RELAX", "1")
+        monkeypatch.setenv("KARPENTER_CAPSULE", "1")
+        capsule.reset()
+        env = _real_env(8)
+        d = env.disruption
+        candidates = get_candidates(d.cluster, d.store, d.cloud, d.clock,
+                                    queue=d.queue)
+        plan = cons.joint_retirement_plan(
+            d.provisioner, d.cluster, d.store, list(candidates))
+        assert plan is not None and plan.viable
+        assert plan.solver == "relax"
+        rec = capsule.last_capture()
+        assert rec is not None and rec["seam"] == "relax.dispatch"
+        path = capsule.write_capsule(
+            rec, path=str(tmp_path / "relax.capsule.npz"), why="forced")
+        cap = capsule.load(path)
+        rep = capsule.replay(cap)
+        assert rep["parity"] == "exact"
+        rows = {r["rung"]: r for r in capsule.ab_compare(cap)}
+        assert set(rows) == {"relax", "ladder", "host"}
+        k_dev = int(np.asarray(cap.outputs["k_sel"]))
+        assert k_dev >= 2
+        assert int(cap.static("k_shipped")) == len(plan.selected_idx)
+
+    def test_capture_off_leaves_no_pending(self, monkeypatch):
+        from karpenter_tpu.obs import capsule
+
+        monkeypatch.setenv("KARPENTER_RELAX", "1")
+        monkeypatch.setenv("KARPENTER_CAPSULE", "0")
+        capsule.reset()
+        env = _real_env(8)
+        cmd, method = _compute(env)
+        assert cmd is not None and method.last_plan.solver == "relax"
+        assert capsule.last_capture() is None
