@@ -19,7 +19,18 @@ marker-free batches keep the plain single solve). One ``solve_round``:
    (preempt.py: counterfactual batch → confirm-by-real-simulation →
    PDB-gated evictions + nomination — ``admission.preempt``).
 
+Since ISSUE 19 a gang-free round FUSES step 2's per-tier cascade into
+one device dispatch: the pack kernel's ``g_tier`` axis fences group
+order so lower bands pack into the capacity higher bands left behind —
+the cascade's residual handoff, device-resident (``_solve_fused``;
+parity pinned by tests/test_fused_round.py, rung ``fused`` on
+``admission.tier``). Gang rounds keep the cascade: each gang is its own
+atomic dispatch. Step 3's victim probes batch the same way
+(preempt.py ``probe_feasible_batch``). See deploy/README.md "Fused
+cluster round" for the dispatch-cadence and parity contracts.
+
 KARPENTER_ADMISSION=0 disables the whole plane (single-solve behavior);
+KARPENTER_FUSED_ROUND=0 restores the per-band dispatch cascade;
 KARPENTER_PREEMPTION=0 disables only the preemption ladder;
 KARPENTER_PREEMPT_MAX (16) bounds preemptors examined per round and
 KARPENTER_PREEMPT_CONFIRMS (4) confirming simulations per preemptor.
@@ -45,7 +56,7 @@ from karpenter_tpu.admission.priority import (
 )
 from karpenter_tpu.admission.residual import ClaimResidual
 from karpenter_tpu.api import labels as wk
-from karpenter_tpu.models.scheduler import SchedulerResults
+from karpenter_tpu.models.scheduler import NullTopology, SchedulerResults
 from karpenter_tpu.models.solver import HostSolver, TPUSolver
 from karpenter_tpu.obs import decisions
 from karpenter_tpu.utils.envknobs import env_bool as _env_bool
@@ -60,6 +71,15 @@ def _enabled() -> bool:
 
 def _preempt_enabled() -> bool:
     return _env_bool("KARPENTER_PREEMPTION", True)
+
+
+def _fused_enabled() -> bool:
+    """KARPENTER_FUSED_ROUND (default on): collapse consecutive gang-free
+    loose tiers into ONE device solve with the tier axis fencing residual
+    capacity on device (deploy/README.md "Fused cluster round").
+    KARPENTER_FUSED_ROUND=0 restores the per-tier cascade everywhere —
+    the parity oracle the seeded suite pins the fused path against."""
+    return _env_bool("KARPENTER_FUSED_ROUND", True)
 
 
 class _State:
@@ -117,11 +137,6 @@ class AdmissionPlane:
         tiers_loose = dict(partition_tiers(loose, prio_of))
         all_prios = sorted(set(tiers_loose) | set(gangs_by_prio),
                            reverse=True)
-        decisions.record_decision(
-            "admission.tier",
-            "cascade" if len(all_prios) > 1 else "single",
-            "ok" if len(all_prios) > 1 else "single-tier",
-            registry=self.registry)
 
         state = _State(topology, existing_nodes, [], fork_limits(limits))
         errors: dict = {}
@@ -134,20 +149,72 @@ class AdmissionPlane:
             # solver's last_device_stats only reflects its final call, so
             # the provisioner's accounting reads this instead
             "host_routed": {},
+            # solver.solve cadences this round paid (the fused round's
+            # headline: >=2 loose tiers collapse to 1; gangs/preempt pay
+            # their own) — perf surfaces this as dispatches_per_round
+            "solve_dispatches": 0,
+            "fused_runs": 0,
         }
         unplaced: list = []  # (priority, pod) after its tier's solve
+        # fused round (deploy/README.md "Fused cluster round"): a
+        # gang-free round's loose tiers collapse into ONE device dispatch
+        # with the tier axis fencing residual capacity on device.
+        # Gang-bearing rounds keep the cascade — each gang is its own
+        # atomic dispatch so the round can never reach one dispatch, and
+        # the fused scan's open-bin view of higher-tier residuals risks
+        # the ±1-bin FFD noise on the gang interleave for a one-dispatch
+        # saving; topology-bearing rounds keep the cascade (the waves
+        # path ignores tier_of); the host rung keeps the cascade (its
+        # FFD loop has no tier axis).
+        has_topology = bool(getattr(
+            topology, "has_groups",
+            topology is not None and not isinstance(topology, NullTopology)))
+        fuse = (_fused_enabled() and isinstance(solver, TPUSolver)
+                and not has_topology and not gangs_by_prio)
+        pending: list = []  # consecutive gang-free prios awaiting one solve
+
+        def flush():
+            if not pending:
+                return
+            run = {prio: list(tiers_loose[prio]) for prio in pending}
+            pending.clear()
+            if len(run) == 1:
+                ((prio, tier_pods),) = run.items()
+                missed = self._solve_tier(
+                    solver, tier_pods, state, templates, its,
+                    daemon_overhead, volume_topology, errors, report)
+                unplaced.extend((prio, p) for p in missed)
+            else:
+                report["fused_runs"] += 1
+                unplaced.extend(self._solve_fused(
+                    solver, run, state, templates, its, daemon_overhead,
+                    volume_topology, errors, report))
+
         for prio in all_prios:
-            for gang in gangs_by_prio.get(prio, ()):
+            gangs_here = gangs_by_prio.get(prio, ())
+            if gangs_here:
+                flush()
+            for gang in gangs_here:
                 self._solve_gang(solver, gang, state, templates, its,
                                  daemon_overhead, volume_topology, errors,
                                  report)
             tier_pods = tiers_loose.get(prio, ())
             if not tier_pods:
                 continue
+            if fuse:
+                pending.append(prio)
+                continue
             missed = self._solve_tier(
                 solver, list(tier_pods), state, templates, its,
                 daemon_overhead, volume_topology, errors, report)
             unplaced.extend((prio, p) for p in missed)
+        flush()
+        decisions.record_decision(
+            "admission.tier",
+            "fused" if report["fused_runs"]
+            else ("cascade" if len(all_prios) > 1 else "single"),
+            "ok" if len(all_prios) > 1 else "single-tier",
+            registry=self.registry)
 
         if unplaced and self.store is not None and _preempt_enabled():
             with obs.span("admission.preempt",
@@ -185,6 +252,7 @@ class AdmissionPlane:
         residuals = []
         if device_rung:
             residuals = [ClaimResidual(c) for c in state.claims]
+            report["solve_dispatches"] += 1
             res = solver.solve(
                 tier_pods, templates, its, topology=state.topology,
                 existing_nodes=list(state.enodes) + residuals,
@@ -235,6 +303,65 @@ class AdmissionPlane:
         placed = placed_uids(state.claims, state.enodes)
         return [p for p in tier_pods if p.uid not in placed]
 
+    # -- a fused run of gang-free tiers ----------------------------------
+    def _solve_fused(self, solver, run, state, templates, its,
+                     daemon_overhead, volume_topology, errors,
+                     report) -> list:
+        """All of ``run``'s tiers in ONE device dispatch: the tier axis
+        (``tensorize(..., tier_of=...)``) orders the scan tier-major, so
+        higher tiers consume shared and residual capacity first — the
+        fence the cascade paid one dispatch per tier for now happens on
+        device (deploy/README.md "Fused cluster round"). The mop-up of
+        refused residual commits stays a single host pass, re-admitting
+        tier-major so precedence survives there too. Returns the run's
+        unplaced pods as (priority, pod) for the preemption ladder."""
+        prios = sorted(run, reverse=True)
+        # dense ranks, higher priority -> higher tier rank; rank 0 is the
+        # lowest tier of THIS run, which is all the scan ordering needs
+        rank = {prio: len(prios) - 1 - i for i, prio in enumerate(prios)}
+        all_pods = [p for prio in prios for p in run[prio]]
+        tier_of = {p.uid: rank[prio]
+                   for prio in prios for p in run[prio]}
+        residuals = [ClaimResidual(c) for c in state.claims]
+        report["solve_dispatches"] += 1
+        res = solver.solve(
+            all_pods, templates, its, topology=state.topology,
+            existing_nodes=list(state.enodes) + residuals,
+            daemon_overhead=daemon_overhead,
+            limits=fork_limits(state.limits),
+            volume_topology=volume_topology,
+            tier_of=tier_of,
+        )
+        self._note_routed(solver, report)
+        new = [c for c in res.new_claims
+               if all(c is not r.claim for r in residuals)]
+        originals = {p.uid: p for p in all_pods}
+        mopup = []
+        for r in residuals:
+            mopup.extend(r.fold(originals))
+        if mopup:
+            # same fork/debit discipline as _solve_tier's mop-up, but the
+            # refused pods must queue tier-major or the host FFD would
+            # hand a low tier capacity a high tier was refused over
+            mopup.sort(key=lambda p: -tier_of.get(p.uid, 0))
+            res2 = HostSolver().solve(
+                mopup, templates, its, topology=state.topology,
+                existing_nodes=list(state.enodes),
+                daemon_overhead=daemon_overhead,
+                limits=debit_limits(fork_limits(state.limits), new),
+                initial_claims=state.claims + new,
+                volume_topology=volume_topology,
+            )
+            new.extend(c for c in res2.new_claims
+                       if all(c is not pc for pc in state.claims + new))
+            errors.update(res2.pod_errors)
+        state.claims.extend(new)
+        state.limits = debit_limits(state.limits, new)
+        errors.update(res.pod_errors)
+        placed = placed_uids(state.claims, state.enodes)
+        return [(prio, p) for prio in prios for p in run[prio]
+                if p.uid not in placed]
+
     # -- one gang ---------------------------------------------------------
     def _solve_gang(self, solver, gang, state, templates, its,
                     daemon_overhead, volume_topology, errors, report):
@@ -258,6 +385,7 @@ class AdmissionPlane:
         try:
             if device_rung:
                 residuals = [ClaimResidual(c) for c in f_claims]
+                report["solve_dispatches"] += 1
                 res = solver.solve(
                     clones, templates, its, topology=topo,
                     existing_nodes=f_enodes + residuals,
@@ -336,50 +464,102 @@ class AdmissionPlane:
         taken: set = set()
         max_preempts = _env_int("KARPENTER_PREEMPT_MAX", 16, minimum=0)
         max_confirms = _env_int("KARPENTER_PREEMPT_CONFIRMS", 4, minimum=1)
-        examined = 0
-        for prio, pod in sorted(unplaced, key=lambda t: -t[0]):
-            if examined >= max_preempts:
-                break
-            examined += 1
+        ladder = sorted(unplaced, key=lambda t: -t[0])[:max_preempts]
+        probes = self._batch_probe(ladder, prio_of, classes, state,
+                                   templates, its, daemon_overhead,
+                                   pdb_limits)
+        for prio, pod in ladder:
             outcome = self._preempt_one(
                 pod, prio_of, classes, state, templates, its,
                 daemon_overhead, pdb_limits, taken, max_confirms, errors,
-                report)
+                report, probe=probes.get(pod.uid))
             if self.registry is not None:
                 self.registry.counter(
                     m.ADMISSION_PREEMPTIONS,
                     "admission preemption ladder outcomes",
                 ).inc(outcome=outcome)
 
+    def _batch_probe(self, ladder, prio_of, classes, state, templates,
+                     its, daemon_overhead, pdb_limits) -> dict:
+        """ONE shared counterfactual dispatch for the whole preemption
+        ladder (the fused round's preemption leg): every examined
+        preemptor's candidate rows fold into one
+        ``dispatch_counterfactual_rows`` batch instead of one dispatch
+        per preemptor. Candidates are gathered taken-blind — the batch
+        cannot know which nodes earlier preemptors will win, and
+        ``taken`` only ever EXCLUDES nodes, so re-filtering at selection
+        time in ``_preempt_one`` is equivalent to the sequential gather.
+        Returns {pod uid: (candidates, feasible-list-or-None)}."""
+        pods = [pod for _, pod in ladder
+                if preemption_policy_of(pod, classes) != "Never"]
+        if not pods:
+            return {}
+        cand_lists = [
+            _preempt.victim_sets(pod, state.enodes, prio_of, classes,
+                                 pdb_limits, set())
+            for pod in pods]
+        feas_lists = None
+        if sum(1 for c in cand_lists if c) >= 2:
+            try:
+                feas_lists = _preempt.probe_feasible_batch(
+                    pods, cand_lists, templates, its,
+                    daemon_overhead=daemon_overhead)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "batched preemption probe failed; probing per "
+                    "preemptor", exc_info=True)
+        if feas_lists is None:
+            feas_lists = [None] * len(pods)
+        return {pod.uid: (cands, feas)
+                for pod, cands, feas in zip(pods, cand_lists, feas_lists)}
+
     def _preempt_one(self, pod, prio_of, classes, state, templates,
                      its, daemon_overhead, pdb_limits, taken, max_confirms,
-                     errors, report) -> str:
+                     errors, report, probe=None) -> str:
         if preemption_policy_of(pod, classes) == "Never":
             decisions.record_decision("admission.preempt", "skipped",
                                       "policy-never",
                                       registry=self.registry)
             return "skipped"
-        cands = _preempt.victim_sets(pod, state.enodes, prio_of, classes,
-                                     pdb_limits, taken)
+        feas = None
+        have_feas = False
+        if probe is not None:
+            cands, feas = probe
+            if feas is not None:
+                have_feas = True
+                kept = [(c, ok) for c, ok in zip(cands, feas)
+                        if c.node_name not in taken]
+                cands = [c for c, _ in kept]
+                feas = [ok for _, ok in kept]
+            else:
+                cands = [c for c in cands if c.node_name not in taken]
+        else:
+            cands = _preempt.victim_sets(pod, state.enodes, prio_of,
+                                         classes, pdb_limits, taken)
         if not cands:
             decisions.record_decision("admission.preempt", "skipped",
                                       "no-victims", registry=self.registry)
             return "skipped"
         probe_error = False
-        try:
-            feas = _preempt.probe_feasible(pod, cands, templates, its,
-                                           daemon_overhead=daemon_overhead)
-        except Exception:
-            import logging
+        if not have_feas:
+            try:
+                feas = _preempt.probe_feasible(
+                    pod, cands, templates, its,
+                    daemon_overhead=daemon_overhead)
+            except Exception:
+                import logging
 
-            logging.getLogger(__name__).warning(
-                "preemption probe failed; confirming sequentially",
-                exc_info=True)
-            # no verdict yet: the ladder records exactly ONE per examined
-            # preemptor — the probe-error cause rides a declining verdict
-            # below; a confirm that still lands records confirmed/ok
-            probe_error = True
-            feas = None
+                logging.getLogger(__name__).warning(
+                    "preemption probe failed; confirming sequentially",
+                    exc_info=True)
+                # no verdict yet: the ladder records exactly ONE per
+                # examined preemptor — the probe-error cause rides a
+                # declining verdict below; a confirm that still lands
+                # records confirmed/ok
+                probe_error = True
+                feas = None
         # probe misses stay misses (seeds are trusted negative only up to
         # the bounded confirm budget below); inexpressible probes confirm
         # the cheapest candidates directly — the reference-cost path

@@ -44,8 +44,8 @@ from karpenter_tpu.admission.priority import preemption_policy_of
 from karpenter_tpu.utils import pod as pod_util
 from karpenter_tpu.utils import resources as resutil
 
-__all__ = ["victim_sets", "probe_feasible", "confirm", "execute_evictions",
-           "PreemptionCandidate"]
+__all__ = ["victim_sets", "probe_feasible", "probe_feasible_batch",
+           "confirm", "execute_evictions", "PreemptionCandidate"]
 
 
 class PreemptionCandidate:
@@ -211,6 +211,100 @@ def probe_feasible(preemptor, candidates: list, templates, its,
             engine="device", max_minv=max_minv, Gp=Gp, Ep=Ep)
     return [bool(placed_g[i, 0] >= 1 and used[i] == 0)
             for i in range(rows)]
+
+
+def probe_feasible_batch(preemptors: list, cand_lists: list, templates,
+                         its, daemon_overhead=None) -> list | None:
+    """The whole eviction ladder's counterfactuals in ONE dispatch: every
+    (preemptor, candidate-node) pair becomes one row of the shared
+    ``dispatch_counterfactual_rows`` batch — the row releases that
+    candidate's victims on its own column and activates only that
+    preemptor's group in the count mask. Rows share one tensorized
+    snapshot over ALL preemptors and the union of their candidate nodes,
+    so a 16-preemptor round pays one kernel cadence instead of sixteen
+    (the fused cluster round's preemption leg — deploy/README.md).
+
+    Returns per-preemptor bool lists aligned with ``cand_lists``, or None
+    when the batch is inexpressible (the caller probes per preemptor)."""
+    from karpenter_tpu.obs import capsule as _capsule
+    from karpenter_tpu.ops.consolidate import (
+        _pow2,
+        dispatch_counterfactual_rows,
+    )
+    from karpenter_tpu.ops.tensorize import (
+        device_eligible,
+        kernel_args,
+        tensorize,
+        tensorize_existing,
+    )
+
+    pairs = [(j, c) for j, cands in enumerate(cand_lists) for c in cands]
+    if not pairs:
+        return [[] for _ in cand_lists]
+    if not all(device_eligible(p) for p in preemptors):
+        return None
+    try:
+        import jax  # noqa: F401
+    except Exception:
+        return None
+    snap = tensorize(list(preemptors), templates, its,
+                     daemon_overhead=daemon_overhead)
+    gidx = {p.uid: g for g, pods_g in enumerate(snap.groups)
+            for p in pods_g}
+    if any(p.uid not in gidx for p in preemptors):
+        return None
+    enodes, col_of = [], {}
+    for _, cand in pairs:
+        if id(cand.enode) not in col_of:
+            col_of[id(cand.enode)] = len(enodes)
+            enodes.append(cand.enode)
+    esnap = tensorize_existing(snap, enodes)
+    Gp = _pow2(snap.G)
+    Ep = _pow2(esnap.E)
+    Tp = _pow2(snap.T)
+    shared = kernel_args(snap, esnap, Gp=Gp, Tp=Tp, Ep=Ep,
+                         include_counts=False)
+    R = len(snap.resources)
+    rows = len(pairs)
+    g_count_k = np.zeros((rows, Gp), dtype=np.int32)
+    e_zero_cols = [None] * rows
+    e_free = []
+    free_col = np.empty(rows, dtype=np.int64)
+    free_delta = np.zeros((rows, R), dtype=np.float32)
+    for i, (j, cand) in enumerate(pairs):
+        g_count_k[i, gidx[preemptors[j].uid]] = 1
+        col = col_of[id(cand.enode)]
+        delta = np.zeros(R, dtype=np.float32)
+        for r, v in cand.release.items():
+            if r in snap.resources:
+                delta[snap.resources.index(r)] = v
+        e_free.append((col, delta))
+        free_col[i] = col
+        free_delta[i] = delta
+    max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
+    with obs.span("preempt.dispatch", rows=rows, kind="device",
+                  preemptors=len(preemptors)):
+        placed_g, used = dispatch_counterfactual_rows(
+            shared, Gp, Ep, esnap.e_avail, max_minv, g_count_k,
+            e_zero_cols, e_free=e_free)
+    if _capsule.capture_enabled():
+        inputs = dict(shared)
+        inputs[_capsule.CF_PREFIX + "g_count_rows"] = g_count_k
+        inputs[_capsule.CF_PREFIX + "e_avail"] = np.asarray(esnap.e_avail)
+        inputs[_capsule.CF_PREFIX + "e_zero_idx"] = np.zeros(0, np.int64)
+        inputs[_capsule.CF_PREFIX + "e_zero_len"] = np.full(
+            rows, -1, dtype=np.int64)
+        inputs[_capsule.CF_PREFIX + "e_free_col"] = free_col
+        inputs[_capsule.CF_PREFIX + "e_free_delta"] = free_delta
+        _capsule.record_capture(
+            "preempt.dispatch", inputs,
+            {"placed_g": placed_g, "used": used},
+            engine="device", max_minv=max_minv, Gp=Gp, Ep=Ep)
+    out = [[] for _ in cand_lists]
+    for i, (j, _) in enumerate(pairs):
+        g = gidx[preemptors[j].uid]
+        out[j].append(bool(placed_g[i, g] >= 1 and used[i] == 0))
+    return out
 
 
 def confirm(preemptor, candidate: PreemptionCandidate, topology) -> bool:

@@ -965,6 +965,20 @@ class GlobalConsolidation(Method):
                         selected=len(plan.selected), dropped=plan.dropped)
             self._verdict("ladder", "confirm-mismatch")
             return None
+        if plan.displacement:
+            # device-side rebinding lever (fused cluster round): hand the
+            # displacement plan's survivor targets to the binder so the
+            # post-command eviction wave re-binds hint-first instead of
+            # cold-scanning the fleet (kube/binder.py seed_wave_hints)
+            from karpenter_tpu.kube import binder as _binder
+
+            name_of = {n.provider_id: n.name
+                       for n in self.ctx.store.list("nodes")
+                       if n.provider_id}
+            _binder.seed_wave_hints(
+                (name_of[pid], take)
+                for pid, _g, take in plan.displacement
+                if pid in name_of and take > 0)
         if getattr(plan, "solver", "ladder") == "relax":
             # the LP relaxation rung selected the set (ops/relax.py):
             # relax = rounded at the LP bound, relax-rounded = the
@@ -977,6 +991,11 @@ class GlobalConsolidation(Method):
             # shipped — a command all the same, but the descent is
             # visible (RELAX_STATS carries the cause)
             self._verdict("joint", "relax-fallback")
+        elif getattr(plan, "n_claims", 1) > 1:
+            # the joint REPLACE program opened multiple fresh claims for
+            # one retirement set (KARPENTER_REPLACE_MAX_CLAIMS > 1) — a
+            # shape the m->1 delete-row rule would have stranded
+            self._verdict("joint", "replace")
         else:
             self._verdict("joint")
         return cmd

@@ -24,7 +24,29 @@ STATS = {
     "rebind_ms": 0.0,
     "passes": 0,
     "bound": 0,
+    "hinted": 0,  # binds landed via a consolidation wave hint
 }
+
+# Wave hints: node name -> displaced-pod count the consolidation round's
+# displacement plan routed there (ops/consolidate.py JointPlan
+# .displacement, seeded by the disruption controller post-confirm).
+# Evicted pods are deleted and re-created by the workload controller, so
+# hints key by TARGET NODE, not pod identity: the binder tries hinted
+# survivors first and lets ``_fits`` validate — a stale or wrong hint
+# falls through to the normal cursor scan, costing nothing but the one
+# check. Consumption is destructive (counts decrement per bind) so a
+# hint never outlives its wave. This is the device-side rebinding lever
+# of the fused cluster round (deploy/README.md "Fused cluster round").
+WAVE_HINTS: dict = {}
+
+
+def seed_wave_hints(entries) -> int:
+    """Merge ``(node_name, count)`` pairs into the wave-hint table;
+    returns the number of hinted slots now outstanding."""
+    for name, count in entries:
+        if count > 0:
+            WAVE_HINTS[name] = WAVE_HINTS.get(name, 0) + int(count)
+    return sum(WAVE_HINTS.values())
 
 
 def _shape_key(pod, pod_req) -> tuple:
@@ -46,6 +68,8 @@ def _shape_key(pod, pod_req) -> tuple:
 
 
 class Binder:
+    _hint_hit = None  # node the last successful _try_hints landed on
+
     def __init__(self, store, clock=None, registry=None):
         from karpenter_tpu.operator import metrics as _m
         from karpenter_tpu.utils.clock import Clock
@@ -99,6 +123,34 @@ class Binder:
         STATS["bound"] += progressed
         return progressed
 
+    def _try_hints(self, pod, nodes, available, node_view, pod_req,
+                   pod_reqs) -> bool:
+        """Hint-first placement: try the CURRENT head of the wave-hint
+        table before the cursor scan — at most one extra ``_fits`` check
+        per pod, so a wave of wrong hints can never cost more than one
+        probe each (the cursor scan below stays the ground truth and
+        keeps its O(pods + nodes)-per-shape bound). A hit consumes one
+        hinted slot (destructive); a miss rotates the head to the back so
+        one cold node cannot shadow the rest of the wave's hints."""
+        while WAVE_HINTS:
+            hname = next(iter(WAVE_HINTS))
+            hnode = nodes.get(hname)
+            if hnode is None:
+                del WAVE_HINTS[hname]  # node retired mid-wave: hint dead
+                continue
+            if self._fits(pod, hnode, available, node_view, pod_req,
+                          pod_reqs):
+                WAVE_HINTS[hname] -= 1
+                if WAVE_HINTS[hname] <= 0:
+                    del WAVE_HINTS[hname]
+                STATS["hinted"] += 1
+                self._hint_hit = hnode
+                return True
+            # rotate: re-insert at the back (dicts preserve order)
+            WAVE_HINTS[hname] = WAVE_HINTS.pop(hname)
+            return False
+        return False
+
     def _bind(self, pending: list) -> int:
         from karpenter_tpu import obs
 
@@ -139,6 +191,10 @@ class Binder:
                     pod, nominated, available, node_view, pod_req, pod_reqs):
                 placed = True
                 node = nominated
+            elif WAVE_HINTS and self._try_hints(
+                    pod, nodes, available, node_view, pod_req, pod_reqs):
+                placed = True
+                node = self._hint_hit
             else:
                 key = _shape_key(pod, pod_req)
                 start = cursor.get(key, 0)

@@ -332,6 +332,7 @@ class TPUSolver(Solver):
         max_bins: int | None = None,
         volume_topology=None,
         existing_base=None,
+        tier_of=None,
     ) -> SchedulerResults:
         has_topology = bool(getattr(topology, "has_groups", topology is not None and not isinstance(topology, NullTopology)))
         host_cutoff = 0
@@ -465,7 +466,9 @@ class TPUSolver(Solver):
                 )
             t0 = time.perf_counter()
             snap = tensorize(
-                eligible, templates, instance_types, daemon_overhead=daemon_overhead, limits=limits
+                eligible, templates, instance_types,
+                daemon_overhead=daemon_overhead, limits=limits,
+                tier_of=tier_of,
             )
             stages["tensorize_ms"] = (time.perf_counter() - t0) * 1000.0
             device_plan = None
@@ -666,9 +669,15 @@ class TPUSolver(Solver):
             if 0 < pcap < 1 << 18:
                 level_bits = max(4, int(np.ceil(np.log2(2 * pcap + 4))))
         max_minv = int(snap.m_minv.max()) if snap.m_minv.size else 0
+        # n_tiers rides the ledger key as a pseudo-static dim: the tier
+        # axis is data (same executable either way), but a fused multi-tier
+        # solve that lands in a fresh shape family must be ATTRIBUTED to
+        # the tier axis in the compile ledger, not read as unexplained
+        # churn (deploy/README.md "Fused cluster round")
         base_key = (Gp, Tp, K, W, R, M, snap.off_zone.shape[1],
                     snap.g_decl.shape[1], snap.g_sown.shape[1],
-                    snap.g_aneed.shape[1], Ep if esnap is not None else 0)
+                    snap.g_aneed.shape[1], Ep if esnap is not None else 0,
+                    snap.n_tiers)
         compat_cache: dict = {}
         bin_cap = min(total_pods, 4096)
         pull = None
@@ -739,6 +748,13 @@ class TPUSolver(Solver):
                 stages["decode_ms"] = stages.get("decode_ms", 0.0) + (
                     time.perf_counter() - t0) * 1000.0
             if retry and grow:
+                # device bin-axis growth: the doubled re-run keeps axis
+                # exhaustion on the device instead of spilling the
+                # remainder to the host loop — counted so perf rows can
+                # surface bin_growth_events per round
+                devplane.record_bin_growth()
+                if stages is not None:
+                    stages["bin_growths"] = stages.get("bin_growths", 0) + 1
                 B, Bp = B2, Bp2
                 continue
             if floor is not None and floor > 0 and claims and not retry:
@@ -1307,6 +1323,12 @@ class TPUSolver(Solver):
             if not no_limits:
                 rem_limits[m] -= tcap[ok].max(axis=0)
             claim._gcounts = gcounts  # for the solver's topology commit
+            if snap.g_tier is not None and gcounts:
+                # tier of the bin's OPENING group (the first group index
+                # with pods here — group order IS scan order, and a bin is
+                # first used at its opening step), so the fused admission
+                # round can charge each claim to the tier that opened it
+                claim._tier = int(snap.g_tier[gcounts[0][0]])
             claims.append(claim)
         # pods the kernel couldn't place (unsched counts are implied by the
         # unconsumed remainder of each group)

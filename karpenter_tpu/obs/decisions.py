@@ -170,13 +170,16 @@ SITES = {
         # attempted and declined, the FFD ladder shipped the round
         # (RELAX_STATS pins the cause). All three ship a command at the
         # best rung, so like "ok" they stay armed rather than benign.
+        # replace = the joint REPLACE program (multi-claim rows,
+        # KARPENTER_REPLACE_MAX_CLAIMS>1) shipped a retirement the m->1
+        # delete rows would have stranded — armed, same stance as relax.
         "rungs": ("joint", "ladder", "sequential"),
         "reasons": frozenset({
             "ok", "no-retirement", "non-definitive", "confirm-mismatch",
             "repair-bound", "topology-plan", "inexpressible",
             "probe-error", "no-device", "disabled", "too-few-candidates",
             "joint-noop-fenced", "relax", "relax-rounded",
-            "relax-fallback", OTHER_REASON,
+            "relax-fallback", "replace", OTHER_REASON,
         }),
         "benign": frozenset({
             "no-retirement", "non-definitive", "topology-plan", "disabled",
@@ -249,11 +252,15 @@ SITES = {
         }),
     },
     "admission.tier": {
-        # admission/plane.py: a live batch with priority markers ran the
-        # tiered cascade, or collapsed to the plain single solve. The tier
-        # count is workload-driven, so every reason is benign — the site
-        # exists for the mix, not the regression detector.
-        "rungs": ("cascade", "single"),
+        # admission/plane.py: a live batch with priority markers collapsed
+        # its gang-free tiers into ONE device dispatch with on-device tier
+        # fencing (fused — deploy/README.md "Fused cluster round"), ran
+        # the per-tier cascade (host rung, gang-interleaved, or
+        # KARPENTER_FUSED_ROUND=0), or collapsed to the plain single
+        # solve. The tier count is workload-driven, so every reason is
+        # benign — the site exists for the mix, not the regression
+        # detector.
+        "rungs": ("fused", "cascade", "single"),
         "reasons": frozenset({
             "ok", "single-tier", "disabled", OTHER_REASON,
         }),

@@ -54,6 +54,7 @@ __all__ = [
     "LEDGER",
     "SloTracker",
     "STATS",
+    "record_bin_growth",
     "record_dispatch",
     "record_padding",
     "record_shard_balance",
@@ -87,6 +88,10 @@ STATS = {
     # max/mean hybrid shard weight of the most recent partition plan
     # (1.0 = perfectly balanced; the ROADMAP's next mesh lever)
     "shard_balance_ratio": 0.0,
+    # on-device doublings of the solve's merged bin axis (the solver's
+    # doubled re-run) — the fused-round lever that keeps axis exhaustion
+    # off the host repair path; perf rows surface it as bin_growth_events
+    "bin_growths": 0,
 }
 _STATS_LOCK = threading.Lock()
 
@@ -231,6 +236,15 @@ def record_padding(site: str, actual, padded, registry=None) -> float:
         buckets=_m.PAD_WASTE_BUCKETS,
     ).observe(ratio, site=site)
     return ratio
+
+
+def record_bin_growth() -> None:
+    """One on-device doubling of a solve's merged bin axis (the doubled
+    re-run in models/solver.py ``_run_and_decode``): the estimated axis
+    ran dry and growth stayed on the device instead of routing the
+    remainder through the host loop."""
+    with _STATS_LOCK:
+        STATS["bin_growths"] += 1
 
 
 def record_shard_overlap(seconds: float, registry=None) -> None:
@@ -497,5 +511,5 @@ def reset():
             cold_compiles=0, compile_ms=0.0, warm_dispatches=0,
             pad_dispatches=0, pad_cells_actual=0.0, pad_cells_padded=0.0,
             shard_overlap_ms=0.0, shard_repair_pods=0, shard_fallbacks=0,
-            shard_balance_ratio=0.0,
+            shard_balance_ratio=0.0, bin_growths=0,
         )

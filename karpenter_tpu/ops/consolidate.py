@@ -18,7 +18,12 @@ encodes the m→1 replacement rule (consolidation.go:164): a counterfactual
 whose pods don't fit into the surviving nodes plus ONE fresh claim simply
 leaves pods unassigned and is infeasible. Probe hits then get the real
 confirming simulation (price filter, validation) — a handful of device
-dispatches replacing the sequential ladders.
+dispatches replacing the sequential ladders. Since ISSUE 19 the rule
+generalizes to the joint REPLACE program: ``max_bins`` threads through
+the dispatch seam, ``_claims_fit`` splits a set's overflow across up to
+``KARPENTER_REPLACE_MAX_CLAIMS`` fresh claims (default 1 keeps m→1),
+and a confirmed multi-claim plan records the ``replace`` verdict — see
+deploy/README.md "Fused cluster round".
 
 Topology-bearing clusters ride the probes too: the waves compiler
 (ops/waves.py) turns the batch's spread/affinity/anti constraints into the
@@ -626,14 +631,23 @@ class DisruptionSnapshot:
                 if row is not None and esnap.live[row]:
                     removed.append(pid)
                 self.col_by_pid.pop(pid, None)
-        churn = len(dirty_nodes) + len(removed) + len(added_nodes)
+        # removals are cheap in-place masks (no row rebuild, no splice),
+        # so an eviction wave's drained nodes never count against the
+        # delta budget — only rows that must actually re-tensorize do.
+        # Counting removals here used to force a full rebuild once per
+        # drain wave, exactly the 0.6 s the fused round reclaims
+        # (deploy/README.md "Fused cluster round").
+        churn = len(dirty_nodes) + len(added_nodes)
         if churn > max(16, esnap.E // 2):
             self.advance_refusal = "churn"
             return False  # a wave: rebuilding also re-compacts the E axis
+        t_delta = time.perf_counter()
         esnap.apply_delta(
             self.snap, dirty=dirty_nodes, removed=removed, added=added_nodes,
             registry=registry,
         )
+        GLOBAL_STATS["tensorize_delta_ms"] += (
+            time.perf_counter() - t_delta) * 1000.0
         # formulation rows ride the delta too: exactly the touched rows
         # recompute on next gather, every other row is reused verbatim
         self._contrib_invalidate(
@@ -756,10 +770,14 @@ class DisruptionSnapshot:
             self._dims = (Gp, Ep)
         return self._shared, self._dims
 
-    def dispatch(self, g_count_k, e_zero_cols, seam="probe.dispatch"):
+    def dispatch(self, g_count_k, e_zero_cols, seam="probe.dispatch",
+                 max_bins=1):
         """Run the batched pack kernel over the counterfactual rows; returns
         (placed_g, used) — per-row PER-GROUP placed-pod counts (shape
-        [rows, Gp]) and per-row fresh-claim counts. ``seam`` names the
+        [rows, Gp]) and per-row fresh-claim counts. ``max_bins`` caps how
+        many fresh claims a row may open: 1 is the reference's m->1 rule;
+        the joint REPLACE program passes ``_replace_max_claims()``.
+        ``seam`` names the
         replay-capture seam the dispatch records under (the per-candidate
         probes use ``probe.dispatch``; the global joint ladder records the
         same tensor layout under ``global.dispatch`` so an anomalous joint
@@ -782,7 +800,8 @@ class DisruptionSnapshot:
         note_probe_dispatch(self.generation)
         if self._native_routable():
             try:
-                return self._dispatch_native(g_count_k, e_zero_cols, seam)
+                return self._dispatch_native(g_count_k, e_zero_cols, seam,
+                                             max_bins=max_bins)
             except Exception:
                 import logging
 
@@ -794,7 +813,7 @@ class DisruptionSnapshot:
         with obs.span("probe.dispatch", rows=rows, engine="device"):
             placed_g, used = dispatch_counterfactual_rows(
                 shared, Gp, Ep, self.esnap.e_avail, self.max_minv,
-                g_count_k, e_zero_cols)
+                g_count_k, e_zero_cols, max_bins=max_bins)
         self._capture(shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
                       used, "device", seam)
         return placed_g, used
@@ -851,7 +870,7 @@ class DisruptionSnapshot:
             return False
 
     def _dispatch_native(self, g_count_k, e_zero_cols,
-                         seam="probe.dispatch"):
+                         seam="probe.dispatch", max_bins=1):
         """One native call per chunk (ROADMAP's open lever closed): the C++
         engine builds feasibility once per chunk and packs every
         counterfactual row in-process, returning only the per-row
@@ -862,14 +881,15 @@ class DisruptionSnapshot:
         with obs.span("probe.dispatch", rows=rows, engine="native"):
             placed_g, used = dispatch_counterfactual_rows_native(
                 shared, Gp, Ep, self.esnap.e_avail, self.max_minv,
-                g_count_k, e_zero_cols)
+                g_count_k, e_zero_cols, max_bins=max_bins)
         self._capture(shared, Gp, Ep, g_count_k, e_zero_cols, placed_g,
                       used, "native", seam)
         return placed_g, used
 
 
 def dispatch_counterfactual_rows(shared, Gp, Ep, e_avail, max_minv,
-                                 g_count_k, e_zero_cols, e_free=None):
+                                 g_count_k, e_zero_cols, e_free=None,
+                                 max_bins=1):
     """The XLA probe dispatch over EXPLICIT tensors: chunked at
     PROBE_CHUNK_ROWS, the chunk axis padded on the pow-2 ladder, each
     chunk one vmapped device call. ONE body shared by
@@ -910,18 +930,19 @@ def dispatch_counterfactual_rows(shared, Gp, Ep, e_avail, max_minv,
         # dispatch + host pull in one device-kind leaf: the probe
         # kernel is synchronous-by-consumption (np.asarray blocks)
         with obs.span("probe.kernel", kind="device", rows=n):
-            kfn = _batched_kernel(1, max_minv)
+            kfn = _batched_kernel(max_bins, max_minv)
             t0 = time.perf_counter()
             out_placed, out_used = kfn(varying, shared)
             # first sight of this (row axis, snapshot shapes)
             # family paid its XLA compile inside the call above;
             # the key mirrors the solver's base_key dims — R and
             # the mask widths change the compiled program even
-            # when the padded axes do not
+            # when the padded axes do not (max_bins: the REPLACE
+            # row shape is its own compiled family)
             devplane.record_dispatch(
                 "probe.kernel",
                 (Np, shared["g_mask"].shape, shared["t_mask"].shape,
-                 Ep, R, max_minv),
+                 Ep, R, max_minv, max_bins),
                 time.perf_counter() - t0)
             placed_g[lo:hi] = np.asarray(out_placed)[:n]
             used[lo:hi] = np.asarray(out_used)[:n]
@@ -929,7 +950,8 @@ def dispatch_counterfactual_rows(shared, Gp, Ep, e_avail, max_minv,
 
 
 def dispatch_counterfactual_rows_native(shared, Gp, Ep, e_avail, max_minv,
-                                        g_count_k, e_zero_cols, e_free=None):
+                                        g_count_k, e_zero_cols, e_free=None,
+                                        max_bins=1):
     """The native-engine half of :func:`dispatch_counterfactual_rows` —
     same chunking, same counterfactual materialization (zeroed columns,
     then per-row ``e_free`` releases), the C++ batched probe entry per
@@ -960,7 +982,7 @@ def dispatch_counterfactual_rows_native(shared, Gp, Ep, e_avail, max_minv,
                     (n, Gp)),
                 pad(e_chunk.astype(np.float32, copy=False),
                     (n, Ep, R)),
-                1,
+                max_bins,
             )
         placed_g[lo:hi] = pg
         used[lo:hi] = u
@@ -1434,7 +1456,29 @@ def _prefix_criterion(bundle, candidates, cum, placed_g, used):
     feasible = (placed_g[:, :G] >= required).all(axis=1)
     prefix_known, claim_ok = _prefix_price_ok(bundle, candidates)
     feasible &= (used == 0) | (prefix_known & claim_ok)
+    if _replace_max_claims() > 1:
+        # joint REPLACE rows (max_bins>1): a prefix opening u>1 fresh
+        # claims must still beat its retirement credit with u claims of
+        # the cheapest admissible offering — a relaxed seed only; the
+        # host rounding pass re-verifies the chosen split in exact
+        # arithmetic and the confirming simulation owns the command
+        credit = _prefix_credit(candidates)
+        min_p = float(getattr(bundle, "min_price", 0.0) or 0.0)
+        feasible &= (used <= 1) | (
+            (min_p > 0) & (used.astype(np.float64) * min_p < credit))
     return feasible, base_exempt_ok
+
+
+def _prefix_credit(candidates) -> np.ndarray:
+    """[N] f64 — cumulative retirement credit of each prefix: summed
+    candidate prices, discounted by ``KARPENTER_TIER_WEIGHT x`` the
+    displaced priority mass (w=0 leaves the raw price sum)."""
+    prices = np.array(
+        [getattr(c, "price", 0.0) for c in candidates], dtype=np.float64)
+    w = _tier_weight()
+    if w > 0.0:
+        prices = prices - w * _tier_mass(candidates)
+    return np.cumsum(prices)
 
 
 def _prefix_price_ok(bundle, candidates):
@@ -1453,6 +1497,15 @@ def _prefix_price_ok(bundle, candidates):
     )
     prefix_known = np.logical_and.accumulate(prices > 0)
     prefix_price = np.cumsum(prices)
+    w = _tier_weight()
+    if w > 0.0:
+        # tier-weighted criterion (KARPENTER_TIER_WEIGHT): the credit a
+        # prefix earns by retiring nodes shrinks by w x the priority
+        # mass its evictions displace, so a replace only ships when the
+        # offering beats the DISCOUNTED credit. w=0 is bit-identical
+        # (parity-pinned like the LP rung's lambda=0); shared here so
+        # the relax rung (ops/relax.py) can never drift from the ladder
+        prefix_price = np.cumsum(prices - w * _tier_mass(candidates))
     tp = getattr(bundle, "type_price_vectors", None)
     p_cat, name_idx = (tp() if tp is not None
                        else _type_price_vectors(bundle.snap))
@@ -1553,7 +1606,49 @@ GLOBAL_STATS = {
     # hoisted out of formulate_ms by the controller's prewarm — ISSUE-14
     # schema note in deploy/README.md "Global consolidation"
     "bundle_ms": 0.0,
+    # incremental re-tensorization wall across eviction waves: time spent
+    # INSIDE ExistingSnapshot.apply_delta when SnapshotCache.advance kept
+    # delta-advancing instead of rebuilding (the fused round's ~0.6 s
+    # host lever — deploy/README.md "Fused cluster round")
+    "tensorize_delta_ms": 0.0,
 }
+
+
+def _replace_max_claims() -> int:
+    """KARPENTER_REPLACE_MAX_CLAIMS (default 1): how many fresh claims a
+    joint retirement row may open — the REPLACE generalization of the
+    reference's m->1 rule (consolidation.go:164). At 1 the program is
+    bit-identical to the m->1 ladder; r>1 lets the joint selection keep
+    prefixes whose displaced pods need several replacement nodes (shapes
+    the m->1 rule strands), with the host rounding pass splitting the
+    overflow across at most r single-template claims and the confirming
+    simulation still owning the shipped command."""
+    from karpenter_tpu.utils.envknobs import env_int
+
+    return env_int("KARPENTER_REPLACE_MAX_CLAIMS", 1, minimum=1)
+
+
+def _tier_weight() -> float:
+    """KARPENTER_TIER_WEIGHT (default 0): discount each candidate's
+    retirement credit by ``w x`` the priority mass its eviction displaces
+    (the tier-weighted ``_prefix_criterion`` — higher-tier pods make
+    their node proportionally less attractive to retire). 0 is
+    bit-identical to the unweighted criterion, parity-pinned exactly
+    like the LP rung's lambda=0."""
+    from karpenter_tpu.utils.envknobs import env_float
+
+    return env_float("KARPENTER_TIER_WEIGHT", 0.0)
+
+
+def _tier_mass(candidates) -> np.ndarray:
+    """[N] f64 — summed effective priority of each candidate's
+    reschedulable pods (the displaced tier mass the weighted criterion
+    charges against its price credit)."""
+    return np.array(
+        [sum((getattr(p, "priority", 0) or 0)
+             for p in getattr(c, "reschedulable_pods", ()) or ())
+         for c in candidates],
+        dtype=np.float64)
 
 
 def _global_repair_bound() -> int:
@@ -1573,10 +1668,10 @@ class JointPlan:
 
     def __init__(self, candidates, selected_idx=(), delete_only=True,
                  definitive=False, displacement=(), overflow=None,
-                 k_device=0, dropped=0, timings=None, viable=True,
-                 reason="ok", prefix_feasible=None, single_mask=None,
-                 generation=None, transient=False, solver="ladder",
-                 relax_fallback=False):
+                 n_claims=1, k_device=0, dropped=0, timings=None,
+                 viable=True, reason="ok", prefix_feasible=None,
+                 single_mask=None, generation=None, transient=False,
+                 solver="ladder", relax_fallback=False):
         self._candidates = list(candidates)
         self.selected_idx = list(selected_idx)
         self.delete_only = delete_only
@@ -1584,9 +1679,13 @@ class JointPlan:
         # [(provider_id, group_index, pod_count)] — where each displaced
         # pod group lands among the survivors (exact-arithmetic integral)
         self.displacement = list(displacement)
-        # {group_index: pod_count} headed for the ONE fresh claim (empty
+        # {group_index: pod_count} headed for the fresh claim(s) (empty
         # on delete-only plans)
         self.overflow = dict(overflow or {})
+        # fresh claims the displacement plan opens: 1 is the reference's
+        # m->1 rule; >1 marks a joint REPLACE command
+        # (KARPENTER_REPLACE_MAX_CLAIMS — ledger reason "replace")
+        self.n_claims = n_claims
         self.k_device = k_device  # the device ladder's pre-repair k
         self.dropped = dropped  # candidates shed by the repair pass
         self.timings = dict(timings or {})
@@ -1768,7 +1867,8 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
 
     with obs.span("global.dispatch", rows=rows_total, singles=singles):
         placed_g, used = bundle.dispatch(g_count_k, e_zero_cols,
-                                         seam="global.dispatch")
+                                         seam="global.dispatch",
+                                         max_bins=_replace_max_claims())
     t2 = time.perf_counter()
 
     single_mask = None
@@ -1826,7 +1926,7 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
         return JointPlan(candidates, definitive=definitive, k_device=k,
                          dropped=dropped, timings=timings, viable=False,
                          reason="repair-bound", **seed_kw)
-    placements, overflow = plan
+    placements, overflow, n_claims = plan
     return JointPlan(
         candidates,
         selected_idx=range(k_final),
@@ -1834,6 +1934,7 @@ def joint_retirement_plan(provisioner, cluster, store, candidates,
         definitive=definitive,
         displacement=placements,
         overflow=overflow,
+        n_claims=n_claims,
         k_device=k,
         dropped=dropped,
         timings=timings,
@@ -1881,7 +1982,8 @@ def _round_repair(bundle, col_arr, contrib, k, used, feasible):
         surv[col_arr[:k_cur]] = False
         required = contrib[:k_cur].sum(axis=0) + base_req
         plan = _greedy_displace(
-            bundle, surv, required, allow_claim=bool(used[k_cur - 1] > 0))
+            bundle, surv, required, allow_claim=bool(used[k_cur - 1] > 0),
+            max_claims=_replace_max_claims())
         if plan is not None:
             return k_cur, plan, k - k_cur
         if attempts >= budget:
@@ -1892,13 +1994,15 @@ def _round_repair(bundle, col_arr, contrib, k, used, feasible):
     return k_cur, None, k - k_cur
 
 
-def _greedy_displace(bundle, surv, required, allow_claim):
+def _greedy_displace(bundle, surv, required, allow_claim, max_claims=1):
     """Exact-arithmetic displacement plan for one retirement set: place
     each group's required pods into surviving nodes' residual capacity
     (ge_ok-compatible, biggest-demand groups first, fullest-fitting nodes
     first — the FFD stance of the mesh repair pass), route any remainder
-    to the ONE fresh claim when the ladder row allowed it. Returns
-    ``(placements, overflow)`` or ``None`` when the set does not round
+    to at most ``max_claims`` fresh claims when the ladder row allowed it
+    (1 — the default — is the reference's m->1 rule; the joint REPLACE
+    program passes ``_replace_max_claims()``). Returns ``(placements,
+    overflow, n_claims)`` or ``None`` when the set does not round
     integrally (the caller repairs by shrinking it).
 
     Residual capacity + ``ge_ok`` is the COMPLETE constraint set here:
@@ -1945,9 +2049,68 @@ def _greedy_displace(bundle, surv, required, allow_claim):
             if not allow_claim:
                 return None
             overflow[int(g)] = overflow.get(int(g), 0) + n
-    if overflow and not _one_claim_fits(snap, overflow):
+    if not overflow:
+        return placements, overflow, 0
+    if max_claims <= 1:
+        if not _one_claim_fits(snap, overflow):
+            return None
+        return placements, overflow, 1
+    split = _claims_fit(snap, overflow, max_claims)
+    if split is None:
         return None
-    return placements, overflow
+    return placements, overflow, len(split)
+
+
+def _claims_fit(snap, overflow, max_claims):
+    """The REPLACE generalization of :func:`_one_claim_fits`: greedily
+    split the overflow pods across at most ``max_claims`` fresh
+    single-template claims — groups biggest-demand first, first-fit over
+    already-open claims (largest addable count by binary search, the
+    aggregate-fit check monotone in count), a fresh claim only when no
+    open one takes a single pod. Returns the per-claim
+    ``{group: count}`` dicts, or None when even ``max_claims`` claims
+    cannot carry the overflow (the caller sheds candidates instead).
+    Same safe direction as the single-claim check: an over-estimate here
+    is caught by the confirming simulation; an under-estimate only
+    sheds one more candidate than strictly needed."""
+    claims: list = []
+    order = sorted(overflow,
+                   key=lambda g: -float(snap.g_demand[g].sum()))
+    for g in order:
+        n = int(overflow[g])
+        while n > 0:
+            placed = False
+            for claim in claims:
+                lo, hi, take = 1, n, 0
+                while lo <= hi:
+                    mid = (lo + hi) // 2
+                    trial = dict(claim)
+                    trial[g] = trial.get(g, 0) + mid
+                    if _one_claim_fits(snap, trial):
+                        take, lo = mid, mid + 1
+                    else:
+                        hi = mid - 1
+                if take:
+                    claim[g] = claim.get(g, 0) + take
+                    n -= take
+                    placed = True
+                    break
+            if placed:
+                continue
+            if len(claims) >= max_claims:
+                return None
+            lo, hi, take = 1, n, 0
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                if _one_claim_fits(snap, {g: mid}):
+                    take, lo = mid, mid + 1
+                else:
+                    hi = mid - 1
+            if take == 0:
+                return None  # a pod no single fresh node can carry
+            claims.append({g: take})
+            n -= take
+    return claims
 
 
 _REPAIR_EPS = 1e-9

@@ -177,6 +177,8 @@ def pack(
     g_smatch,  # [G,C] bool: the spread class counts this group's pods
     g_aneed,  # [G,A] bool: hostname-affinity classes the group owns
     g_amatch,  # [G,A] bool: the affinity-class selector matches this group
+    g_tier,  # [G] i32: priority tier (scan arrives tier-major — the order
+    # IS the fence: lower tiers only ever see residual capacity)
     # existing/in-flight nodes as pre-loaded bins (existingnode.go:64)
     ge_ok,  # [G,E] bool: group admissible on node (taints + strict labels)
     e_avail,  # [E,R] f32: fixed available capacity (allocatable - usage)
@@ -255,6 +257,10 @@ def pack(
         bmatch=jnp.zeros((B, CW), dtype=jnp.uint32),
         bscnt=jnp.zeros((B, C), dtype=jnp.int32),
         baff=jnp.zeros((B, A), dtype=jnp.int32),
+        # tier of the group that OPENED the bin — pure observability for
+        # the fused admission round (which tier each claim charges to);
+        # it never gates packing, the tier-major scan order is the fence
+        btier=jnp.zeros(B, dtype=jnp.int32),
     )
     if with_existing:
         state.update(
@@ -268,7 +274,7 @@ def pack(
 
     def step(state, xs):
         (d, n, gm, gh, Fg, tfull, cap_g, single, decl_g, match_g,
-         sown_g, smatch_g, aneed_g, amatch_g, ge_g) = xs
+         sown_g, smatch_g, aneed_g, amatch_g, tier_g, ge_g) = xs
         any_aneed = jnp.any(aneed_g)
         has_pods = n > 0
         owned = sown_g < SPREAD_OWNED_MIN  # [C]
@@ -489,6 +495,7 @@ def pack(
         bmask3 = jnp.where(sel[:, None, None], nm[None, :, :], bmask2)
         bhas3 = jnp.where(sel[:, None], nh[None, :], bhas2)
         btmpl3 = jnp.where(sel, m_star, state["btmpl"])
+        btier3 = jnp.where(sel, tier_g, state["btier"])
 
         # ---- nodepool limits: subtract worst-case capacity per new bin ----
         n_opened = jnp.sum(sel.astype(jnp.float32))
@@ -522,6 +529,7 @@ def pack(
             bmatch=bmatch3,
             bscnt=bscnt3,
             baff=baff3,
+            btier=btier3,
         )
         if with_existing:
             new_state.update(
@@ -531,7 +539,7 @@ def pack(
         return new_state, (take + pods_new, take_e)
 
     xs = (g_demand, g_count, g_mask, g_has, F, tmpl_full, g_bin_cap, g_single,
-          g_decl, g_match, g_sown, g_smatch, g_aneed, g_amatch, ge_ok)
+          g_decl, g_match, g_sown, g_smatch, g_aneed, g_amatch, g_tier, ge_ok)
     state, (assign, assign_e) = jax.lax.scan(step, state, xs)
     return dict(
         assign=assign,  # [G,B] (scan stacks per-step [B] outputs)
@@ -540,6 +548,7 @@ def pack(
         npods=state["npods"],
         types=state["types"],
         tmpl=state["btmpl"],
+        tier=state["btier"],  # [B] tier of the bin's opening group
     )
 
 
@@ -602,6 +611,8 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
         args["g_aneed"] = jnp.zeros((G, A), dtype=bool)
     if "g_amatch" not in args:
         args["g_amatch"] = jnp.zeros((G, args["g_aneed"].shape[1]), dtype=bool)
+    if "g_tier" not in args:
+        args["g_tier"] = jnp.zeros(G, dtype=jnp.int32)
     # existing-node tensors default to one inert node (zero capacity);
     # when the caller supplied none, phase A is compiled out entirely
     C = args["g_sown"].shape[1]
@@ -646,6 +657,7 @@ def solve_step(args: dict, max_bins: int, with_existing: bool | None = None,
         args["g_demand"], args["g_count"], args["g_mask"], args["g_has"], F, tmpl_full,
         args["g_bin_cap"], args["g_single"], args["g_decl"], args["g_match"],
         args["g_sown"], args["g_smatch"], args["g_aneed"], args["g_amatch"],
+        args["g_tier"],
         args["ge_ok"], args["e_avail"], args["e_npods"], args["e_scnt"],
         args["e_decl"], args["e_match"], args["e_aff"],
         args["t_alloc"], args["t_cap"], args["t_tmpl"], args["m_mask"], args["m_has"],

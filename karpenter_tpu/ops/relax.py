@@ -562,7 +562,8 @@ def joint_relax_plan(bundle, candidates, col_arr, contrib, cum,
             required = base_req.copy()
             required[:G] += contrib[:k, :G].sum(axis=0)
             plan = _cons._greedy_displace(
-                bundle, surv, required, allow_claim=claim_used)
+                bundle, surv, required, allow_claim=claim_used,
+                max_claims=_cons._replace_max_claims())
             if plan is not None:
                 chosen = (k, plan, claim_used)
                 break
@@ -577,7 +578,7 @@ def joint_relax_plan(bundle, candidates, col_arr, contrib, cum,
                    prefix_known, claim_ok, order)
     if chosen is None:
         return None, cause
-    k_final, (placements, overflow), _ = chosen
+    k_final, (placements, overflow, n_claims), _ = chosen
     dropped = max(k_ub - k_final, 0)
     RELAX_STATS["ships"] += 1
     RELAX_STATS["rounded_drops"] += dropped
@@ -590,6 +591,7 @@ def joint_relax_plan(bundle, candidates, col_arr, contrib, cum,
         definitive=True,
         displacement=placements,
         overflow=overflow,
+        n_claims=n_claims,
         k_device=k_ub,
         dropped=dropped,
         timings=timings,

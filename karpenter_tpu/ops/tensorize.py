@@ -185,7 +185,8 @@ def pad_to(a: np.ndarray, shape: tuple, fill=0) -> np.ndarray:
 GROUP_AXIS_KEYS = frozenset({
     "g_mask", "g_has", "g_tol", "g_demand", "g_count", "g_zone_allowed",
     "g_ct_allowed", "g_tmpl_ok", "g_bin_cap", "g_single", "g_decl",
-    "g_match", "g_sown", "g_smatch", "g_aneed", "g_amatch", "ge_ok",
+    "g_match", "g_sown", "g_smatch", "g_aneed", "g_amatch", "g_tier",
+    "ge_ok",
 })
 
 
@@ -304,6 +305,14 @@ class DeviceSnapshot:
     # suite rides this; /introspect-style diagnostics read the signal
     # without re-walking the catalog)
     off_risk: np.ndarray | None = None
+    # priority-tier axis (fused cluster round, deploy/README.md "Fused
+    # cluster round"): per-group tier rank in the scan's fencing order —
+    # HIGHER tier packs FIRST, so lower tiers only ever see residual
+    # capacity, replacing the admission plane's re-tensorize-per-tier
+    # cascade with one dispatch. None/1 means single-tier (every solve
+    # before the fused round, and every consolidation probe).
+    g_tier: np.ndarray | None = None  # [G] i32
+    n_tiers: int = 1
 
     @property
     def G(self):
@@ -659,6 +668,11 @@ def kernel_args(snap: DeviceSnapshot, esnap: "ExistingSnapshot | None" = None,
         g_smatch=pad(snap.g_smatch, (Gp, snap.g_smatch.shape[1])),
         g_aneed=pad(snap.g_aneed, (Gp, snap.g_aneed.shape[1])),
         g_amatch=pad(snap.g_amatch, (Gp, snap.g_amatch.shape[1])),
+        g_tier=pad(
+            snap.g_tier if snap.g_tier is not None
+            else np.zeros(snap.G, dtype=np.int32),
+            (Gp,),
+        ),
         t_mask=pad(snap.t_mask, (Tp, K, W)),
         t_has=pad(snap.t_has, (Tp, K)),
         t_tol=pad(snap.t_tol, (Tp, K)),
@@ -1189,6 +1203,7 @@ def tensorize(
     daemon_overhead=None,
     limits=None,
     device_plan=None,
+    tier_of=None,
 ):
     """Compile a scheduling snapshot to tensors.
 
@@ -1201,15 +1216,20 @@ def tensorize(
     device_plan: pre-compiled waves.WavesPlan (topology-compiled subgroups
         with extra requirements / bin caps / conflict classes), groups
         already in the order the scan should process them
+    tier_of: pod uid -> priority-tier rank (higher = packs first). Splits
+        signature groups per tier and orders the scan tier-major so the
+        fused admission round fences tiers on device (deploy/README.md
+        "Fused cluster round"). Ignored when device_plan is given — the
+        topology path keeps the host cascade.
     """
     with obs.span("tensorize.build", kind="cache",
                   plan=device_plan is not None):
         return _tensorize(pods, templates, instance_types_by_pool,
-                          daemon_overhead, limits, device_plan)
+                          daemon_overhead, limits, device_plan, tier_of)
 
 
 def _tensorize(pods, templates, instance_types_by_pool, daemon_overhead,
-               limits, device_plan):
+               limits, device_plan, tier_of=None):
     daemon_overhead = daemon_overhead or {}
     limits = limits or {}
 
@@ -1240,19 +1260,48 @@ def _tensorize(pods, templates, instance_types_by_pool, daemon_overhead,
         g_decl, g_match = device_plan.class_masks()
         g_sown, g_smatch = device_plan.spread_tensors()
         g_aneed, g_amatch = device_plan.aff_tensors()
+        g_tier_list = [0] * len(groups)
     else:
         # ---- group pods by signature, FFD order ----
         # the signature is cached on the pod object: the provisioner
         # re-solves the same (immutable-spec) Pod instances round after
         # round; clones (which relaxation/injection mutate) are fresh
         # objects without the cached attribute
+        base_groups = group_by_signature(pods)
+        if tier_of:
+            # sub-split each signature group by priority tier: the scan IS
+            # the fence (tier-major order below), so pods of one spec but
+            # different tiers must occupy distinct rows to pack in their
+            # tier's turn. Same-signature rows share a row_key — the row
+            # cache content is tier-independent, so sharing stays sound.
+            n_base = len(base_groups)
+            split = []
+            for g in base_groups:
+                by_tier: dict = {}
+                for p in g:
+                    by_tier.setdefault(tier_of.get(p.uid, 0), []).append(p)
+                split.extend(by_tier.values())
+            base_groups = split
+            # tier-axis pad-waste site: group rows that exist ONLY for
+            # tier fencing (the split's inflation) are the axis's extra
+            # scan cost — recorded so a fused-round row-count blowup is
+            # attributed to the tier axis, not read as organic G growth
+            from karpenter_tpu.obs import devplane as _devplane
+
+            _devplane.record_padding("solve.tiers", n_base, len(split))
+
+        def _tier(g):
+            return tier_of.get(g[0].uid, 0) if tier_of else 0
+
         groups = sorted(
-            group_by_signature(pods),
+            base_groups,
             key=lambda g: (
+                -_tier(g),
                 -g[0].effective_requests().get(resutil.CPU, 0.0),
                 -g[0].effective_requests().get(resutil.MEMORY, 0.0),
             ),
         )
+        g_tier_list = [_tier(g) for g in groups]
         group_reqs = [pod_requirements(g[0]) for g in groups]
         # group_by_signature cached the signature on every rep
         row_keys = [(g[0].__dict__["_sig_cache"], ()) for g in groups]
@@ -1311,6 +1360,8 @@ def _tensorize(pods, templates, instance_types_by_pool, daemon_overhead,
     g_ct_allowed = np.ones((G, max(len(ct_vocab), 1)), dtype=bool)
     g_bin_cap = np.asarray(g_bin_cap_list, dtype=np.int32).reshape(G)
     g_single = np.asarray(g_single_list, dtype=bool).reshape(G)
+    g_tier = np.asarray(g_tier_list, dtype=np.int32).reshape(G)
+    n_tiers = int(g_tier.max()) + 1 if G else 1
 
     # signature-keyed row cache: the packed requirement rows are a pure
     # function of (pod signature, waves extra reqs) GIVEN this type-side
@@ -1412,6 +1463,8 @@ def _tensorize(pods, templates, instance_types_by_pool, daemon_overhead,
         m_overhead=m_overhead,
         m_limits=m_limits,
         off_risk=ts["off_risk"],
+        g_tier=g_tier,
+        n_tiers=n_tiers,
     )
     # decoder fast-path state: per-group signature keys plus the type-side
     # entry's persistent compat cache. Entries are pure functions of

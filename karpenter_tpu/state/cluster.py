@@ -27,9 +27,20 @@ _anon_counter = itertools.count(1)
 # journal capacity: must cover every informer event between two disruption
 # snapshot reads or the consumer sees a gap and rebuilds from scratch. A
 # 1000-node consolidation wave generates ~4-5k events (pod deletes +
-# recreates + binds + node/claim deletes), so 16k leaves real headroom
-# while bounding memory to one deque of small tuples.
-DELTA_JOURNAL_CAP = 16384
+# recreates + binds + node/claim deletes) and a multi-round 2000-node
+# convergence ~5k per ROUND — a 16k cap aged out mid-convergence and
+# forced exactly the full re-tensorization the delta path exists to
+# avoid (the fused round's tensorize lever; bench.py gates the wave at
+# zero gap-rebuilds), so the default covers several such waves while
+# still bounding memory to one deque of small tuples (~6 MB worst case).
+DELTA_JOURNAL_CAP = 65536
+
+
+def _journal_cap() -> int:
+    from karpenter_tpu.utils.envknobs import env_int
+
+    return env_int("KARPENTER_DELTA_JOURNAL_CAP", DELTA_JOURNAL_CAP,
+                   minimum=1024)
 
 
 def delta_to_wire(delta):
@@ -113,7 +124,7 @@ class Cluster:
         # delta is ("node", provider_id), ("pod", pod, node_name|None, gone)
         # or None (opaque: the consumer must rebuild from scratch).
         self._delta_journal: collections.deque = collections.deque(
-            maxlen=DELTA_JOURNAL_CAP
+            maxlen=_journal_cap()
         )
         # per-nodepool scheduling fingerprint (ISSUE 14): the counter
         # controller rewrites status.resources after every node wave, and

@@ -174,7 +174,8 @@ class TestBatchedConsolidation:
             "g_mask", "g_has", "g_tol", "g_demand", "g_count",
             "g_zone_allowed", "g_ct_allowed", "g_tmpl_ok", "g_bin_cap",
             "g_single", "g_decl", "g_match", "g_sown", "g_smatch",
-            "g_aneed", "g_amatch", "ge_ok", "e_avail", "e_npods", "e_scnt",
+            "g_aneed", "g_amatch", "g_tier",
+            "ge_ok", "e_avail", "e_npods", "e_scnt",
             "e_decl", "e_match", "e_aff", "t_mask", "t_has", "t_tol",
             "t_alloc", "t_cap", "t_tmpl", "off_zone", "off_ct", "off_avail",
             "off_price", "m_mask", "m_has", "m_tol", "m_overhead",

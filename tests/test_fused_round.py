@@ -1,0 +1,351 @@
+"""Fused cluster round (deploy/README.md "Fused cluster round").
+
+Covers the tentpole contracts this PR introduces:
+- the seeded parity suite: fused one-dispatch admission vs the tiered
+  cascade across 120 gang-free mixes — placed-pod sets and error sets
+  IDENTICAL, claim count within the measured ±1-bin FFD envelope (the
+  cascade models higher-tier claims as residual e-rows, the fused scan
+  sees them as in-scan open bins: bit-identical claim COMPOSITION is
+  structurally unreachable, so the pin is set equality + the bin bound);
+- the one-dispatch cadence: ≥2 gang-free loose tiers pay exactly one
+  solver.solve, the ledger records the "fused" rung, gang-bearing and
+  knob-off rounds keep the cascade;
+- device-side tier fencing: the high tier owns constrained capacity;
+- the batched preemption probe: probe_feasible_batch over every
+  (preemptor, candidate) pair in ONE dispatch ≡ per-preemptor
+  probe_feasible;
+- the joint REPLACE splitter: _claims_fit respects max_claims, degrades
+  to the m->1 rule, and _greedy_displace's triple return stays
+  bit-compatible at max_claims=1;
+- the binder's wave hints: hint-first binding consumes destructively,
+  validates via _fits, and falls through on a wrong hint.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_tpu.admission import AdmissionPlane
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.nodepool import NodePool
+from karpenter_tpu.api.objects import ObjectMeta, Pod, PriorityClass
+from karpenter_tpu.cloudprovider.catalog import (
+    benchmark_catalog,
+    make_instance_type,
+)
+from karpenter_tpu.controllers.provisioning.provisioner import collect_domains
+from karpenter_tpu.kube import binder as binder_mod
+from karpenter_tpu.models import ClaimTemplate
+from karpenter_tpu.models.solver import TPUSolver
+from karpenter_tpu.models.topology import Topology
+from karpenter_tpu.obs import decisions
+
+GIB = 2**30
+
+
+def _pc(name, value, default=False, policy=""):
+    return PriorityClass(metadata=ObjectMeta(name=name), value=value,
+                         global_default=default, preemption_policy=policy)
+
+
+def _pod(name, cpu=1.0, mem=2.0, **kw):
+    return Pod(metadata=ObjectMeta(name=name, labels=kw.pop("labels", {}),
+                                   annotations=kw.pop("annotations", {})),
+               requests={"cpu": cpu, "memory": mem * GIB}, **kw)
+
+
+def _inputs(pods, catalog, pools=None):
+    pools = pools or [NodePool(metadata=ObjectMeta(name="default"))]
+    templates = [ClaimTemplate(p) for p in pools]
+    its = {p.name: catalog for p in pools}
+    domains: dict = {}
+    for t in templates:
+        collect_domains(domains, t, catalog)
+    return templates, its, Topology(domains=domains, pods=pods)
+
+
+def _loose_mix(seed: int):
+    """A gang-free seeded mix (the fused round's scope — gangs keep the
+    cascade) with enough tier spread that most seeds fuse ≥2 tiers."""
+    r = random.Random(seed)
+    catalog = benchmark_catalog(r.choice((4, 8, 12)))
+    pods = []
+    for i in range(r.randint(8, 28)):
+        p = _pod(f"f{seed}-{i}", cpu=r.choice((0.25, 0.5, 1.0, 2.0)),
+                 mem=r.choice((0.5, 1.0, 2.0)))
+        p.priority = r.choice((0, 0, 100, 1000, 5000))
+        pods.append(p)
+    return pods, catalog
+
+
+def _placed_uids(res) -> set:
+    out = {p.uid for c in res.new_claims for p in c.pods}
+    for n in res.existing_nodes:
+        out.update(p.uid for p in getattr(n, "scheduled_pods", []) or [])
+    return out
+
+
+def _solve(pods, catalog, fused: bool, monkeypatch):
+    monkeypatch.setenv("KARPENTER_FUSED_ROUND", "1" if fused else "0")
+    templates, its, topo = _inputs(pods, catalog)
+    plane = AdmissionPlane()
+    return plane.solve_round(TPUSolver(), [p.clone() for p in pods],
+                             templates, its, topology=topo)
+
+
+# ---------------------------------------------------------------------------
+# seeded parity: fused one-dispatch round vs the tiered cascade
+# ---------------------------------------------------------------------------
+
+class TestFusedCascadeParity:
+    def test_seeded_parity_120_mixes(self, monkeypatch):
+        """The parity contract (measured over 200 seeds before pinning):
+        placed-pod sets and error sets IDENTICAL on every seed; claim
+        count within ±1 bin per seed (FFD noise from the residual-rows vs
+        open-bins modeling difference) and net drift bounded suite-wide."""
+        net = 0
+        fused_rounds = 0
+        for seed in range(120):
+            pods, catalog = _loose_mix(seed)
+            res_f = _solve(pods, catalog, True, monkeypatch)
+            res_c = _solve(pods, catalog, False, monkeypatch)
+            assert _placed_uids(res_f) == _placed_uids(res_c), (
+                f"seed {seed}: placed sets diverged")
+            assert set(res_f.pod_errors) == set(res_c.pod_errors), (
+                f"seed {seed}: error sets diverged")
+            nf, nc = len(res_f.new_claims), len(res_c.new_claims)
+            assert nf <= nc + 1, (
+                f"seed {seed}: fused opened {nf} claims vs cascade {nc}")
+            net += nf - nc
+            fused_rounds += res_f.admission.get("fused_runs", 0)
+        # suite-wide: the ±1 noise must not trend (3/200 seeds paid +1 and
+        # one -1 when measured; a systematic regression reads as net>3)
+        assert net <= 3, f"fused claim-count drift: net {net:+d} bins"
+        assert fused_rounds >= 60, (
+            f"only {fused_rounds}/120 seeds fused — the gate is miswired")
+
+    def test_multi_tier_round_pays_one_dispatch(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_FUSED_ROUND", "1")
+        pods, catalog = _loose_mix(3)
+        dec0 = decisions.counts()
+        templates, its, topo = _inputs(pods, catalog)
+        res = AdmissionPlane().solve_round(TPUSolver(), pods, templates,
+                                           its, topology=topo)
+        adm = res.admission
+        assert adm["tiers"] >= 2
+        assert adm["solve_dispatches"] == 1
+        assert adm["fused_runs"] == 1
+        delta = decisions.rung_delta(dec0, decisions.counts())
+        assert delta.get("admission.tier", {}).get("fused", 0) == 1
+
+    def test_knob_off_keeps_cascade(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_FUSED_ROUND", "0")
+        pods, catalog = _loose_mix(3)
+        templates, its, topo = _inputs(pods, catalog)
+        res = AdmissionPlane().solve_round(TPUSolver(), pods, templates,
+                                           its, topology=topo)
+        adm = res.admission
+        assert adm["fused_runs"] == 0
+        assert adm["solve_dispatches"] == adm["tiers"]
+
+    def test_gang_rounds_keep_cascade(self, monkeypatch):
+        """Each gang is its own atomic dispatch, so a gang round can never
+        reach one dispatch — it must not fuse (and must not pay the
+        fused scan's ±1-bin noise on the interleave)."""
+        monkeypatch.setenv("KARPENTER_FUSED_ROUND", "1")
+        pods, catalog = _loose_mix(5)
+        ann = {wk.POD_GROUP_ANNOTATION: "g0"}
+        for i in range(3):
+            p = _pod(f"gang-{i}", cpu=1.0, mem=1.0, annotations=dict(ann))
+            p.priority = 1000
+            pods.append(p)
+        templates, its, topo = _inputs(pods, catalog)
+        res = AdmissionPlane().solve_round(TPUSolver(), pods, templates,
+                                           its, topology=topo)
+        assert res.admission["fused_runs"] == 0
+
+    def test_fused_tier_order_owns_constrained_capacity(self, monkeypatch):
+        """Device-side fencing: with one node's worth of limit-admissible
+        capacity, the fused solve gives the high tier the node and the
+        low tier carries every error — the cascade's answer, one
+        dispatch."""
+        monkeypatch.setenv("KARPENTER_FUSED_ROUND", "1")
+        catalog = [make_instance_type("xl", 8, 32)]
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pool.spec.limits = {"cpu": "8"}
+        pods = []
+        for i in range(8):
+            p = _pod(f"hi{i}", cpu=1.0, mem=1.0)
+            p.priority = 1000
+            pods.append(p)
+        for i in range(8):
+            p = _pod(f"lo{i}", cpu=1.0, mem=1.0)
+            p.priority = 0
+            pods.append(p)
+        templates, its, topo = _inputs(pods, catalog, [pool])
+        res = AdmissionPlane().solve_round(
+            TPUSolver(), pods, templates, its, topology=topo,
+            limits={"default": {"cpu": 8.0}})
+        placed = {p.name for c in res.new_claims for p in c.pods}
+        assert placed and all(n.startswith("hi") for n in placed)
+        assert sum(1 for k in res.pod_errors if "/lo" in k) == 8
+
+
+# ---------------------------------------------------------------------------
+# batched preemption probe
+# ---------------------------------------------------------------------------
+
+def _preempt_fleet(n_replicas=6):
+    from karpenter_tpu.api.objects import Deployment
+    from karpenter_tpu.operator import Environment
+
+    catalog = [make_instance_type("xl", 16, 64)]
+    env = Environment(instance_types=catalog)
+    env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+    env.create("priorityclasses", _pc("high", 10000), _pc("low", 0))
+    tpl = _pod("low-tpl", cpu=5.0, mem=8.0, priority_class_name="low",
+               labels={"app": "low"})
+    env.store.create("deployments", Deployment(
+        metadata=ObjectMeta(name="low"), replicas=n_replicas, template=tpl))
+    env.run_until_idle(max_rounds=300)
+    return env
+
+
+class TestBatchedPreemptProbe:
+    def test_batch_matches_per_preemptor_probe(self):
+        """ONE dispatch over every (preemptor, candidate) pair must
+        return exactly what the per-preemptor probes return — same
+        feasibility bits, same candidate order."""
+        from karpenter_tpu.admission import preempt as P
+        from karpenter_tpu.utils.pdb import PdbLimits
+
+        env = _preempt_fleet()
+        store = env.store
+        bound = [p for p in store.list("pods") if p.node_name]
+        classes = {pc.name: pc for pc in store.list("priorityclasses")}
+        prio_of = {p.uid: 0 for p in bound}
+        preemptors = []
+        for i in range(3):
+            hi = _pod(f"hi{i}", cpu=6.0, mem=4.0,
+                      priority_class_name="high")
+            prio_of[hi.uid] = 10000
+            preemptors.append(hi)
+        topo = Topology(domains={}, pods=preemptors)
+        enodes = env.provisioner._existing_nodes(
+            list(env.cluster.nodes()), topo)
+        pdb = PdbLimits(store)
+        cand_lists = [
+            P.victim_sets(hi, enodes, prio_of, classes, pdb, set())
+            for hi in preemptors
+        ]
+        assert any(cand_lists), "fleet produced no candidates"
+        templates, its, _, _, _ = env.provisioner.solver_inputs()
+        batch = P.probe_feasible_batch(preemptors, cand_lists,
+                                       templates, its)
+        assert batch is not None
+        for hi, cands, got in zip(preemptors, cand_lists, batch):
+            want = P.probe_feasible(hi, cands, templates, its)
+            assert want is not None
+            assert got == want, f"{hi.metadata.name}: {got} != {want}"
+
+    def test_empty_candidate_lists_short_circuit(self):
+        from karpenter_tpu.admission import preempt as P
+
+        assert P.probe_feasible_batch([], [], None, None) == []
+        hi = _pod("hi", cpu=1.0)
+        assert P.probe_feasible_batch([hi], [[]], None, None) == [[]]
+
+
+# ---------------------------------------------------------------------------
+# joint REPLACE splitter
+# ---------------------------------------------------------------------------
+
+class TestReplaceKnob:
+    def test_default_is_single_claim(self, monkeypatch):
+        from karpenter_tpu.ops import consolidate as cons
+
+        monkeypatch.delenv("KARPENTER_REPLACE_MAX_CLAIMS", raising=False)
+        assert cons._replace_max_claims() == 1
+
+    def test_knob_floor_is_one(self, monkeypatch):
+        from karpenter_tpu.ops import consolidate as cons
+
+        monkeypatch.setenv("KARPENTER_REPLACE_MAX_CLAIMS", "0")
+        assert cons._replace_max_claims() == 1
+        monkeypatch.setenv("KARPENTER_REPLACE_MAX_CLAIMS", "3")
+        assert cons._replace_max_claims() == 3
+
+    def test_tier_weight_default_off(self, monkeypatch):
+        from karpenter_tpu.ops import consolidate as cons
+
+        monkeypatch.delenv("KARPENTER_TIER_WEIGHT", raising=False)
+        assert cons._tier_weight() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# binder wave hints
+# ---------------------------------------------------------------------------
+
+class TestWaveHints:
+    def _env(self):
+        from karpenter_tpu.operator import Environment
+
+        catalog = [make_instance_type("m", 8, 32)]
+        env = Environment(instance_types=catalog)
+        env.create("nodepools", NodePool(metadata=ObjectMeta(name="default")))
+        for i in range(6):
+            env.store.create("pods", _pod(f"seed{i}", cpu=2.0, mem=2.0))
+        env.run_until_idle(max_rounds=200)
+        return env
+
+    def setup_method(self):
+        binder_mod.WAVE_HINTS.clear()
+
+    def teardown_method(self):
+        binder_mod.WAVE_HINTS.clear()
+
+    def test_hint_first_bind_consumes_destructively(self):
+        env = self._env()
+        nodes = [n for n in env.store.list("nodes") if n.ready]
+        assert nodes
+        target = nodes[-1]
+        before = binder_mod.STATS["hinted"]
+        binder_mod.seed_wave_hints([(target.name, 2)])
+        env.store.create("pods", _pod("w0", cpu=0.5, mem=0.5))
+        env.store.create("pods", _pod("w1", cpu=0.5, mem=0.5))
+        env.binder.bind_pending()
+        assert binder_mod.STATS["hinted"] - before == 2
+        assert binder_mod.WAVE_HINTS == {}  # both slots consumed
+        for name in ("w0", "w1"):
+            got = env.store.try_get("pods", name)
+            assert got is not None and got.node_name == target.name
+
+    def test_wrong_hint_falls_through_to_scan(self):
+        env = self._env()
+        binder_mod.seed_wave_hints([("no-such-node", 5)])
+        env.store.create("pods", _pod("w2", cpu=0.5, mem=0.5))
+        env.binder.bind_pending()
+        got = env.store.try_get("pods", "w2")
+        assert got is not None and got.node_name, (
+            "a dead hint must not strand the pod")
+        assert "no-such-node" not in binder_mod.WAVE_HINTS
+
+    def test_seed_ignores_nonpositive_counts(self):
+        binder_mod.seed_wave_hints([("a", 0), ("b", -3)])
+        assert binder_mod.WAVE_HINTS == {}
+
+
+# ---------------------------------------------------------------------------
+# ledger census riders
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_fused_rung_and_replace_reason_registered(self):
+        assert "fused" in decisions.SITES["admission.tier"]["rungs"]
+        assert "replace" in decisions.SITES["consolidate.global"]["reasons"]
+        # replace is ARMED (a shipped command, same stance as relax), so
+        # it must not sit in the benign set
+        assert "replace" not in decisions.SITES["consolidate.global"].get(
+            "benign", frozenset())

@@ -165,6 +165,162 @@ class TestRelaxFuzz:
 
 
 # ---------------------------------------------------------------------------
+# the joint REPLACE program (ISSUE 19): multi-claim displacement rows
+# ---------------------------------------------------------------------------
+
+
+def _compat_all(snap, gsel=None):
+    """All-compatible group×type mask, gsel-aware — the bundle double
+    carries no requirement tensors, so claim checks get the permissive
+    mask (the shapes are what the splitter exercises)."""
+    n = snap.G if gsel is None else len(gsel)
+    return np.ones((n, snap.T), bool)
+
+
+def _displace_inputs(bundle, col_arr, contrib, k):
+    surv = np.asarray(bundle.esnap.live, bool).copy()
+    surv[col_arr[:k]] = False
+    required = contrib[:k, : bundle.snap.G].sum(axis=0)
+    return surv, required
+
+
+def _frontier_k(bundle, col_arr, contrib, max_claims):
+    """Largest prefix the displacement oracle rounds with at most
+    ``max_claims`` fresh claims (descending scan, the ladder's shape)."""
+    for k in range(len(col_arr), 0, -1):
+        surv, required = _displace_inputs(bundle, col_arr, contrib, k)
+        if cons._greedy_displace(bundle, surv, required,
+                                 allow_claim=True,
+                                 max_claims=max_claims) is not None:
+            return k
+    return 0
+
+
+def _placements_feasible(bundle, surv, required, placements, overflow):
+    """Re-validate a displacement plan from first principles: survivors'
+    residual capacity covers every placement, and placements plus the
+    claim-routed overflow account for every displaced pod."""
+    demand = np.asarray(bundle.snap.g_demand, np.float64)
+    resid = np.maximum(np.asarray(bundle.esnap.e_avail, np.float64), 0.0)
+    resid[~surv] = 0.0
+    placed = np.zeros(bundle.snap.G, np.float64)
+    for pid, g, cnt in placements:
+        e = int(pid[1:])
+        assert surv[e], "placement landed on a retiree"
+        resid[e] -= cnt * demand[g]
+        placed[g] += cnt
+    for g, cnt in overflow.items():
+        placed[g] += cnt
+    return (resid >= -1e-6).all() and np.allclose(placed, required)
+
+
+class TestReplaceFuzz:
+    """The REPLACE generalization of the m->1 rule (ISSUE 19): overflow
+    splits across up to ``max_claims`` fresh claims via ``_claims_fit``.
+    Fuzzes the splitter against the single-claim contract it extends."""
+
+    def test_single_claim_path_bit_compatible(self, monkeypatch):
+        """max_claims=1 must reproduce the pre-REPLACE contract exactly:
+        placements/overflow identical under any cap (the placement phase
+        never consults it), viability == the one-claim aggregate-fit
+        rule, and the splitter never pays a second claim when one
+        suffices."""
+        monkeypatch.setattr(cons, "_group_type_compat", _compat_all)
+        exercised = 0
+        for seed in range(80):
+            rng = np.random.default_rng(20_000 + seed)
+            bundle, _, col_arr, contrib, _ = _mk_bundle(
+                rng, fill_lo=0.55, fill_hi=0.95)
+            # descend to the single-claim frontier: every refused k must
+            # also carry identical placements/overflow under cap 3
+            for k in range(len(col_arr), 0, -1):
+                surv, required = _displace_inputs(
+                    bundle, col_arr, contrib, k)
+                r1 = cons._greedy_displace(bundle, surv, required,
+                                           allow_claim=True, max_claims=1)
+                r3 = cons._greedy_displace(bundle, surv, required,
+                                           allow_claim=True, max_claims=3)
+                if r1 is None:
+                    continue  # one claim refused; the splitter may round
+                p1, o1, n1 = r1
+                assert r3 is not None, "raising the cap lost a feasible set"
+                p3, o3, n3 = r3
+                assert p1 == p3 and o1 == o3
+                if o1:
+                    exercised += 1
+                    assert n1 == 1
+                    assert cons._one_claim_fits(bundle.snap, o1)
+                    assert n3 == 1, "splitter paid a claim one node covers"
+                else:
+                    assert n1 == 0 and n3 == 0
+                break  # frontier reached: smaller prefixes add nothing
+        assert exercised >= 10, "generator never forced overflow"
+
+    def test_replace_extends_retirement_frontier(self, monkeypatch):
+        """Fuzz bar: the multi-claim frontier dominates the single-claim
+        one on every seed, strictly beats it on a healthy fraction, and
+        every shipped split is integrally feasible end to end — each
+        claim passes the aggregate-fit check, the claims jointly carry
+        exactly the overflow, and survivors cover the placements."""
+        monkeypatch.setattr(cons, "_group_type_compat", _compat_all)
+        strict = shipped_multi = 0
+        for seed in range(80):
+            rng = np.random.default_rng(30_000 + seed)
+            bundle, _, col_arr, contrib, _ = _mk_bundle(
+                rng, fill_lo=0.55, fill_hi=0.95)
+            k1 = _frontier_k(bundle, col_arr, contrib, 1)
+            k3 = _frontier_k(bundle, col_arr, contrib, 3)
+            assert k3 >= k1, (seed, k1, k3)
+            if k3 > k1:
+                strict += 1
+            if k3 == 0:
+                continue
+            surv, required = _displace_inputs(bundle, col_arr, contrib, k3)
+            placements, overflow, n_claims = cons._greedy_displace(
+                bundle, surv, required, allow_claim=True, max_claims=3)
+            assert 0 <= n_claims <= 3
+            assert _placements_feasible(
+                bundle, surv, required, placements, overflow), seed
+            if n_claims > 1:
+                shipped_multi += 1
+                # multi-claim implies one claim could NOT carry it
+                assert not cons._one_claim_fits(bundle.snap, overflow)
+                split = cons._claims_fit(bundle.snap, overflow, 3)
+                assert split is not None and len(split) == n_claims
+                total: dict = {}
+                for claim in split:
+                    assert cons._one_claim_fits(bundle.snap, claim), seed
+                    for g, cnt in claim.items():
+                        total[g] = total.get(g, 0) + cnt
+                assert total == overflow, "split lost or invented pods"
+        assert strict >= 5, f"splitter never extended the frontier ({strict})"
+        assert shipped_multi >= 5, shipped_multi
+
+    def test_claims_fit_splits_what_one_claim_cannot(self, monkeypatch):
+        monkeypatch.setattr(cons, "_group_type_compat", _compat_all)
+        snap = SimpleNamespace(
+            G=2, T=1, resources=("cpu", "mem"),
+            g_demand=np.array([[8.0, 16.0], [8.0, 16.0]]),
+            t_alloc=np.array([[16.0, 64.0]]),
+            m_overhead=np.array([[0.0, 0.0]]),
+            t_tmpl=np.zeros(1, np.intp))
+        overflow = {0: 2, 1: 2}  # 4 pods x 8cpu: two per 16-cpu claim
+        assert not cons._one_claim_fits(snap, overflow)
+        assert cons._claims_fit(snap, overflow, 1) is None
+        split = cons._claims_fit(snap, overflow, 2)
+        assert split is not None and len(split) == 2
+        total: dict = {}
+        for claim in split:
+            assert cons._one_claim_fits(snap, claim)
+            for g, cnt in claim.items():
+                total[g] = total.get(g, 0) + cnt
+        assert total == overflow
+        # a pod no single fresh node carries kills the split outright
+        snap.g_demand = np.array([[32.0, 8.0], [8.0, 16.0]])
+        assert cons._claims_fit(snap, {0: 1}, 4) is None
+
+
+# ---------------------------------------------------------------------------
 # the fallback matrix: every non-ship cause, forced deterministically
 # ---------------------------------------------------------------------------
 
