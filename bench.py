@@ -701,6 +701,16 @@ def _global_pairs():
             f"global: {cfg} paid {row.get('snapshot_rebuilds')} full "
             "snapshot rebuild(s) across the eviction wave — the "
             "journal-delta path declined mid-wave")
+    # fleet ledger: both legs' end-of-run live rate must match the row's
+    # end-cost sweep within 1% (same catalog walk — any gap is a missed
+    # launch/retire event). Gated only when the row carries the key.
+    if row.get("cost_reconciled_ok") is False:
+        ledger = (row.get("ledger") or {})
+        problems.append(
+            f"global: {cfg} fleet-ledger live rate "
+            f"{ledger.get('live_rate')} did not reconcile with the "
+            f"end-cost sweep {row.get('end_cost')} within 1% — a "
+            "lifecycle event escaped the ledger")
     base = _perf_baseline_rows().get(cfg)
     if base is not None and "total_ms" in base and "total_ms" in row:
         pairs.append((cfg, float(base["total_ms"]), float(row["total_ms"])))
@@ -792,17 +802,35 @@ def _spot_pairs():
             f"spot: {cfg} lost {lost} pod(s) to reclaims whose notice "
             "arrived with >=1 round of lead — the proactive drain "
             "machinery failed")
+    # fleet ledger (deploy/README.md "Fleet ledger"): the storm's
+    # realized-cost integral must close on a live rate within 1% of the
+    # row's own end-cost sweep on BOTH legs — a gap means a lifecycle
+    # event (launch/retire) escaped the ledger. Gated only when the row
+    # carries the key, so pre-ledger committed rows still parse.
+    if row.get("cost_reconciled_ok") is False:
+        aware_l = (row.get("risk_aware") or {})
+        blind_l = (row.get("risk_blind") or {})
+        problems.append(
+            f"spot: {cfg} fleet-ledger live rate did not reconcile with "
+            "the end-cost sweep within 1% (risk-aware "
+            f"{aware_l.get('ledger_live_rate')} vs {aware_l.get('end_cost')}, "
+            f"risk-blind {blind_l.get('ledger_live_rate')} vs "
+            f"{blind_l.get('end_cost')}) — a lifecycle event escaped the "
+            "ledger")
     base = _perf_baseline_rows().get(cfg)
     if base is not None and "total_ms" in base and "total_ms" in row:
         pairs.append((cfg, float(base["total_ms"]), float(row["total_ms"])))
     return pairs, problems
 
 
-def _multitenant_pairs() -> list:
-    """Sentinel pairs for the multi-tenant fleet row: wall clock AND the
-    concurrent worst-tenant p99 (a queueing/coalescing regression shows
-    up in p99 long before total wall clock moves). Baseline-gated like
-    the consolidation leg: no committed multitenant row, no fresh run."""
+def _multitenant_pairs():
+    """(sentinel pairs, hard-gate problems) for the multi-tenant fleet
+    row: wall clock AND the concurrent worst-tenant p99 (a queueing/
+    coalescing regression shows up in p99 long before total wall clock
+    moves), plus the fleet-ledger billing reconciliation — the server's
+    per-tenant billed device seconds must sum to its own devplane
+    dispatch ledger within rounding. Baseline-gated like the
+    consolidation leg: no committed multitenant row, no fresh run."""
     base = {
         cfg: r for cfg, r in _perf_baseline_rows().items()
         # a degraded committed row (client fallbacks — its latencies never
@@ -811,10 +839,21 @@ def _multitenant_pairs() -> list:
         and not r.get("degraded")
     }
     if not base:
-        return []
-    pairs = []
+        return [], []
+    pairs, problems = [], []
     fresh_rows = _fresh_perf_rows(["multitenant"])
     for cfg, fresh in fresh_rows.items():
+        # billing gate first: it holds on degraded rows too (the billed
+        # seconds describe dispatches that DID happen server-side), and
+        # only when the row carries the key (pre-ledger rows still parse)
+        if fresh.get("billing_sums_ok") is False:
+            b_plane = fresh.get("billing") or {}
+            problems.append(
+                f"multitenant: {cfg} per-tenant billed device seconds "
+                f"{b_plane.get('total_device_seconds')} did not sum to "
+                "the server's devplane dispatch ledger "
+                f"{b_plane.get('devplane_dispatch_seconds')} within "
+                "rounding — a dispatch escaped tenant attribution")
         b = base.get(cfg)
         if b is None or "total_ms" not in fresh:
             continue
@@ -837,7 +876,7 @@ def _multitenant_pairs() -> list:
               f"committed configs {sorted(base)} (fresh: "
               f"{sorted(fresh_rows)}) — nothing was compared",
               file=sys.stderr)
-    return pairs
+    return pairs, problems
 
 
 def _baseline_multichip() -> list:
@@ -1063,7 +1102,15 @@ def sentinel(record: dict, consolidation: bool = False,
                 print(f"bench:   {p}", file=sys.stderr)
             return 3
     if multitenant:
-        pairs.extend(_multitenant_pairs())
+        t_pairs, t_problems = _multitenant_pairs()
+        pairs.extend(t_pairs)
+        if t_problems:
+            print("bench: multitenant billing gate failed "
+                  "(KARPENTER_BENCH_SENTINEL=0 to disable):",
+                  file=sys.stderr)
+            for p in t_problems:
+                print(f"bench:   {p}", file=sys.stderr)
+            return 3
     if multichip:
         m_pairs, m_problems = _multichip_pairs()
         pairs.extend(m_pairs)

@@ -87,7 +87,9 @@ def serve_metrics(registry, port: int, host: str = ""):
     compile ledger, obs/devplane.py), and `/introspect` (the decision
     plane: per-site rung mixes, last-K round rung summaries, the solve-
     quality series, per-tenant rung mixes, retained anomalous rounds —
-    obs/decisions.py; `python -m karpenter_tpu.obs report` renders it).
+    obs/decisions.py; `python -m karpenter_tpu.obs report` renders it),
+    and `/usage` (the fleet ledger's per-tenant device-time billing,
+    obs/timeline.py — deploy/README.md "Fleet ledger").
     `host` defaults to all interfaces for containerized scrapes; deploys
     without a NetworkPolicy narrow it via KARPENTER_METRICS_BIND
     (deploy/README.md, network exposure)."""
@@ -96,7 +98,7 @@ def serve_metrics(registry, port: int, host: str = ""):
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
             if self.path not in ("/metrics", "/healthz", "/slo",
-                                 "/introspect"):
+                                 "/introspect", "/usage"):
                 self.send_response(404)
                 self.end_headers()
                 return
@@ -109,6 +111,11 @@ def serve_metrics(registry, port: int, host: str = ""):
                 from karpenter_tpu.obs import decisions
 
                 body = json.dumps(decisions.introspect_snapshot()).encode()
+                ctype = "application/json"
+            elif self.path == "/usage":
+                from karpenter_tpu.obs import timeline
+
+                body = json.dumps(timeline.usage_snapshot()).encode()
                 ctype = "application/json"
             else:
                 body = (

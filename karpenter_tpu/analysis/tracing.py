@@ -58,6 +58,17 @@ runtime:
   stale tensors as "its" capsule — corrupting the exact bit-parity
   replay exists to guarantee) and race the capsule index from XLA's
   runtime (the same failure mode as GL401-404, one plane over).
+- GL406 timeline-in-trace: a fleet-ledger timeline hook
+  (``record_event``/``record_billing``/``note_launch``/``begin_command``/
+  ``observe_fleet``, or ``record``/``observe``/``note`` on a timeline
+  receiver — ``timeline.*``/``TIMELINE``) inside jit-reachable code. The
+  hooks take the ledger lock, read wall-clock time, mutate the bounded
+  event ring / command table / billing rows, and feed metric registries —
+  executed at trace time they would mint ONE frozen lifecycle event per
+  compile (re-committed by every later solve, corrupting the causal
+  timeline and the billed device-seconds the ``/usage`` endpoint reports)
+  and race the ring from XLA's runtime (the same failure mode as
+  GL401-405, one plane over).
 
 Reachability is an inter-procedural taint pass: entry functions are those
 handed to jit/pallas_call (as decorator, call argument, or via
@@ -85,6 +96,7 @@ RULES = {
     "GL403": "devplane telemetry hook (compile ledger / pad-waste / SLO observe) in jit-reachable code executes at trace time",
     "GL404": "decision-ledger hook (record_decision / record_quality / decisions receiver) in jit-reachable code executes at trace time",
     "GL405": "replay-capsule hook (record_capture / write_capsule / capsule receiver) in jit-reachable code executes at trace time",
+    "GL406": "fleet-ledger timeline hook (record_event / record_billing / timeline receiver) in jit-reachable code executes at trace time",
 }
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
@@ -124,6 +136,14 @@ _DECISION_BASES = {"decisions", "DECISIONS"}
 _CAPSULE_FUNCS = {"record_capture", "write_capsule", "maybe_write_round"}
 _CAPSULE_VERBS = {"capture"}
 _CAPSULE_BASES = {"capsule", "CAPSULES"}
+# GL406 — the fleet-ledger timeline surface (karpenter_tpu/obs/timeline):
+# the event/billing hooks match by final attribute (timeline.record_event,
+# TIMELINE.record_billing, a bare import); the generic verbs only count on
+# an unmistakably timeline receiver.
+_TIMELINE_FUNCS = {"record_event", "record_billing", "note_launch",
+                   "begin_command", "observe_fleet"}
+_TIMELINE_VERBS = {"record", "observe", "note"}
+_TIMELINE_BASES = {"timeline", "TIMELINE"}
 
 
 def _const_names(node) -> set:
@@ -597,6 +617,16 @@ class _TaintVisitor:
                 f"replay-capsule hook `{fname}(...)` inside "
                 f"jit-reachable `{self.fn.name}` executes at trace time "
                 "(capture from the host-side dispatch site)",
+            )
+        elif last in _TIMELINE_FUNCS or (
+            last in _TIMELINE_VERBS and base in _TIMELINE_BASES
+        ):
+            self._flag(
+                "GL406",
+                node.lineno,
+                f"fleet-ledger timeline hook `{fname}(...)` inside "
+                f"jit-reachable `{self.fn.name}` executes at trace time "
+                "(record lifecycle events from the host-side controller)",
             )
 
         # GL103 side effects

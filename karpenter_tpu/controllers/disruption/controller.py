@@ -149,6 +149,7 @@ class DisruptionController:
             return progressed
         self._log_abnormal_run(now)
         self._last_run = now
+        self._observe_fleet_cost(now)
         if not self.cluster.synced():
             return progressed
         # one trace per disruption round: the method ladder, every probe
@@ -204,6 +205,21 @@ class DisruptionController:
     def _has_interruptions(self) -> bool:
         return any(sn.interruption_pending()
                    for sn in self.cluster.state_nodes())
+
+    # -- realized-cost observation (fleet ledger) ------------------------
+    def _observe_fleet_cost(self, now: float):
+        """Advance the fleet ledger's realized-cost integral once per
+        disruption round cadence (piecewise-constant between rounds): one
+        CatalogView per sweep resolves every store node's offering, the
+        integral accrues into ``karpenter_fleet_cost_realized_total``,
+        and exposure-hours feed the observed interruption-rate
+        denominators (obs/timeline.py; deploy/README.md "Fleet ledger")."""
+        from karpenter_tpu.cloudprovider.types import CatalogView
+        from karpenter_tpu.obs import timeline
+
+        view = CatalogView(self.store.list("nodepools"), self.cloud)
+        timeline.observe_fleet(self.store.list("nodes"), view, now,
+                               registry=self.registry)
 
     # -- watchdog (logAbnormalRuns, controller.go:274-283) ---------------
     def _log_abnormal_run(self, now: float):
@@ -305,7 +321,7 @@ class DisruptionController:
             if method.needs_validation:
                 self._pending = (cmd, method, self.clock.now())
                 return True
-            return self._execute(cmd)
+            return self._execute(cmd, method)
         self._noop_fence = fence
         if not ran_search:
             # candidates exist but every consolidation search sat behind
@@ -367,7 +383,7 @@ class DisruptionController:
             ok = self._validate(cmd, method)
         if not ok:
             return True  # dropped; next round recomputes
-        return self._execute(cmd)
+        return self._execute(cmd, method)
 
     def _validate(self, cmd, method) -> bool:
         """Re-check the command against fresh state (validation.go:67)."""
@@ -425,11 +441,11 @@ class DisruptionController:
         return True
 
     # -- execution (controller.go executeCommand:188) --------------------
-    def _execute(self, cmd) -> bool:
+    def _execute(self, cmd, method=None) -> bool:
         with obs.span("disrupt.execute", action=cmd.action, reason=cmd.reason):
-            return self._execute_inner(cmd)
+            return self._execute_inner(cmd, method)
 
-    def _execute_inner(self, cmd) -> bool:
+    def _execute_inner(self, cmd, method=None) -> bool:
         # 1. taint candidates so nothing schedules onto them (:196)
         for c in cmd.candidates:
             node = self.store.try_get("nodes", c.name)
@@ -440,6 +456,35 @@ class DisruptionController:
             nc = claim.to_node_claim()
             self.store.create("nodeclaims", nc)
             cmd.replacement_names.append(nc.name)
+        # 2b. open the command's fleet-ledger entry: predicted savings at
+        # execution time, the cause chain every launch/drain event will
+        # carry, and the pending claim/node sets whose completion
+        # reconciles predicted vs realized (obs/timeline.py)
+        from karpenter_tpu.controllers.disruption.methods import (
+            candidate_prices,
+            predicted_command_savings,
+        )
+        from karpenter_tpu.obs import timeline
+
+        cmd.predicted_savings = predicted_command_savings(cmd)
+        cause = {
+            "site": getattr(method, "decision_site", "") or "",
+            "rung": getattr(method, "last_rung", "") or "",
+            "reason": cmd.reason,
+        }
+        cause["command"] = timeline.begin_command(
+            site=cause["site"], rung=cause["rung"], reason=cmd.reason,
+            predicted=cmd.predicted_savings,
+            retired_rate=candidate_prices(cmd.candidates),
+            claims=cmd.replacement_names,
+            nodes=[c.name for c in cmd.candidates],
+            registry=self.registry,
+        )
+        for name in cmd.replacement_names:
+            timeline.pend_cause(name, cause)
+        for c in cmd.candidates:
+            timeline.record_event("drain", c.name, cause=cause,
+                                  pods=len(c.reschedulable_pods))
         # 3. fence the state (:223)
         self.cluster.mark_for_deletion(*[c.provider_id for c in cmd.candidates])
         # 4. orchestrate deletion (:225)

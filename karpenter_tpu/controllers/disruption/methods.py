@@ -41,6 +41,10 @@ class Method:
     # consolidation methods honor the isConsolidated fence: skipped while
     # cluster state is unchanged since the last fruitless search
     is_consolidation: bool = False
+    # the decision-ledger site whose verdict shipped this method's
+    # commands — the fleet ledger stamps it on every command's cause
+    # chain (obs/timeline.py); empty for methods without a ladder site
+    decision_site: str = ""
 
     def __init__(self, ctx):
         self.ctx = ctx  # DisruptionContext: provisioner, cluster, store, clock, options
@@ -175,6 +179,7 @@ class InterruptionDrain(Method):
 
     reason = REASON_INTERRUPTED
     needs_validation = False  # a validation TTL would eat the deadline
+    decision_site = "disrupt.interruption"
     last_rung: str = ""  # "proactive" | "reactive" | "degraded" (tests)
 
     @property
@@ -492,6 +497,34 @@ def candidate_prices(candidates) -> float | None:
             return None
         total += p
     return total
+
+
+def predicted_command_savings(cmd) -> float | None:
+    """Criterion-predicted savings RATE of a command at execution time:
+    the candidates' summed effective price minus the cheapest effective
+    offering each replacement claim can still launch as — the number the
+    fleet ledger reconciles against realized savings when the command
+    completes (obs/timeline.py; deploy/README.md "Fleet ledger"). None
+    when either side is unpriceable (the :func:`candidate_prices`
+    stance: an unknown price cannot anchor a reconciliation)."""
+    current = candidate_prices(cmd.candidates)
+    if current is None:
+        return None
+    from karpenter_tpu.cloudprovider.types import effective_price, risk_lambda
+
+    lam = risk_lambda()  # hoisted: one env read, not one per offering
+    replacement = 0.0
+    for claim in cmd.replacements:
+        best = None
+        for it in claim.instance_types:
+            for o in it.offerings.available().compatible(claim.requirements):
+                p = effective_price(o, lam)
+                if p > 0 and (best is None or p < best):
+                    best = p
+        if best is None:
+            return None
+        replacement += best
+    return current - replacement
 
 
 def compute_consolidation(ctx, candidates) -> Command | None:
@@ -828,6 +861,7 @@ class GlobalConsolidation(Method):
     needs_validation = True
     is_consolidation = True
     uses_bundle = True  # the controller prewarms the round's snapshot
+    decision_site = "consolidate.global"
     last_rung: str = ""  # "joint" | "ladder" | "sequential" (tests + perf)
     last_plan = None  # the round's JointPlan (tests + observability)
     # when True the controller closes the consolidation round after this

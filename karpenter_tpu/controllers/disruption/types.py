@@ -82,6 +82,10 @@ class Command:
         self.replacement_names: list = []
         self.created_at: float = 0.0
         self.last_error: str | None = None
+        # criterion-predicted savings rate, stamped at execution for the
+        # fleet ledger's reconciliation (obs/timeline.py); None when the
+        # command was unpriceable
+        self.predicted_savings: float | None = None
 
     @property
     def action(self) -> str:

@@ -28,6 +28,7 @@ import time
 
 from karpenter_tpu import obs
 from karpenter_tpu.api import labels as wk
+from karpenter_tpu.obs import timeline
 from karpenter_tpu.controllers.disruption.queue import add_disruption_taint
 from karpenter_tpu.utils import pod as pod_util
 
@@ -109,6 +110,12 @@ class NodeTerminationController:
         if evicted:
             progressed = True
         blocked_keys = {p.key() for p in blocked}
+        # evict events stage on the drain round's trace: an eviction always
+        # means progress, so the round keeps and the events commit
+        for node, evictable in plan:
+            n = sum(1 for p in evictable if p.key() not in blocked_keys)
+            if n:
+                timeline.record_event("evict", node.name, pods=n)
         with obs.span("drain.finalize", kind="host"):
             for node, evictable in plan:
                 if evictable:
@@ -154,6 +161,13 @@ class NodeTerminationController:
         pool = node.labels.get(wk.NODEPOOL_LABEL, "")
         self.registry.counter(m.NODES_TERMINATED, "nodes terminated").inc(
             nodepool=pool)
+        # retire closes the node's timeline (and counts a reclaim when an
+        # interrupt notice preceded it — the observed interruption feed)
+        timeline.record_event(
+            "retire", node.name, pool=pool,
+            instance_type=node.labels.get(wk.INSTANCE_TYPE_LABEL, ""),
+            zone=node.labels.get(wk.TOPOLOGY_ZONE_LABEL, ""),
+            registry=self.registry)
         if node.metadata.deletion_timestamp is not None:
             self.registry.histogram(
                 m.NODE_TERMINATION_DURATION,

@@ -29,9 +29,17 @@ Contract (cross-referenced from ops/consolidate.py and ops/tensorize.py):
   ``python -m karpenter_tpu.obs replay <capsule> [--ab]`` re-executes it
   bit-identically offline (and A/Bs every eligible rung). Its hooks are
   host-only under GL405.
+- :mod:`karpenter_tpu.obs.timeline` is the fleet ledger: the causal
+  node-lifecycle timeline (bounded event ring with decision/trace/
+  capsule cause chains, queried via ``/introspect`` and
+  ``python -m karpenter_tpu.obs report --timeline``), realized-cost
+  accounting with per-command predicted-vs-realized reconciliation (the
+  ``savings-drift`` anomaly), per-tenant device-time billing behind the
+  ``/usage`` endpoint, and the observed interruption-rate feed. Its
+  hooks are host-only under GL406.
 """
 
-from karpenter_tpu.obs import capsule, decisions, devplane
+from karpenter_tpu.obs import capsule, decisions, devplane, timeline
 from karpenter_tpu.obs.recorder import FlightRecorder, chrome_events
 from karpenter_tpu.obs.trace import (
     RECORDER,
@@ -55,6 +63,7 @@ __all__ = [
     "capsule",
     "decisions",
     "devplane",
+    "timeline",
     "RECORDER",
     "TRACER",
     "Span",

@@ -25,7 +25,8 @@ import argparse
 import json
 import sys
 
-__all__ = ["render_report", "render_ab", "run_replay", "main"]
+__all__ = ["render_report", "render_timeline", "render_ab", "run_replay",
+           "main"]
 
 
 def _fmt_mix(rungs: dict) -> str:
@@ -42,9 +43,79 @@ def _fmt_mix(rungs: dict) -> str:
     return "  ".join(parts)
 
 
-def render_report(snapshot: dict) -> str:
+def render_timeline(section: dict) -> str:
+    """The fleet-ledger ``timeline`` section of the introspect JSON
+    (obs/timeline.py) as a human-readable report (pure — the CLI smoke
+    test feeds it a canned section)."""
+    lines = ["fleet ledger"]
+    lines.append("=" * 64)
+    ring = section.get("ring") or {}
+    kinds = ring.get("kinds") or {}
+    mix = "  ".join(f"{k}={n}" for k, n in sorted(kinds.items()))
+    lines.append(
+        f"  ring {ring.get('size', 0)}/{ring.get('capacity', 0)} "
+        f"(dropped={ring.get('dropped', 0)})" + (f"  {mix}" if mix else ""))
+    for ev in section.get("events") or []:
+        cause = ev.get("cause") or {}
+        why = ""
+        if cause:
+            why = (f"  <- {cause.get('site', '-')}/{cause.get('rung', '-')}"
+                   f"/{cause.get('reason', '-')}")
+            if cause.get("command"):
+                why += f" [{cause['command']}]"
+        tid = f" [{ev['trace_id']}]" if ev.get("trace_id") else ""
+        lines.append(f"  {ev.get('kind', '?'):9s} {ev.get('node')}{tid}{why}")
+    cost = section.get("cost") or {}
+    lines.append("")
+    lines.append(
+        f"realized cost: total={cost.get('realized_total', 0.0)} "
+        f"live_rate={cost.get('live_rate', 0.0)} "
+        f"({cost.get('live_nodes', 0)} nodes)")
+    for key, amt in sorted((cost.get("realized") or {}).items()):
+        lines.append(f"  {key:32s} {amt}")
+    commands = section.get("commands") or {}
+    reconciled = commands.get("reconciled") or []
+    if reconciled or commands.get("pending"):
+        lines.append("")
+        lines.append(
+            f"commands: pending={commands.get('pending', 0)} "
+            f"reconciled={len(reconciled)}")
+        for c in reconciled:
+            verdict = ("within" if c.get("ok")
+                       else "DRIFT" if c.get("ok") is False else "unpriced")
+            lines.append(
+                f"  {c.get('command')} {c.get('site') or '-'}"
+                f"/{c.get('rung') or '-'}  predicted={c.get('predicted')} "
+                f"realized={c.get('realized')}  {verdict}")
+    interruptions = section.get("interruptions") or {}
+    if interruptions:
+        lines.append("")
+        lines.append("observed interruption rates")
+        for key, row in sorted(interruptions.items()):
+            lines.append(
+                f"  {key:24s} notices={row.get('notices', 0)} "
+                f"reclaims={row.get('reclaims', 0)} "
+                f"exposure_h={row.get('exposure_hours', 0.0)} "
+                f"reclaims/h={row.get('reclaims_per_hour', 0.0)}")
+    billing = section.get("billing") or {}
+    tenants = billing.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append(
+            f"tenant billing (total={billing.get('total_device_seconds')}s "
+            f"devplane={billing.get('devplane_dispatch_seconds')}s "
+            f"dropped={billing.get('dropped_device_seconds')}s)")
+        for t, row in sorted(tenants.items()):
+            lines.append(
+                f"  {t:16s} {row.get('device_seconds', 0.0)}s over "
+                f"{row.get('dispatches', 0)} dispatches")
+    return "\n".join(lines)
+
+
+def render_report(snapshot: dict, timeline: bool = False) -> str:
     """The introspect JSON as a human-readable report (pure — the CLI
-    smoke test feeds it a canned snapshot)."""
+    smoke test feeds it a canned snapshot). ``timeline`` appends the
+    fleet-ledger section (``report --timeline``)."""
     lines = ["decision plane"]
     lines.append("=" * 64)
     sites = snapshot.get("sites") or {}
@@ -115,6 +186,9 @@ def render_report(snapshot: dict) -> str:
                 f"  {c.get('round') or '-'} [{c.get('trace_id') or '-'}]  "
                 f"seam={c.get('seam')} engine={c.get('engine')}{tenant}  "
                 f"{c.get('why')}  {c.get('path')}")
+    if timeline:
+        lines.append("")
+        lines.append(render_timeline(snapshot.get("timeline") or {}))
     return "\n".join(lines)
 
 
@@ -198,6 +272,11 @@ def main(argv=None) -> int:
                      help="emit the raw JSON instead of the rendered report")
     rep.add_argument("-k", type=int, default=16,
                      help="rounds/anomalies to include (in-process source)")
+    rep.add_argument("--timeline", action="store_true",
+                     help="append the fleet-ledger section (lifecycle "
+                          "events with cause chains, realized cost, "
+                          "command reconciliation, interruption rates, "
+                          "tenant billing — obs/timeline.py)")
     rpl = sub.add_parser(
         "replay", help="re-execute a replay capsule offline (bit-parity "
                        "asserted against its captured outputs)")
@@ -234,7 +313,7 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps(snapshot, indent=2, sort_keys=True))
     else:
-        print(render_report(snapshot))
+        print(render_report(snapshot, timeline=args.timeline))
     return 0
 
 

@@ -77,6 +77,11 @@ All hooks are host-side by construction: graftlint's GL404 rule
 ``record_quality`` (or a verb on a decisions receiver) becomes reachable
 from jit/pallas-traced code. Site/rung/reason semantics are documented in
 deploy/README.md ("Decision plane").
+
+The ``fleet.reconcile`` site is produced by the fleet ledger
+(:mod:`karpenter_tpu.obs.timeline`): one verdict per reconciled
+disruption command, with the savings-drift anomaly owning that site's
+steady-streak story — see deploy/README.md ("Fleet ledger").
 """
 
 from __future__ import annotations
@@ -202,6 +207,24 @@ SITES = {
         "reasons": frozenset({
             "ok", "delete-only", "reactive-fallback", "deadline-degraded",
             OTHER_REASON,
+        }),
+    },
+    "fleet.reconcile": {
+        # obs/timeline.py FleetTimeline._reconcile: one verdict per
+        # disruption command whose replacements all launched and whose
+        # retired nodes all left the fleet — realized savings (retired
+        # rate minus launch rate) within the KARPENTER_SAVINGS_DRIFT_TOL
+        # band of the criterion prediction, or drifting. Every reason is
+        # benign: the savings-drift anomaly (obs/timeline.py) owns the
+        # steady-streak regression story for this site, so the generic
+        # rung-regression detector must not double-fire beside it. See
+        # deploy/README.md "Fleet ledger".
+        "rungs": ("within", "drift"),
+        "reasons": frozenset({
+            "ok", "consolidation", "interruption", OTHER_REASON,
+        }),
+        "benign": frozenset({
+            "ok", "consolidation", "interruption", OTHER_REASON,
         }),
     },
     "solver.route": {
@@ -617,9 +640,11 @@ def rung_delta(before: dict, after: dict) -> dict:
 def introspect_snapshot(k: int = 16) -> dict:
     """The ``/introspect`` endpoint body: per-site rung mixes, the last-K
     rounds' rung summaries, the quality account, per-tenant rung mixes,
-    the flight recorder's retained anomalous rounds, and the replay
-    capsules written by this process (obs/capsule.py)."""
+    the flight recorder's retained anomalous rounds, the replay capsules
+    written by this process (obs/capsule.py), and the fleet ledger's
+    timeline section (obs/timeline.py)."""
     from karpenter_tpu.obs import capsule as _capsule
+    from karpenter_tpu.obs import timeline as _timeline
     from karpenter_tpu.obs import trace as _trace
 
     anomalies = []
@@ -640,6 +665,7 @@ def introspect_snapshot(k: int = 16) -> dict:
         "tenants": DECISIONS.tenant_mix(),
         "anomalies": anomalies[-k:],
         "capsules": _capsule.index(k),
+        "timeline": _timeline.timeline_snapshot(k),
     }
 
 

@@ -92,6 +92,10 @@ STATS = {
     # doubled re-run) — the fused-round lever that keeps axis exhaustion
     # off the host repair path; perf rows surface it as bin_growth_events
     "bin_growths": 0,
+    # total device seconds across EVERY dispatch (cold + warm) — the
+    # ground-truth total the fleet ledger's per-tenant billing
+    # (obs/timeline.py /usage) must sum to within rounding
+    "dispatch_seconds": 0.0,
 }
 _STATS_LOCK = threading.Lock()
 
@@ -135,8 +139,12 @@ class CompileLedger:
         )
 
     def record_dispatch(self, family: str, key, seconds: float,
-                        registry=None) -> bool:
-        """Note one dispatch; returns True when it was a cold compile."""
+                        registry=None, tenant: str | None = None) -> bool:
+        """Note one dispatch; returns True when it was a cold compile.
+        ``tenant`` attributes the dispatch's device seconds to a tenant on
+        the fleet ledger's billing plane (obs/timeline.py); None lets the
+        ledger resolve the open round's tenant attr (the solver service's
+        per-session rounds) before falling back to "untenanted"."""
         with self._lock:
             seen = self._keys.setdefault(family, set())
             cold = key not in seen
@@ -147,13 +155,19 @@ class CompileLedger:
                 resident = len(seen)
             else:
                 self._warm_streak += 1
+        from karpenter_tpu.obs import timeline as _timeline
+
+        _timeline.record_billing(family, seconds, tenant=tenant,
+                                 registry=registry)
         if not cold:
             with _STATS_LOCK:
                 STATS["warm_dispatches"] += 1
+                STATS["dispatch_seconds"] += seconds
             return False
         with _STATS_LOCK:
             STATS["cold_compiles"] += 1
             STATS["compile_ms"] += seconds * 1000.0
+            STATS["dispatch_seconds"] += seconds
         from karpenter_tpu.operator import metrics as _m
 
         reg = _resolve_registry(registry)
@@ -213,8 +227,10 @@ class CompileLedger:
 LEDGER = CompileLedger()
 
 
-def record_dispatch(family: str, key, seconds: float, registry=None) -> bool:
-    return LEDGER.record_dispatch(family, key, seconds, registry=registry)
+def record_dispatch(family: str, key, seconds: float, registry=None,
+                    tenant: str | None = None) -> bool:
+    return LEDGER.record_dispatch(family, key, seconds, registry=registry,
+                                  tenant=tenant)
 
 
 def record_padding(site: str, actual, padded, registry=None) -> float:
@@ -360,6 +376,7 @@ class SloTracker:
             self.latency_slo is not None and seconds > self.latency_slo
         )
         t_samples = None
+        evicted = None
         with self._lock:
             self._window.append(float(seconds))
             self._count += 1
@@ -373,7 +390,8 @@ class SloTracker:
                 if tv is None:
                     if len(self._tenants) >= self._TENANT_CAP:
                         # dict order is recency order (pop+reinsert below)
-                        self._tenants.pop(next(iter(self._tenants)))
+                        evicted = next(iter(self._tenants))
+                        self._tenants.pop(evicted)
                     tv = {
                         "window": deque(maxlen=256), "count": 0,
                         "errors": 0, "burned": 0,
@@ -389,6 +407,13 @@ class SloTracker:
         from karpenter_tpu.operator import metrics as _m
 
         reg = _resolve_registry(registry)
+        if evicted is not None:
+            # the LRU-dropped tenant's billing/quantile series retire with
+            # its sub-window (obs/timeline.py drop_tenant) — the bounded-
+            # cardinality stance extended to the metric registry
+            from karpenter_tpu.obs import timeline as _timeline
+
+            _timeline.drop_tenant(evicted, slo=self.name, registry=reg)
         reg.histogram(
             _m.SOLVER_REQUEST_SECONDS,
             "solver-service request durations by outcome",
@@ -511,5 +536,5 @@ def reset():
             cold_compiles=0, compile_ms=0.0, warm_dispatches=0,
             pad_dispatches=0, pad_cells_actual=0.0, pad_cells_padded=0.0,
             shard_overlap_ms=0.0, shard_repair_pods=0, shard_fallbacks=0,
-            shard_balance_ratio=0.0, bin_growths=0,
+            shard_balance_ratio=0.0, bin_growths=0, dispatch_seconds=0.0,
         )

@@ -139,6 +139,10 @@ class Trace:
         # only when the round closes anomalous (or KARPENTER_CAPSULE=1)
         self.capsule_pending: dict | None = None
         self.capsule_path: str | None = None
+        # node-lifecycle events staged by the fleet ledger
+        # (obs/timeline.py): committed to the timeline ring only when the
+        # round keeps, so an idle round cannot grow it
+        self.events: list = []
         # an idle round (the owner found nothing to do) opts out of the
         # ring and the histograms so it cannot churn real rounds out; an
         # anomaly overrides the discard — anomalous rounds always keep
@@ -164,6 +168,12 @@ class Trace:
         with self._lock:
             key = (site, rung, reason)
             self.decisions[key] = self.decisions.get(key, 0) + 1
+
+    def add_event(self, event: dict):
+        """Stage one fleet-ledger lifecycle event (obs/timeline.py) for
+        commit at round close."""
+        with self._lock:
+            self.events.append(event)
 
     def add_capture(self, record: dict):
         """Attach a replay-capture record (last one wins — the round's
@@ -419,6 +429,13 @@ class Tracer:
         rec = self.recorder
         if rec is not None:
             rec.record(trace)
+        if trace.events:
+            # the fleet ledger commits the round's staged lifecycle
+            # events AFTER the recorder ran, so the round's capsule ref
+            # (when one was written) rides on the committed events
+            from karpenter_tpu.obs import timeline as _timeline
+
+            _timeline.note_round(trace)
 
     def _feed_metrics(self, trace: Trace):
         registry = trace.registry
@@ -525,9 +542,11 @@ def reset():
     RECORDER.clear()
     from karpenter_tpu.obs import capsule as _capsule
     from karpenter_tpu.obs import decisions as _decisions
+    from karpenter_tpu.obs import timeline as _timeline
 
     _decisions.reset()
     _capsule.reset()
+    _timeline.reset()
     return TRACER, RECORDER
 
 

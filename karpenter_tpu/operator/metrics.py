@@ -136,6 +136,17 @@ class Histogram(_Metric):
             self._sum[key] = self._sum.get(key, 0.0) + value
             self._total[key] = self._total.get(key, 0) + 1
 
+    def remove(self, **labels):
+        """Delete ONE label combination — Gauge.remove parity, so a
+        bounded-cardinality owner (the fleet ledger's per-tenant billing,
+        obs/timeline.py) can retire exactly the series of a tenant whose
+        rolling sub-window LRU-dropped."""
+        key = _labels_key(labels)
+        with self._lock:
+            self._counts.pop(key, None)
+            self._sum.pop(key, None)
+            self._total.pop(key, None)
+
     def count(self, **labels) -> int:
         with self._lock:
             return self._total.get(_labels_key(labels), 0)
@@ -355,6 +366,18 @@ CAPSULE_SKIPPED = f"{NAMESPACE}_capsule_skipped_total"
 # their bundle bytes from the LRU budget without waiting for a client
 # access to trip the reap-on-access path
 SOLVER_SESSION_SWEEPS = f"{NAMESPACE}_solver_session_sweeps_total"
+# fleet ledger (karpenter_tpu/obs/timeline.py): effective-price dollars
+# integrated over node lifetimes, predicted vs realized savings rates of
+# reconciled disruption commands, per-tenant device-time billing (the
+# histogram's tenant series retire via Histogram.remove when the tenant's
+# SLO sub-window LRU-drops), and committed lifecycle-timeline events by
+# kind — see deploy/README.md "Fleet ledger"
+FLEET_COST_REALIZED = f"{NAMESPACE}_fleet_cost_realized_total"
+FLEET_SAVINGS_PREDICTED = f"{NAMESPACE}_fleet_savings_predicted_total"
+FLEET_SAVINGS_REALIZED = f"{NAMESPACE}_fleet_savings_realized_total"
+TENANT_DEVICE_SECONDS = f"{NAMESPACE}_tenant_device_seconds_total"
+TENANT_DISPATCH_SECONDS = f"{NAMESPACE}_tenant_dispatch_seconds"
+TIMELINE_EVENTS = f"{NAMESPACE}_timeline_events_total"
 NODES_ALLOCATABLE = f"{NAMESPACE}_nodes_allocatable"
 NODES_TOTAL = f"{NAMESPACE}_nodes_count"
 NODEPOOL_USAGE = f"{NAMESPACE}_nodepool_usage"

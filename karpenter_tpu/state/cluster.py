@@ -11,6 +11,11 @@ provisioner or the disruption controller may solve.
 Events flow in through `on_event` (the informer layer,
 state/informer/{pod,node,nodeclaim}.go collapsed into one method — our
 hermetic runtime has a single watch stream).
+
+New pod bindings and interruption notices also feed the fleet ledger's
+causal node-lifecycle timeline (obs/timeline.py; deploy/README.md "Fleet
+ledger") — ``bind`` and ``interrupt`` events on the bounded ring, the
+latter counting the observed interruption-rate feed's notices.
 """
 
 from __future__ import annotations
@@ -290,6 +295,9 @@ class Cluster:
                 sn.pods[key] = pod
                 sn.host_port_usage.add(pod)
                 sn.volume_usage.add(pod, kube=self.store)
+            from karpenter_tpu.obs import timeline
+
+            timeline.record_event("bind", pod.node_name, pod=key)
             if (
                 pod.affinity
                 and pod.affinity.pod_anti_affinity
@@ -391,6 +399,13 @@ class Cluster:
             return True
         sn.interruption_deadline = deadline
         self.mark_unconsolidated(("node", provider_id))
+        labels = sn.labels()
+        from karpenter_tpu.obs import timeline
+
+        timeline.record_event(
+            "interrupt", sn.name or provider_id, deadline=deadline,
+            instance_type=labels.get(wk.INSTANCE_TYPE_LABEL, ""),
+            zone=labels.get(wk.TOPOLOGY_ZONE_LABEL, ""))
         return True
 
     # -- deletion marks (cluster.go MarkForDeletion) ---------------------

@@ -418,8 +418,10 @@ def run_global_consolidation():
             os.environ["KARPENTER_RELAX"] = "1"
         try:
             from karpenter_tpu.obs import devplane as _dev
+            from karpenter_tpu.obs import timeline
 
             env = C.config4_consolidation_env(n_nodes)
+            timeline.reset()
             g0 = dict(GLOBAL_STATS)
             dv0 = dict(_dev.STATS)
             rx0 = dict(RELAX_STATS)
@@ -519,6 +521,32 @@ def run_global_consolidation():
                     k: round(RELAX_STATS[k] - rx0[k], 2)
                     for k in ("attempts", "ships", "fallbacks",
                               "kernel_ms")}
+            # fleet ledger (deploy/README.md "Fleet ledger"): one final
+            # observe closes the live-cost integral on the end fleet, so
+            # the ledger's live rate must equal the same node→offering
+            # walk _fleet_cost just did — the 1% reconciliation bar
+            # bench.py gates at exit 3
+            from karpenter_tpu.cloudprovider.types import CatalogView
+
+            live = timeline.observe_fleet(
+                env.store.list("nodes"),
+                CatalogView(env.store.list("nodepools"),
+                            env.disruption.cloud),
+                env.clock.now(), registry=env.registry)
+            recs = timeline.timeline_snapshot()["commands"]["reconciled"]
+            out["ledger"] = {
+                "realized_cost": live["realized_total"],
+                "live_rate": live["live_rate"],
+                "predicted_savings": round(sum(
+                    r["predicted"] for r in recs
+                    if r["predicted"] is not None), 6),
+                "realized_savings": round(sum(
+                    r["realized"] for r in recs), 6),
+                "commands_reconciled": len(recs),
+                "cost_reconciled_ok": bool(
+                    abs(live["live_rate"] - out["end_cost"])
+                    <= 0.01 * max(out["end_cost"], 1e-9)),
+            }
             return out
         finally:
             if prior is None:
@@ -544,9 +572,10 @@ def run_global_consolidation():
             "dispatches_per_round", "bin_growth_events",
             "snapshot_rebuilds", "snapshot_rebuild_reasons",
             "delta_path_ok", "hinted_binds",
-            "rungs", "relax")},
+            "rungs", "relax", "ledger")},
         "ladder": {k: ladder[k] for k in (
-            "total_ms", "rounds", "end_nodes", "pods_bound", "end_cost")},
+            "total_ms", "rounds", "end_nodes", "pods_bound", "end_cost",
+            "ledger")},
         # the acceptance verdicts (bench.py --consolidation): <budget
         # wall clock, end cost <= the ladder oracle's, exactly one
         # confirming simulation per executed joint command, and at most
@@ -560,6 +589,12 @@ def run_global_consolidation():
             and joint["confirm_count"] == joint["joint_commands"]),
         "dispatch_contract_ok": bool(
             joint["max_dispatches_per_generation"] <= 1),
+        # fleet-ledger bar: both legs' end-of-run live rate matches the
+        # _fleet_cost sweep within 1% (same catalog walk, so any gap is
+        # a missed launch/retire event, not price noise)
+        "cost_reconciled_ok": bool(
+            joint["ledger"]["cost_reconciled_ok"]
+            and ladder["ledger"]["cost_reconciled_ok"]),
     }
     print(json.dumps(row))
 
@@ -701,7 +736,10 @@ def run_spot():
         prior = os.environ.get("KARPENTER_SPOT_RISK_LAMBDA")
         os.environ["KARPENTER_SPOT_RISK_LAMBDA"] = str(leg_lam)
         try:
+            from karpenter_tpu.obs import timeline
+
             env = C.spot_env(n_nodes)
+            timeline.reset()
             chaos = ChaosCloud(random.Random(seed)).arm(env)
             pool = env.store.list("nodepools")[0]
             offerings = [
@@ -745,13 +783,31 @@ def run_spot():
                 env.run_until_idle(max_rounds=500)
             elapsed = time.perf_counter() - t0
             reg = env.registry
+            end_cost = round(_fleet_cost(env), 6)
+            # fleet ledger: close the live-cost integral on the end fleet
+            # and compare the ledger's live rate against the _fleet_cost
+            # sweep above (same CatalogView walk) — the 1% reconciliation
+            # bar bench.py --spot gates at exit 3, per leg
+            from karpenter_tpu.cloudprovider.types import CatalogView
+
+            live = timeline.observe_fleet(
+                env.store.list("nodes"),
+                CatalogView(env.store.list("nodepools"),
+                            env.disruption.cloud),
+                env.clock.now(), registry=reg)
             return {
                 "lambda": leg_lam,
                 "total_ms": round(elapsed * 1000, 2),
                 "end_nodes": len(env.store.list("nodes")),
                 "pods_bound": len(
                     [p for p in env.store.list("pods") if p.node_name]),
-                "end_cost": round(_fleet_cost(env), 6),
+                "end_cost": end_cost,
+                "realized_cost": live["realized_total"],
+                "ledger_live_rate": live["live_rate"],
+                "cost_reconciled_ok": bool(
+                    abs(live["live_rate"] - end_cost)
+                    <= 0.01 * max(end_cost, 1e-9)),
+                "interruption_rates": timeline.interruption_rates(),
                 "creates": int(created.total() - creates0),
                 "notices": chaos.stats["notices"],
                 "reclaims": chaos.stats["reclaims"],
@@ -790,6 +846,10 @@ def run_spot():
         "zero_late_drain_ok": bool(
             aware["pods_lost_with_lead"] == 0
             and blind["pods_lost_with_lead"] == 0),
+        # fleet-ledger bar: the storm's realized cost reconciles against
+        # the end-cost sweep within 1% on BOTH legs (bench.py --spot)
+        "cost_reconciled_ok": bool(
+            aware["cost_reconciled_ok"] and blind["cost_reconciled_ok"]),
         "rungs": decisions.rung_delta(dec0, decisions.counts()),
     }
     print(json.dumps(row))
@@ -1304,6 +1364,15 @@ def run_multitenant(n_tenants: int | None = None, rounds: int | None = None,
             if lb.get("outcome") == "bleed")
         if bleed:
             isolation_ok = False
+        # fleet-ledger billing plane (/usage, obs/timeline.py): the
+        # server attributes every solve dispatch's device seconds to the
+        # session tenant; the per-tenant billed total (+ LRU-dropped
+        # remainder) must equal the server's own devplane dispatch-
+        # seconds ledger within rounding — bench.py --multitenant gates
+        # the reconciliation at exit 3
+        usage = json.loads(_scrape("/usage"))
+        billing_gap = abs(usage["total_device_seconds"]
+                          - usage["devplane_dispatch_seconds"])
         row = {
             "config": config,
             "tenants": n_tenants,
@@ -1334,6 +1403,22 @@ def run_multitenant(n_tenants: int | None = None, rounds: int | None = None,
                 and deltas["resyncs"] == 0
             ),
             "isolation_ok": isolation_ok,
+            "billing": {
+                "per_tenant": {
+                    t: {
+                        "device_seconds": usage["tenants"].get(
+                            t, {}).get("device_seconds", 0.0),
+                        "dispatches": usage["tenants"].get(
+                            t, {}).get("dispatches", 0),
+                    }
+                    for t in (f"tenant-{i}" for i in range(n_tenants))
+                },
+                "total_device_seconds": usage["total_device_seconds"],
+                "dropped_device_seconds": usage["dropped_device_seconds"],
+                "devplane_dispatch_seconds": usage[
+                    "devplane_dispatch_seconds"],
+            },
+            "billing_sums_ok": bool(billing_gap <= 1e-3),
             # client-side rung mix of the measured phase (session.sync
             # delta-vs-resync, solver.route service-vs-rescue): steady
             # state reads all-delta / all-service

@@ -879,7 +879,7 @@ class TestIntrospection:
         self._populate(reg)
         snap = decisions.introspect_snapshot()
         assert set(snap) == {"sites", "rounds", "quality", "tenants",
-                             "anomalies", "capsules"}
+                             "anomalies", "capsules", "timeline"}
         assert snap["sites"]["solver.route"]["last"]["rung"] == "xla"
         assert snap["quality"]["series"]
         json.dumps(snap)  # endpoint-serializable

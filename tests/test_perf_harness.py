@@ -263,7 +263,7 @@ class TestMultiTenantSentinelLeg:
         ran = []
         monkeypatch.setattr(bench, "_fresh_perf_rows",
                             lambda args: ran.append(args) or {})
-        assert bench._multitenant_pairs() == []
+        assert bench._multitenant_pairs() == ([], [])
         assert ran == []  # the fresh run was never paid
 
     def test_pairs_total_and_p99(self, monkeypatch):
@@ -276,7 +276,8 @@ class TestMultiTenantSentinelLeg:
         monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
             cfg: {"config": cfg, "total_ms": 1100.0, "worst_p99_ms": 50.0},
         })
-        pairs = bench._multitenant_pairs()
+        pairs, problems = bench._multitenant_pairs()
+        assert problems == []
         assert (cfg, 1000.0, 1100.0) in pairs
         assert (f"{cfg}:p99", 20.0, 50.0) in pairs
         # a >15% p99 regression trips the shared table
@@ -294,7 +295,7 @@ class TestMultiTenantSentinelLeg:
             cfg: {"config": cfg, "total_ms": 9000.0, "worst_p99_ms": 900.0,
                   "degraded": True},
         })
-        assert bench._multitenant_pairs() == []
+        assert bench._multitenant_pairs() == ([], [])
         err = capsys.readouterr().err
         assert "degraded" in err  # loud skip, never a silently-green gate
 
@@ -308,8 +309,39 @@ class TestMultiTenantSentinelLeg:
             "multitenant-4x2x24": {"config": "multitenant-4x2x24",
                                    "total_ms": 500.0},
         })
-        assert bench._multitenant_pairs() == []
+        assert bench._multitenant_pairs() == ([], [])
         assert "nothing was compared" in capsys.readouterr().err
+
+    def test_billing_mismatch_is_a_hard_gate(self, monkeypatch, capsys):
+        import bench
+
+        cfg = "multitenant-8x3x24"
+        monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
+            cfg: {"config": cfg, "total_ms": 1000.0},
+        })
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            cfg: {"config": cfg, "total_ms": 1100.0,
+                  "billing_sums_ok": False,
+                  "billing": {"total_device_seconds": 1.2,
+                              "devplane_dispatch_seconds": 3.4}},
+        })
+        _, problems = bench._multitenant_pairs()
+        assert any("escaped tenant attribution" in p for p in problems)
+
+    def test_pre_ledger_row_skips_the_billing_gate(self, monkeypatch):
+        # a fresh row without the billing keys (pre-ledger harness) must
+        # not trip the gate on absence
+        import bench
+
+        cfg = "multitenant-8x3x24"
+        monkeypatch.setattr(bench, "_perf_baseline_rows", lambda: {
+            cfg: {"config": cfg, "total_ms": 1000.0},
+        })
+        monkeypatch.setattr(bench, "_fresh_perf_rows", lambda args, env=None: {
+            cfg: {"config": cfg, "total_ms": 1100.0},
+        })
+        _, problems = bench._multitenant_pairs()
+        assert problems == []
 
 
 class TestMultichipSentinelLeg:
@@ -612,6 +644,20 @@ class TestGlobalSentinelLeg:
         assert any("max-one-dispatch-per-generation" in p
                    for p in problems)
 
+    def test_ledger_reconciliation_is_a_hard_gate(self, monkeypatch):
+        _, problems = self._run(
+            monkeypatch, self._row(
+                cost_reconciled_ok=False,
+                ledger={"live_rate": 300.0, "realized_cost": 12.0}))
+        assert any("fleet-ledger" in p and "escaped the ledger" in p
+                   for p in problems)
+
+    def test_pre_ledger_row_skips_the_reconciliation_gate(self, monkeypatch):
+        # a committed pre-ledger row carries no cost_reconciled_ok key —
+        # the gate must stay dormant, not fire on absence
+        _, problems = self._run(monkeypatch, self._row())
+        assert problems == []
+
     def test_old_schema_row_parses_without_dispatch_gate(self, monkeypatch):
         # a pre-ISSUE-14 row (no dispatch keys, 10s-era budget) must
         # still parse and pair — the new gate only arms when present
@@ -692,3 +738,15 @@ class TestSpotSentinelLeg:
     def test_no_baseline_still_gates_without_pairs(self, monkeypatch):
         pairs, problems = self._run(monkeypatch, self._row())
         assert problems == [] and pairs == []
+
+    def test_ledger_reconciliation_is_a_hard_gate(self, monkeypatch):
+        row = self._row(cost_reconciled_ok=False)
+        row["spot-1000-storm"]["risk_aware"]["ledger_live_rate"] = 380.0
+        row["spot-1000-storm"]["risk_blind"]["ledger_live_rate"] = 512.7
+        _, problems = self._run(monkeypatch, row)
+        assert any("fleet-ledger" in p and "escaped the ledger" in p
+                   for p in problems)
+
+    def test_pre_ledger_row_skips_the_reconciliation_gate(self, monkeypatch):
+        _, problems = self._run(monkeypatch, self._row())
+        assert problems == []

@@ -964,6 +964,107 @@ class TestCapsuleRules:
         assert findings == []
 
 
+class TestTimelineRules:
+    """GL406: the fleet-ledger timeline hooks (obs/timeline.py) must stay
+    jit-unreachable — `record_event`/`record_billing` take the ledger
+    lock, read wall-clock time, and mutate the bounded event ring and the
+    billing rows; a trace-time execution would mint one frozen lifecycle
+    event per compile and corrupt the billed device-seconds `/usage`
+    reports."""
+
+    def test_positive_event_and_billing_in_jitted_function(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import timeline\n"
+            "\n"
+            "def kernel(x):\n"
+            "    timeline.record_event('launch', 'node-1')\n"
+            "    timeline.record_billing('solver', 0.5)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL406", "GL406"]
+        assert "record_event" in findings[0].message
+
+    def test_positive_bare_import_and_receiver_verb_spellings(self):
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs.timeline import note_launch\n"
+            "from karpenter_tpu.obs.timeline import TIMELINE\n"
+            "\n"
+            "def kernel(x):\n"
+            "    note_launch('claim-1')\n"
+            "    TIMELINE.observe(x)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+        )})
+        assert rules_of(findings) == ["GL406", "GL406"]
+
+    def test_positive_hook_reached_through_call_edge(self):
+        """Reachability carries GL406 across modules like GL401-405: the
+        event hides in a helper the jitted entry calls."""
+        findings, _ = analyze_sources({
+            "pkg.a": (
+                "import jax\n"
+                "from pkg.b import helper\n"
+                "\n"
+                "def entry(x):\n"
+                "    return helper(x)\n"
+                "\n"
+                "fn = jax.jit(entry)\n"
+            ),
+            "pkg.b": (
+                "from karpenter_tpu.obs import timeline\n"
+                "\n"
+                "def helper(t):\n"
+                "    timeline.begin_command(site='consolidate.global')\n"
+                "    return t * 2\n"
+            ),
+        })
+        assert rules_of(findings) == ["GL406"]
+        assert findings[0].path.endswith("pkg/b.py")
+
+    def test_negative_host_side_controller_hook_not_flagged(self):
+        """The production pattern — dispatch the kernel, record lifecycle
+        events from the host-side controller after the pull — never flags
+        (controllers/disruption/controller.py, state/cluster.py,
+        controllers/node/termination.py all hook exactly this way)."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "from karpenter_tpu.obs import timeline\n"
+            "\n"
+            "def kernel(x):\n"
+            "    return x * 2\n"
+            "\n"
+            "fn = jax.jit(kernel)\n"
+            "\n"
+            "def execute(args):\n"
+            "    out = fn(args)\n"
+            "    timeline.record_event('drain', 'node-1')\n"
+            "    timeline.record_billing('solver', 0.5, tenant='t1')\n"
+            "    return out\n"
+        )})
+        assert findings == []
+
+    def test_negative_generic_verbs_on_other_receivers_not_flagged(self):
+        """`record`/`observe`/`note` on non-timeline receivers (a static
+        profiler handle) stay quiet inside jitted code — only the timeline
+        receivers make the verbs GL406."""
+        findings, _ = analyze_sources({"fx": (
+            "import jax\n"
+            "\n"
+            "def kernel(x, prof):\n"
+            "    prof.note(x.shape[0])\n"
+            "    prof.observe(x.ndim)\n"
+            "    return x\n"
+            "\n"
+            "fn = jax.jit(kernel, static_argnames=('prof',))\n"
+        )})
+        assert findings == []
+
+
 class TestAdmissionHookSpecs:
     """ISSUE-12 spec extension: the ADMISSION plane's ledger and capsule
     hooks ride the same GL404/GL405 reachability pass — an
@@ -1136,7 +1237,7 @@ class TestPackageGate:
         for rule in ("GL101", "GL102", "GL103", "GL104",
                      "GL201", "GL202", "GL203",
                      "GL301", "GL302", "GL303",
-                     "GL401", "GL402", "GL403", "GL404", "GL405",
+                     "GL401", "GL402", "GL403", "GL404", "GL405", "GL406",
                      "GL501", "GL502", "GL503", "GL504"):
             assert rule in out
         # adding a rule without spec fixtures fails here ON PURPOSE: every
@@ -1145,7 +1246,7 @@ class TestPackageGate:
             "GL101", "GL102", "GL103", "GL104",
             "GL201", "GL202", "GL203",
             "GL301", "GL302", "GL303",
-            "GL401", "GL402", "GL403", "GL404", "GL405",
+            "GL401", "GL402", "GL403", "GL404", "GL405", "GL406",
             "GL501", "GL502", "GL503", "GL504",
         }
 

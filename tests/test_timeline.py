@@ -1,0 +1,471 @@
+"""Fleet ledger (karpenter_tpu/obs/timeline): the closed lifecycle-event
+matrix (unknown kinds raise), the bounded ring, idle-round staging (a
+discarded round cannot grow the ring), cause-link integrity from
+begin_command through note_launch/retire to reconciliation, the
+savings-drift anomaly (fires exactly once per steady-streak crossing,
+first-sight exempt), the realized-cost integrator, the observed
+interruption-rate feed, per-tenant device-time billing summing to the
+devplane dispatch ledger, Histogram.remove parity, the /usage endpoint,
+and the `report --timeline` rendering.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs import decisions, devplane, timeline
+from karpenter_tpu.obs.timeline import EVENT_KINDS, FleetTimeline
+from karpenter_tpu.operator import metrics as m
+from karpenter_tpu.operator.metrics import Registry
+
+
+@pytest.fixture
+def ledger(tmp_path):
+    """Isolated timeline + tracer/recorder/devplane/decision state."""
+    obs.configure(enabled=True, dump_dir=str(tmp_path), capacity=8,
+                  dump_all=False)
+    obs.RECORDER.clear()
+    timeline.reset()
+    decisions.reset()
+    devplane.reset()
+    yield tmp_path
+    timeline.reset()
+    decisions.reset()
+    devplane.reset()
+    obs.reset()
+
+
+def _offering(price=1.0, risk=None):
+    return SimpleNamespace(price=price, interruption_risk=risk)
+
+
+class _Catalog:
+    """Stub CatalogView: labels['type'] -> offering (or None)."""
+
+    def __init__(self, prices):
+        self.prices = prices
+
+    def offering(self, labels):
+        p = self.prices.get(labels.get("node.kubernetes.io/instance-type"))
+        return _offering(p) if p is not None else None
+
+
+def _node(name, itype="small", pool="default", zone="z1", ctype="on-demand"):
+    return SimpleNamespace(name=name, labels={
+        "node.kubernetes.io/instance-type": itype,
+        "karpenter.sh/nodepool": pool,
+        "topology.kubernetes.io/zone": zone,
+        "karpenter.sh/capacity-type": ctype,
+    })
+
+
+# ---------------------------------------------------------------------------
+# the event matrix + the bounded ring
+# ---------------------------------------------------------------------------
+
+class TestEventMatrix:
+    def test_every_kind_records_and_counts(self, ledger):
+        reg = Registry()
+        for kind in EVENT_KINDS:
+            timeline.record_event(kind, f"node-{kind}", registry=reg)
+        snap = timeline.timeline_snapshot()
+        assert snap["ring"]["size"] == len(EVENT_KINDS)
+        assert snap["ring"]["kinds"] == {k: 1 for k in EVENT_KINDS}
+        for kind in EVENT_KINDS:
+            assert reg.counter(m.TIMELINE_EVENTS).value(kind=kind) == 1
+
+    def test_unknown_kind_raises(self, ledger):
+        with pytest.raises(ValueError):
+            timeline.record_event("reboot", "node-1")
+
+    def test_attrs_and_cause_ride_the_event(self, ledger):
+        ev = timeline.record_event(
+            "drain", "node-1", cause={"site": "consolidate.global",
+                                      "rung": "joint", "reason": "ok",
+                                      "command": "cmd-00001"},
+            pods=7, registry=Registry())
+        assert ev["pods"] == 7
+        assert ev["cause"]["command"] == "cmd-00001"
+        got = timeline.timeline_snapshot()["events"][-1]
+        assert got["cause"]["site"] == "consolidate.global"
+
+    def test_ring_is_bounded_and_counts_drops(self, ledger, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TIMELINE_RING", "16")
+        timeline.reset()
+        reg = Registry()
+        for i in range(40):
+            timeline.record_event("bind", f"node-{i}", registry=reg)
+        snap = timeline.timeline_snapshot()
+        assert snap["ring"]["capacity"] == 16
+        assert snap["ring"]["size"] == 16
+        assert snap["ring"]["dropped"] == 24
+        # the kind census survives the drops: counts are ever-committed
+        assert snap["ring"]["kinds"]["bind"] == 40
+        # the survivors are the LAST 16
+        assert snap["events"][0]["node"] == "node-24"
+
+
+# ---------------------------------------------------------------------------
+# round staging: discarded rounds cannot grow the ring
+# ---------------------------------------------------------------------------
+
+class TestRoundStaging:
+    def test_idle_discarded_round_commits_nothing(self, ledger):
+        reg = Registry()
+        with obs.round_trace("disrupt", registry=reg):
+            timeline.record_event("drain", "node-1")
+            obs.discard_round()
+        snap = timeline.timeline_snapshot()
+        assert snap["ring"]["size"] == 0
+        assert reg.counter(m.TIMELINE_EVENTS).value(kind="drain") == 0
+
+    def test_kept_round_commits_with_trace_id(self, ledger):
+        reg = Registry()
+        with obs.round_trace("disrupt", registry=reg):
+            tid = obs.current_trace_id()
+            timeline.record_event("drain", "node-1")
+            # not committed yet: events stage on the trace until close
+            assert timeline.timeline_snapshot()["ring"]["size"] == 0
+        snap = timeline.timeline_snapshot()
+        assert snap["ring"]["size"] == 1
+        assert snap["events"][0]["trace_id"] == tid
+        assert reg.counter(m.TIMELINE_EVENTS).value(kind="drain") == 1
+
+    def test_no_open_round_commits_directly(self, ledger):
+        timeline.record_event("register", "node-1", registry=Registry())
+        snap = timeline.timeline_snapshot()
+        assert snap["ring"]["size"] == 1
+        assert snap["events"][0]["trace_id"] is None
+
+
+# ---------------------------------------------------------------------------
+# cause links + command reconciliation
+# ---------------------------------------------------------------------------
+
+class TestCauseLinks:
+    def test_launch_pops_staged_cause_and_reconciles(self, ledger):
+        reg = Registry()
+        cmd_id = timeline.begin_command(
+            site="consolidate.global", rung="joint", reason="underutilized",
+            predicted=3.0, retired_rate=5.0,
+            claims=["claim-a"], nodes=["old-1"], registry=reg)
+        cause = {"site": "consolidate.global", "rung": "joint",
+                 "reason": "underutilized", "command": cmd_id}
+        timeline.pend_cause("claim-a", cause)
+        ev = timeline.note_launch("claim-a", node="new-1", price=2.0,
+                                  registry=reg)
+        assert ev["cause"]["command"] == cmd_id
+        assert ev["claim"] == "claim-a"
+        # still pending: the retired candidate hasn't gone yet
+        assert timeline.timeline_snapshot()["commands"]["pending"] == 1
+        timeline.record_event("retire", "old-1", registry=reg)
+        snap = timeline.timeline_snapshot()
+        assert snap["commands"]["pending"] == 0
+        rec = snap["commands"]["reconciled"][-1]
+        assert rec["command"] == cmd_id
+        assert rec["realized"] == pytest.approx(3.0)  # 5.0 retired - 2.0
+        assert rec["ok"] is True
+        assert decisions.counts()[
+            ("fleet.reconcile", "within", "consolidation")] == 1
+        assert reg.counter(m.FLEET_SAVINGS_PREDICTED).value(
+            site="consolidate.global") == pytest.approx(3.0)
+        assert reg.counter(m.FLEET_SAVINGS_REALIZED).value(
+            site="consolidate.global") == pytest.approx(3.0)
+
+    def test_unpriced_command_records_without_verdict(self, ledger):
+        reg = Registry()
+        timeline.begin_command(site="consolidate.global", rung="ladder",
+                               reason="underutilized", predicted=None,
+                               retired_rate=2.0, nodes=["old-1"],
+                               registry=reg)
+        timeline.record_event("retire", "old-1", registry=reg)
+        rec = timeline.timeline_snapshot()["commands"]["reconciled"][-1]
+        assert rec["ok"] is None
+        assert ("fleet.reconcile", "within", "consolidation") \
+            not in decisions.counts()
+
+    def test_interruption_site_maps_to_interruption_reason(self, ledger):
+        reg = Registry()
+        timeline.begin_command(site="disrupt.interruption",
+                               rung="proactive", reason="interrupted",
+                               predicted=1.0, retired_rate=1.0,
+                               nodes=["spot-1"], registry=reg)
+        timeline.record_event("retire", "spot-1", registry=reg)
+        assert decisions.counts()[
+            ("fleet.reconcile", "within", "interruption")] == 1
+
+    def test_vanished_node_self_heals_reconciliation(self, ledger):
+        """A candidate that disappears between fleet observations (the
+        store pruned it before a retire event committed) still completes
+        its command."""
+        reg = Registry()
+        cat = _Catalog({"small": 1.0})
+        timeline.observe_fleet([_node("old-1")], cat, 0.0, registry=reg)
+        timeline.begin_command(site="consolidate.global", rung="joint",
+                               reason="underutilized", predicted=1.0,
+                               retired_rate=1.0, nodes=["old-1"],
+                               registry=reg)
+        timeline.observe_fleet([], cat, 60.0, registry=reg)
+        assert timeline.timeline_snapshot()["commands"]["pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# savings-drift anomaly
+# ---------------------------------------------------------------------------
+
+class TestSavingsDrift:
+    _seq = 0
+
+    def _reconcile(self, reg, predicted, realized, n=1):
+        for _ in range(n):
+            TestSavingsDrift._seq += 1
+            node = f"n-{TestSavingsDrift._seq}"
+            timeline.begin_command(
+                site="consolidate.global", rung="joint",
+                reason="underutilized", predicted=predicted,
+                retired_rate=realized, nodes=[node], registry=reg)
+            timeline.record_event("retire", node, registry=reg)
+
+    def test_fires_exactly_once_per_streak_crossing(self, ledger,
+                                                    monkeypatch):
+        monkeypatch.setenv("KARPENTER_SAVINGS_STEADY_AFTER", "3")
+        timeline.reset()
+        reg = Registry()
+        fired = lambda: reg.counter(m.TRACE_ANOMALIES).value(
+            kind="savings-drift")
+        # first-sight exempt: a violation with no prior streak stays quiet
+        self._reconcile(reg, predicted=5.0, realized=1.0)
+        assert fired() == 0
+        # a steady in-tolerance streak arms the detector...
+        self._reconcile(reg, predicted=1.0, realized=1.0, n=3)
+        # ...and the crossing fires exactly once, even when the drift holds
+        self._reconcile(reg, predicted=5.0, realized=1.0, n=4)
+        assert fired() == 1
+        # recovery + a fresh streak re-arms for the next crossing
+        self._reconcile(reg, predicted=1.0, realized=1.0, n=3)
+        self._reconcile(reg, predicted=5.0, realized=1.0)
+        assert fired() == 2
+        assert decisions.counts()[
+            ("fleet.reconcile", "drift", "consolidation")] == 6
+
+    def test_tolerance_is_relative(self, ledger, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SAVINGS_DRIFT_TOL", "0.5")
+        timeline.reset()
+        reg = Registry()
+        self._reconcile(reg, predicted=2.0, realized=1.1)  # |Δ|=0.9 <= 1.0
+        rec = timeline.timeline_snapshot()["commands"]["reconciled"][-1]
+        assert rec["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# realized cost + interruption rates
+# ---------------------------------------------------------------------------
+
+class TestRealizedCost:
+    def test_integral_is_piecewise_constant_between_observations(
+            self, ledger):
+        reg = Registry()
+        cat = _Catalog({"small": 1.0, "big": 3.0})
+        nodes = [_node("n1", "small"), _node("n2", "big", zone="z2")]
+        out = timeline.observe_fleet(nodes, cat, 0.0, registry=reg)
+        assert out["live_nodes"] == 2
+        assert out["live_rate"] == pytest.approx(4.0)
+        assert out["realized_total"] == 0.0
+        out = timeline.observe_fleet(nodes, cat, 1800.0, registry=reg)
+        assert out["realized_total"] == pytest.approx(2.0)  # $4/h x 0.5h
+        assert reg.counter(m.FLEET_COST_REALIZED).value(
+            nodepool="default", zone="z1", capacity_type="on-demand"
+        ) == pytest.approx(0.5)
+        assert reg.counter(m.FLEET_COST_REALIZED).value(
+            nodepool="default", zone="z2", capacity_type="on-demand"
+        ) == pytest.approx(1.5)
+
+    def test_unpriced_nodes_are_skipped(self, ledger):
+        out = timeline.observe_fleet(
+            [_node("n1", "delisted")], _Catalog({}), 0.0,
+            registry=Registry())
+        assert out["live_nodes"] == 0
+
+    def test_interruption_rates_feed(self, ledger):
+        reg = Registry()
+        cat = _Catalog({"small": 1.0})
+        timeline.observe_fleet([_node("spot-1")], cat, 0.0, registry=reg)
+        timeline.observe_fleet([_node("spot-1")], cat, 3600.0, registry=reg)
+        timeline.record_event("interrupt", "spot-1", instance_type="small",
+                              zone="z1", deadline=3720.0, registry=reg)
+        timeline.record_event("retire", "spot-1", instance_type="small",
+                              zone="z1", registry=reg)
+        rates = timeline.interruption_rates()["small/z1"]
+        assert rates["notices"] == 1
+        assert rates["reclaims"] == 1
+        assert rates["exposure_hours"] == pytest.approx(1.0)
+        assert rates["reclaims_per_hour"] == pytest.approx(1.0)
+
+    def test_retire_without_notice_is_not_a_reclaim(self, ledger):
+        reg = Registry()
+        timeline.record_event("retire", "od-1", instance_type="small",
+                              zone="z1", registry=reg)
+        assert timeline.interruption_rates() == {}
+
+
+# ---------------------------------------------------------------------------
+# per-tenant billing
+# ---------------------------------------------------------------------------
+
+class TestBilling:
+    def test_billed_seconds_sum_to_devplane_ledger(self, ledger):
+        reg = Registry()
+        devplane.record_dispatch("solver", ("k", 1), 0.25, registry=reg,
+                                 tenant="acme")
+        devplane.record_dispatch("solver", ("k", 1), 0.05, registry=reg,
+                                 tenant="acme")
+        devplane.record_dispatch("mesh", ("k", 2), 0.40, registry=reg,
+                                 tenant="globex")
+        devplane.record_dispatch("mesh", ("k", 3), 0.10, registry=reg)
+        usage = timeline.usage_snapshot()
+        assert usage["tenants"]["acme"]["device_seconds"] == pytest.approx(
+            0.30)
+        assert usage["tenants"]["acme"]["dispatches"] == 2
+        assert usage["tenants"]["acme"]["families"]["solver"] == \
+            pytest.approx(0.30)
+        assert usage["tenants"]["globex"]["device_seconds"] == \
+            pytest.approx(0.40)
+        assert usage["tenants"]["untenanted"]["device_seconds"] == \
+            pytest.approx(0.10)
+        # the acceptance invariant: per-tenant billed device-seconds sum
+        # to the devplane dispatch total within rounding
+        assert usage["total_device_seconds"] == pytest.approx(
+            usage["devplane_dispatch_seconds"])
+        assert reg.counter(m.TENANT_DEVICE_SECONDS).value(
+            tenant="acme") == pytest.approx(0.30)
+        assert reg.histogram(m.TENANT_DISPATCH_SECONDS).count(
+            tenant="acme") == 2
+
+    def test_open_round_tenant_attr_resolves(self, ledger):
+        reg = Registry()
+        with obs.round_trace("solver-service", registry=reg,
+                             tenant="acme"):
+            got = timeline.record_billing("solver", 0.5, registry=reg)
+        assert got == "acme"
+        assert timeline.usage_snapshot()["tenants"]["acme"][
+            "device_seconds"] == pytest.approx(0.5)
+
+    def test_drop_tenant_folds_into_dropped_and_retires_series(
+            self, ledger):
+        reg = Registry()
+        timeline.record_billing("solver", 1.5, tenant="churn", registry=reg)
+        h = reg.histogram(m.TENANT_DISPATCH_SECONDS)
+        assert h.count(tenant="churn") == 1
+        timeline.drop_tenant("churn", slo="solve", registry=reg)
+        usage = timeline.usage_snapshot()
+        assert "churn" not in usage["tenants"]
+        assert usage["dropped_device_seconds"] == pytest.approx(1.5)
+        # the total stays exact under churn
+        assert usage["total_device_seconds"] == pytest.approx(1.5)
+        assert h.count(tenant="churn") == 0
+
+    def test_tenant_table_is_bounded(self, ledger):
+        reg = Registry()
+        for i in range(300):
+            timeline.record_billing("solver", 0.01, tenant=f"t{i}",
+                                    registry=reg)
+        usage = timeline.usage_snapshot()
+        assert len(usage["tenants"]) == 256
+        # evicted seconds folded, not lost
+        assert usage["total_device_seconds"] == pytest.approx(3.0)
+
+    def test_histogram_remove_parity_with_gauge(self, ledger):
+        reg = Registry()
+        h = reg.histogram("h_test", "help")
+        h.observe(1.0, tenant="a")
+        h.observe(2.0, tenant="b")
+        h.remove(tenant="a")
+        assert h.count(tenant="a") == 0
+        assert h.sum(tenant="a") == 0.0
+        assert h.count(tenant="b") == 1  # other series untouched
+        h.remove(tenant="missing")  # idempotent, like Gauge.remove
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /usage, /introspect, report --timeline
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_usage_endpoint_serves_billing_json(self, ledger):
+        from karpenter_tpu.__main__ import serve_metrics
+
+        timeline.record_billing("solver", 0.5, tenant="acme",
+                                registry=Registry())
+        server = serve_metrics(Registry(), 18767, host="127.0.0.1")
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:18767/usage") as resp:
+                body = json.loads(resp.read())
+        finally:
+            server.shutdown()
+        assert body["tenants"]["acme"]["device_seconds"] == 0.5
+        assert set(body) == {"tenants", "total_device_seconds",
+                             "dropped_device_seconds",
+                             "devplane_dispatch_seconds"}
+
+    def test_introspect_snapshot_carries_timeline_section(self, ledger):
+        timeline.record_event("launch", "node-1", registry=Registry())
+        snap = decisions.introspect_snapshot()
+        assert snap["timeline"]["ring"]["size"] == 1
+        json.dumps(snap)  # the endpoint body must stay JSON-serializable
+
+    def test_report_timeline_rendering(self, ledger):
+        from karpenter_tpu.obs.__main__ import render_report, render_timeline
+
+        reg = Registry()
+        cmd_id = timeline.begin_command(
+            site="consolidate.global", rung="joint", reason="underutilized",
+            predicted=2.0, retired_rate=3.0, claims=["claim-a"],
+            nodes=["old-1"], registry=reg)
+        timeline.pend_cause("claim-a", {"site": "consolidate.global",
+                                        "rung": "joint", "reason": "ok",
+                                        "command": cmd_id})
+        timeline.note_launch("claim-a", node="new-1", price=1.0,
+                             registry=reg)
+        timeline.record_event("retire", "old-1", registry=reg)
+        timeline.record_billing("solver", 0.5, tenant="acme", registry=reg)
+        out = render_timeline(decisions.introspect_snapshot()["timeline"])
+        assert "fleet ledger" in out
+        assert "launch" in out and "retire" in out
+        assert f"[{cmd_id}]" in out  # the cause chain renders
+        assert "within" in out
+        assert "acme" in out
+        # the report CLI only appends the section under --timeline
+        snap = decisions.introspect_snapshot()
+        assert "fleet ledger" in render_report(snap, timeline=True)
+        assert "fleet ledger" not in render_report(snap)
+
+    def test_reset_clears_every_plane(self, ledger):
+        reg = Registry()
+        timeline.record_event("launch", "node-1", registry=reg)
+        timeline.record_billing("solver", 1.0, tenant="a", registry=reg)
+        timeline.begin_command(site="consolidate.global", nodes=["n"],
+                               registry=reg)
+        timeline.reset()
+        snap = timeline.timeline_snapshot()
+        assert snap["ring"]["size"] == 0
+        assert snap["commands"]["pending"] == 0
+        assert timeline.usage_snapshot()["total_device_seconds"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the class is instantiable standalone (tests that want isolation without
+# touching the module singleton)
+# ---------------------------------------------------------------------------
+
+class TestStandaloneInstance:
+    def test_independent_instances_do_not_share_state(self, ledger):
+        a, b = FleetTimeline(), FleetTimeline()
+        a.record_event("launch", "n1", registry=Registry())
+        assert a.snapshot()["ring"]["size"] == 1
+        assert b.snapshot()["ring"]["size"] == 0
